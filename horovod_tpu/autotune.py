@@ -1,0 +1,230 @@
+"""Autotuning: Bayesian optimization of runtime knobs.
+
+Reference: ``horovod/common/parameter_manager.{h,cc}`` (tunable-knob
+manager, warmup/sample scoring by bytes/sec, winner broadcast via
+``Controller::SynchronizeParameters``) and ``horovod/common/optim/`` —
+Gaussian-process regression with an RBF kernel and Expected-Improvement
+acquisition (``bayesian_optimization.h:93``, ``gaussian_process.{h,cc}``).
+
+TPU re-design: XLA already schedules collectives, so the knob set changes
+(SURVEY.md §7 hard-part #5).  What remains worth tuning on TPU:
+
+* ``fusion_threshold`` — bucket bytes for the gradient-fusion transform
+  (too small → many collective launches; too large → less overlap with
+  backward compute);
+* ``compression`` — {none, bf16} wire compression (categorical);
+
+Score = throughput (bytes reduced per second) exactly like the reference.
+The GP/EI core is a faithful re-implementation in numpy (host-side, tiny
+problem sizes), not a port of the Eigen code.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class GaussianProcessRegressor:
+    """GP with RBF kernel, exact inference via Cholesky.
+
+    Mirrors ``common/optim/gaussian_process.{h,cc}`` at the math level.
+    """
+
+    def __init__(self, length_scale: float = 1.0, noise: float = 1e-6) -> None:
+        self.length_scale = length_scale
+        self.noise = noise
+        self._x: Optional[np.ndarray] = None
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d = a[:, None, :] - b[None, :, :]
+        sq = np.sum(d * d, axis=-1)
+        return np.exp(-0.5 * sq / (self.length_scale**2))
+
+    def fit(self, x: np.ndarray, y: np.ndarray) -> None:
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        y = np.asarray(y, np.float64).ravel()
+        self._x = x
+        self._ymean = y.mean() if y.size else 0.0
+        self._ystd = y.std() + 1e-12
+        yn = (y - self._ymean) / self._ystd
+        k = self._kernel(x, x) + self.noise * np.eye(len(x))
+        self._L = np.linalg.cholesky(k)
+        self._alpha = np.linalg.solve(
+            self._L.T, np.linalg.solve(self._L, yn)
+        )
+
+    def predict(self, x: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        if self._x is None:
+            return np.zeros(len(x)), np.ones(len(x))
+        ks = self._kernel(x, self._x)
+        mu = ks @ self._alpha
+        v = np.linalg.solve(self._L, ks.T)
+        var = np.clip(1.0 - np.sum(v * v, axis=0), 1e-12, None)
+        return mu * self._ystd + self._ymean, np.sqrt(var) * self._ystd
+
+
+class BayesianOptimization:
+    """EI-driven optimizer over a box domain
+    (``optim/bayesian_optimization.h``; acquisition maximized by random +
+    local refinement instead of L-BFGS — equivalent at these dimensions)."""
+
+    def __init__(
+        self,
+        bounds: Sequence[Tuple[float, float]],
+        *,
+        xi: float = 0.01,
+        seed: int = 0,
+    ) -> None:
+        self.bounds = np.asarray(bounds, np.float64)
+        self.xi = xi
+        self.gp = GaussianProcessRegressor(length_scale=0.3)
+        self.xs: List[np.ndarray] = []
+        self.ys: List[float] = []
+        self._rng = np.random.RandomState(seed)
+
+    def _normalize(self, x: np.ndarray) -> np.ndarray:
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return (x - lo) / (hi - lo)
+
+    def _denormalize(self, u: np.ndarray) -> np.ndarray:
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return lo + u * (hi - lo)
+
+    def register(self, x: Sequence[float], y: float) -> None:
+        self.xs.append(self._normalize(np.asarray(x, np.float64)))
+        self.ys.append(float(y))
+        self.gp.fit(np.stack(self.xs), np.asarray(self.ys))
+
+    def expected_improvement(self, u: np.ndarray) -> np.ndarray:
+        """EI(u) = (mu - best - xi) Phi(z) + sigma phi(z)
+        (``bayesian_optimization.h:93``)."""
+        mu, sigma = self.gp.predict(u)
+        best = max(self.ys) if self.ys else 0.0
+        imp = mu - best - self.xi
+        z = imp / sigma
+        phi = np.exp(-0.5 * z * z) / np.sqrt(2 * np.pi)
+        Phi = 0.5 * (1 + _erf(z / np.sqrt(2)))
+        ei = imp * Phi + sigma * phi
+        ei[sigma < 1e-10] = 0.0
+        return ei
+
+    def suggest(self) -> np.ndarray:
+        if len(self.xs) < 3:  # bootstrap with random exploration
+            u = self._rng.rand(self.bounds.shape[0])
+            return self._denormalize(u)
+        cand = self._rng.rand(512, self.bounds.shape[0])
+        ei = self.expected_improvement(cand)
+        return self._denormalize(cand[int(np.argmax(ei))])
+
+
+def _erf(x: np.ndarray) -> np.ndarray:
+    # Abramowitz & Stegun 7.1.26, vectorized; enough precision for EI.
+    sign = np.sign(x)
+    x = np.abs(x)
+    t = 1.0 / (1.0 + 0.3275911 * x)
+    y = 1.0 - (
+        ((((1.061405429 * t - 1.453152027) * t) + 1.421413741) * t - 0.284496736)
+        * t
+        + 0.254829592
+    ) * t * np.exp(-x * x)
+    return sign * y
+
+
+@dataclass
+class Autotuner:
+    """Parameter manager (``parameter_manager.h:42-246``): scores each
+    sample window by bytes/sec, proposes the next knob setting, converges to
+    the best seen, and can synchronize the winner across processes."""
+
+    warmup_samples: int = 3       # HOROVOD_AUTOTUNE_WARMUP_SAMPLES (common.h:67)
+    steps_per_sample: int = 10    # HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE
+    log_path: Optional[str] = None  # HOROVOD_AUTOTUNE_LOG
+    # knob: log2 of fusion threshold MB in [0, 7] → 1 MB .. 128 MB
+    bo: BayesianOptimization = field(
+        default_factory=lambda: BayesianOptimization(bounds=[(0.0, 7.0)])
+    )
+
+    def __post_init__(self) -> None:
+        self._samples_seen = 0
+        self._bytes = 0.0
+        self._seconds = 0.0
+        self._steps = 0
+        self._current = self._threshold_from_knob(6.0)  # 64 MB default
+        self._current_knob = 6.0
+        self._best: Tuple[float, int] = (-1.0, self._current)
+        self._active = True
+        if self.log_path:
+            self._log_file = open(self.log_path, "w", newline="")
+            self._log = csv.writer(self._log_file)
+            self._log.writerow(["sample", "fusion_threshold", "score_bytes_per_sec"])
+        else:
+            self._log = None
+
+    @classmethod
+    def from_env(cls) -> "Autotuner":
+        return cls(
+            warmup_samples=int(os.environ.get("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", 3)),
+            steps_per_sample=int(
+                os.environ.get("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", 10)
+            ),
+            log_path=os.environ.get("HOROVOD_AUTOTUNE_LOG") or None,
+        )
+
+    @staticmethod
+    def _threshold_from_knob(knob: float) -> int:
+        return int(2 ** float(knob) * 1024 * 1024)
+
+    @property
+    def fusion_threshold(self) -> int:
+        """Current fusion threshold to use for the next step."""
+        return self._current
+
+    @property
+    def active(self) -> bool:
+        return self._active
+
+    def record(self, nbytes: float, seconds: float) -> None:
+        """Report one step's reduced-byte volume and duration
+        (``ParameterManager::Update``, scored in bytes/sec)."""
+        if not self._active:
+            return
+        self._bytes += nbytes
+        self._seconds += seconds
+        self._steps += 1
+        if self._steps < self.steps_per_sample:
+            return
+        score = self._bytes / max(self._seconds, 1e-9)
+        self._samples_seen += 1
+        if self._log:
+            self._log.writerow([self._samples_seen, self._current, score])
+            self._log_file.flush()
+        if self._samples_seen > self.warmup_samples:
+            self.bo.register([self._current_knob], score)
+            if score > self._best[0]:
+                self._best = (score, self._current)
+            knob = float(self.bo.suggest()[0])
+        else:
+            knob = self._current_knob  # warmup: keep defaults, discard score
+        self._current_knob = knob
+        self._current = self._threshold_from_knob(knob)
+        self._bytes = self._seconds = 0.0
+        self._steps = 0
+        if len(self.bo.ys) >= 12:  # converge: freeze at best
+            self._current = self._best[1]
+            self._active = False
+            if self._log:
+                self._log_file.close()
+
+    def synchronize(self) -> None:
+        """Broadcast the winning threshold from rank 0 so all processes
+        fuse identically (``Controller::SynchronizeParameters``,
+        ``controller.cc:33-47``)."""
+        from horovod_tpu import state as S
+
+        self._current = int(S.broadcast_object(self._current, 0))

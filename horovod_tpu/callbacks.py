@@ -1,0 +1,232 @@
+"""Training-loop callbacks and learning-rate schedules.
+
+Reference: ``horovod/_keras/callbacks.py:20-185`` —
+``BroadcastGlobalVariablesCallback``, ``MetricAverageCallback``,
+``LearningRateScheduleCallback`` (with momentum correction),
+``LearningRateWarmupCallback`` — re-exported for keras / tf.keras.
+
+TPU re-design: two idiomatic forms are provided.  (1) Framework-neutral
+callback objects with the Keras hook signature (``on_epoch_begin/end``,
+``on_batch_begin/end``) usable with any loop, including
+:class:`horovod_tpu.training.Loop`.  (2) Pure optax schedule factories
+(:func:`warmup_schedule`, :func:`multiplier_schedule`) — on TPU the LR
+schedule belongs inside the compiled step, not in a host callback, so these
+are the recommended path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+import horovod_tpu as hvd_mod  # resolved lazily to avoid cycles
+from horovod_tpu import basics
+from horovod_tpu.ops import collectives as C
+
+
+class Callback:
+    """Minimal Keras-compatible callback interface."""
+
+    def set_model(self, model) -> None:
+        self.model = model
+
+    def on_train_begin(self, logs: Optional[Dict] = None) -> None: ...
+
+    def on_epoch_begin(self, epoch: int, logs: Optional[Dict] = None) -> None: ...
+
+    def on_epoch_end(self, epoch: int, logs: Optional[Dict] = None) -> None: ...
+
+    def on_batch_begin(self, batch: int, logs: Optional[Dict] = None) -> None: ...
+
+    def on_batch_end(self, batch: int, logs: Optional[Dict] = None) -> None: ...
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast model/optimizer state from ``root_rank`` on train begin
+    (``_keras/callbacks.py:20-43``).  The model object must expose
+    ``params`` (and optionally ``opt_state``) attributes."""
+
+    def __init__(self, root_rank: int = 0) -> None:
+        self.root_rank = root_rank
+
+    def on_train_begin(self, logs=None) -> None:
+        from horovod_tpu import state as S
+
+        if hasattr(self, "model") and self.model is not None:
+            if getattr(self.model, "params", None) is not None:
+                self.model.params = S.broadcast_parameters(
+                    self.model.params, self.root_rank
+                )
+            if getattr(self.model, "opt_state", None) is not None:
+                self.model.opt_state = S.broadcast_optimizer_state(
+                    self.model.opt_state, self.root_rank
+                )
+
+
+class MetricAverageCallback(Callback):
+    """Allreduce-average numeric epoch metrics across workers
+    (``_keras/callbacks.py:46-84``)."""
+
+    def on_epoch_end(self, epoch: int, logs=None) -> None:
+        if not logs:
+            return
+        keys = sorted(
+            k
+            for k, v in logs.items()
+            if isinstance(v, (int, float, np.floating, np.integer))
+            and not isinstance(v, bool)
+            or getattr(v, "ndim", None) == 0
+        )
+        if not keys:
+            return
+        vals = np.asarray([float(logs[k]) for k in keys], np.float64)
+        avg = C.allreduce(vals.astype(np.float32), C.Average)
+        for k, v in zip(keys, np.asarray(avg)):
+            logs[k] = float(v)
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the base LR by ``multiplier(epoch)`` within
+    ``[start_epoch, end_epoch)`` (``_keras/callbacks.py:87-150``).
+
+    ``model.lr`` (a float attribute or a 0-d array in
+    ``model.hyperparams['learning_rate']``) is updated in place.  With
+    ``staircase=False`` the multiplier is evaluated per batch at fractional
+    epochs, matching the reference.  Momentum correction is not needed: on
+    TPU the schedule feeds optax's ``inject_hyperparams`` and the optimizer
+    state is scale-invariant in optax's formulation.
+    """
+
+    def __init__(
+        self,
+        multiplier,
+        start_epoch: int = 0,
+        end_epoch: Optional[int] = None,
+        staircase: bool = True,
+        steps_per_epoch: Optional[int] = None,
+        initial_lr: Optional[float] = None,
+    ) -> None:
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self.initial_lr = initial_lr
+        self.current_epoch = 0
+        if not callable(multiplier):
+            self.multiplier = lambda epoch: multiplier
+        else:
+            self.multiplier = multiplier
+
+    def _in_range(self, epoch: float) -> bool:
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def _base_lr(self) -> float:
+        if self.initial_lr is None:
+            raise ValueError(
+                "initial_lr must be set (the reference reads it from the "
+                "Keras optimizer; pass it explicitly here)"
+            )
+        return self.initial_lr
+
+    def _apply(self, epoch: float) -> None:
+        if not self._in_range(epoch):
+            return
+        lr = self._base_lr() * float(self.multiplier(epoch))
+        if hasattr(self, "model") and self.model is not None:
+            self.model.lr = lr
+        self.last_lr = lr
+
+    def on_epoch_begin(self, epoch: int, logs=None) -> None:
+        self.current_epoch = epoch
+        if self.staircase:
+            self._apply(epoch)
+
+    def on_batch_begin(self, batch: int, logs=None) -> None:
+        if not self.staircase:
+            if self.steps_per_epoch is None:
+                raise ValueError("steps_per_epoch required when staircase=False")
+            self._apply(self.current_epoch + batch / self.steps_per_epoch)
+
+    def on_epoch_end(self, epoch: int, logs=None) -> None:
+        if logs is not None and hasattr(self, "last_lr"):
+            logs["lr"] = self.last_lr
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual LR warmup from base LR to base LR × size over
+    ``warmup_epochs`` (``_keras/callbacks.py`` ``LearningRateWarmupCallback``;
+    Goyal et al. 2017 recipe cited there)."""
+
+    def __init__(
+        self,
+        warmup_epochs: int = 5,
+        momentum_correction: bool = True,  # accepted for API parity; no-op
+        steps_per_epoch: Optional[int] = None,
+        verbose: int = 0,
+        initial_lr: Optional[float] = None,
+    ) -> None:
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+        mult = lambda epoch: 1.0 / basics.size() * (
+            epoch * (basics.size() - 1) / warmup_epochs + 1
+        )
+        super().__init__(
+            multiplier=mult,
+            start_epoch=0,
+            end_epoch=warmup_epochs,
+            staircase=False,
+            steps_per_epoch=steps_per_epoch,
+            initial_lr=initial_lr,
+        )
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose and basics.rank() == 0:
+            print(
+                f"Epoch {epoch + 1}: finished gradual learning rate warmup to "
+                f"{getattr(self, 'last_lr', None)}."
+            )
+
+
+# --- optax-native schedules (the TPU-idiomatic path) ------------------------
+
+
+def warmup_schedule(
+    base_lr: float,
+    *,
+    warmup_steps: int,
+    size: Optional[int] = None,
+) -> Callable[[int], float]:
+    """optax schedule: linear warmup from ``base_lr`` to
+    ``base_lr * size`` over ``warmup_steps``, then constant.  The compiled
+    in-graph equivalent of ``LearningRateWarmupCallback``."""
+    import jax.numpy as jnp
+
+    def schedule(step):
+        n = size if size is not None else basics.size()
+        frac = jnp.minimum(step / max(warmup_steps, 1), 1.0)
+        return base_lr * (1.0 + frac * (n - 1))
+
+    return schedule
+
+
+def multiplier_schedule(
+    base_lr: float, boundaries_and_multipliers: Sequence[Tuple[int, float]]
+) -> Callable[[int], float]:
+    """Piecewise-constant LR, the in-graph ``LearningRateScheduleCallback``."""
+    import jax.numpy as jnp
+
+    bounds = [b for b, _ in boundaries_and_multipliers]
+    mults = [m for _, m in boundaries_and_multipliers]
+
+    def schedule(step):
+        lr = jnp.asarray(base_lr)
+        for b, m in zip(bounds, mults):
+            lr = jnp.where(step >= b, base_lr * m, lr)
+        return lr
+
+    return schedule

@@ -1,0 +1,98 @@
+"""Elastic-ish state synchronization.
+
+The reference (v0.19) predates Horovod Elastic; its fault-tolerance
+primitive is Join (SURVEY.md §5.3) plus the convention that rank 0
+checkpoints and broadcasts restored state (§5.4).  This module packages that
+convention: a :class:`State` object holding params/optimizer state that can
+``sync()`` (broadcast from rank 0 after a restart or membership change),
+``save()``/``restore()`` to disk, and ``commit()`` periodically.
+
+On TPU a membership change means a new mesh and recompilation — the driver
+of that (re-running ``init()`` with the surviving hosts) lives above this
+layer in the launcher; this object guarantees the surviving state is
+consistent when training resumes.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from horovod_tpu import basics
+from horovod_tpu import state as S
+
+
+class State:
+    """Synchronizable training state (params, opt_state, epoch, step...)."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        self._keys = sorted(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def sync(self, root_rank: int = 0) -> None:
+        """Broadcast every field from ``root_rank`` (restart consistency)."""
+        for k in self._keys:
+            v = getattr(self, k)
+            leaves = jax.tree_util.tree_leaves(v)
+            if leaves and all(
+                isinstance(l, (jax.Array, np.ndarray, float, int)) for l in leaves
+            ):
+                setattr(self, k, S.broadcast_parameters(v, root_rank))
+            else:
+                setattr(self, k, S.broadcast_object(v, root_rank))
+
+    def save(self, path: str) -> None:
+        """Rank-0 checkpoint (host pytree pickle; for large models prefer
+        orbax — this covers the reference's convention, not a storage
+        format)."""
+        if basics.rank() == 0:
+            tmp = path + ".tmp"
+            host = {
+                k: jax.tree_util.tree_map(
+                    lambda l: np.asarray(l)
+                    if isinstance(l, (jax.Array, np.ndarray))
+                    else l,
+                    getattr(self, k),
+                )
+                for k in self._keys
+            }
+            with open(tmp, "wb") as f:
+                pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+
+    def restore(self, path: str, root_rank: int = 0) -> bool:
+        """Rank 0 loads, then broadcast to all.  Returns False if absent."""
+        exists = os.path.exists(path) if basics.rank() == 0 else False
+        exists = bool(S.broadcast_object(exists, root_rank))
+        if not exists:
+            return False
+        if basics.rank() == 0:
+            with open(path, "rb") as f:
+                host = pickle.load(f)
+        else:
+            host = None
+        host = S.broadcast_object(host, root_rank)
+        for k in self._keys:
+            if k in host:
+                setattr(self, k, host[k])
+        return True
+
+    def commit(self, path: Optional[str] = None) -> None:
+        if path is not None:
+            self.save(path)
+
+
+def run(train_fn):
+    """Decorator: sync state before the first invocation, mirroring
+    ``horovod.elastic.run``'s contract at v0.19 scope (initial broadcast)."""
+
+    def wrapped(state: State, *args, **kwargs):
+        state.sync()
+        return train_fn(state, *args, **kwargs)
+
+    return wrapped

@@ -1,0 +1,60 @@
+"""Join: graceful early exit for ranks that run out of data.
+
+Reference: the JOIN request type and coordinator accounting
+(``EnqueueJoin`` ``common/operations.cc:919-943``; ready-when
+``count == size - joined_size`` ``controller.cc:780-803``; zero-tensor
+substitution ``global_state.h:104-107``).
+
+TPU re-design (SURVEY.md §7 "hard parts" #1): XLA collectives are compiled
+for a fixed mesh, so membership cannot change dynamically inside a step.
+Join therefore becomes a **data-level** construct: every worker always
+participates in the collective, but a worker that has exhausted its data
+contributes zeros and is excluded from the averaging denominator — exactly
+the reference's zero-tensor trick, moved into the graph.  Use
+:func:`masked_average` inside the train step, driven by an ``active`` flag
+from the data loader.  The eager :func:`join` is a barrier that returns the
+last rank to arrive, for epoch-boundary synchronization.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from horovod_tpu import basics
+from horovod_tpu.ops import collectives as C
+
+
+def masked_average(grads, active, *, axis_name=None):
+    """Average ``grads`` over workers where ``active`` is truthy.
+
+    ``active`` is a per-worker 0/1 scalar (traced).  Contributions from
+    inactive workers are zeroed (the reference's ``AllocateZeros``
+    substitution, ``common.h:219``) and the divisor is the live count,
+    clamped to 1 so a fully-joined step is a no-op rather than a NaN."""
+    axes = axis_name
+    if axes is None:
+        axes = basics.axis_name() if basics.is_initialized() else basics.AXIS
+    if isinstance(axes, str):
+        axes = (axes,)
+    a = jnp.asarray(active, jnp.float32)
+    live = lax.psum(a, axes)
+    live = jnp.maximum(live, 1.0)
+
+    def _avg(g):
+        g = g * a.astype(g.dtype)
+        return lax.psum(g, axes) / live.astype(g.dtype)
+
+    return jax.tree_util.tree_map(_avg, grads)
+
+
+def join() -> int:
+    """Block until every process has called ``join``; returns the last
+    joining worker rank (the reference returns the last joined rank so
+    callers can broadcast final state from it)."""
+    basics._ctx()
+    my = np.asarray(float(basics.rank()), np.float32)
+    last = C._eager_allreduce(my, C.Max, None, None)
+    return int(last)

@@ -1,0 +1,2 @@
+"""Benchmark/example model zoo (the reference keeps models in examples/;
+here they double as the flagship benchmark targets)."""

@@ -1,0 +1,16 @@
+from horovod_tpu.ops import collectives, compression, fusion, adasum  # noqa: F401
+from horovod_tpu.ops.collectives import (  # noqa: F401
+    Adasum,
+    Average,
+    Max,
+    Min,
+    Product,
+    Sum,
+    allgather,
+    allreduce,
+    alltoall,
+    broadcast,
+    grouped_allreduce,
+    reducescatter,
+)
+from horovod_tpu.ops.compression import Compression  # noqa: F401

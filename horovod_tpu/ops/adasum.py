@@ -1,0 +1,133 @@
+"""Adasum: adaptive, scale-insensitive gradient reduction.
+
+Reference: ``horovod/common/ops/adasum/adasum.h`` — the pairwise combination
+
+    a' = (1 - a.b / (2 |a|^2)) * a  +  (1 - a.b / (2 |b|^2)) * b
+
+(coefficient math at ``adasum.h:387-397``) applied over a recursive
+distance-doubling hierarchy (``FusedAllreduce``, ``adasum.h:194-338``), and
+the hybrid GPU variant (``ops/adasum_gpu_operations.cc``): reduce-scatter
+within the node, Adasum across nodes, allgather back.
+
+TPU re-design: the recursion is expressed in-graph with ``lax.ppermute``
+partner exchanges over the mesh axis, so XLA schedules the log2(P) rounds on
+ICI directly; the hierarchical variant maps reference LOCAL→``local`` axis
+(plain psum, ICI) and CROSS→``cross`` axis (Adasum rounds, DCN).  Dot
+products accumulate in float32 — the reference does its coefficient math in
+host float64 (``adasum.h:355-372``), unavailable in-graph on TPU; float32 is
+the documented deviation and the tests bound its error.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu import basics
+
+
+def _pairwise(a, b, dot, asq, bsq):
+    """One Adasum combination with the reference's coefficient formula and
+    zero-norm guards (``adasum.h:387-397``)."""
+    one = jnp.ones((), jnp.float32)
+    acoef = jnp.where(asq > 0, one - dot / (2.0 * asq), one)
+    bcoef = jnp.where(bsq > 0, one - dot / (2.0 * bsq), one)
+    return (
+        acoef.astype(a.dtype) * a + bcoef.astype(b.dtype) * b
+    )
+
+
+def _leaf_dots(a, b):
+    a32 = a.astype(jnp.float32).ravel()
+    b32 = b.astype(jnp.float32).ravel()
+    return jnp.vdot(a32, b32), jnp.vdot(a32, a32), jnp.vdot(b32, b32)
+
+
+def adasum_allreduce(tree, *, axis_name=None):
+    """In-graph Adasum allreduce over the worker axis (or hierarchical over
+    ``(cross, local)``: local sum + mean, Adasum across hosts — the
+    ``AdasumGpuAllreduce`` structure)."""
+    axes = axis_name
+    if axes is None:
+        axes = (basics.axis_name() if basics.is_initialized() else basics.AXIS,)
+    if isinstance(axes, str):
+        axes = (axes,)
+    axes = tuple(axes)
+    if len(axes) == 2:
+        cross_ax, local_ax = axes
+        nloc = lax.axis_size(local_ax)
+        tree = jax.tree_util.tree_map(
+            lambda t: lax.psum(t, local_ax) / jnp.asarray(nloc, t.dtype), tree
+        )
+        return _adasum_over_axis(tree, cross_ax)
+    if len(axes) != 1:
+        raise ValueError("adasum_allreduce takes one axis or (cross, local)")
+    return _adasum_over_axis(tree, axes[0])
+
+
+def _adasum_over_axis(tree, ax: str):
+    n = lax.axis_size(ax)
+    if n & (n - 1) != 0:
+        raise ValueError(
+            f"Adasum requires a power-of-two axis size (got {n}); the "
+            "reference has the same restriction (adasum_gpu_operations.cc)"
+        )
+    if n == 1:
+        return tree
+    idx = lax.axis_index(ax)
+    levels = int(np.log2(n))
+    for k in range(levels):
+        stride = 1 << k
+        perm = [(i, i ^ stride) for i in range(n)]
+
+        def _exchange(t):
+            return lax.ppermute(t, ax, perm)
+
+        partner_tree = jax.tree_util.tree_map(_exchange, tree)
+        # Orientation: the lower rank of the pair is "a".
+        is_lower = (idx & stride) == 0
+
+        def _combine(t, p):
+            a = jnp.where(is_lower, t, p)
+            b = jnp.where(is_lower, p, t)
+            dot, asq, bsq = _leaf_dots(a, b)
+            return _pairwise(a, b, dot, asq, bsq)
+
+        tree = jax.tree_util.tree_map(_combine, tree, partner_tree)
+    return tree
+
+
+def adasum_reduce_stack(stacked):
+    """Serial ground-truth: Adasum-reduce a stacked ``(P, ...)`` array with
+    the same pairing order as the distributed recursion.  Used by the eager
+    path and as the oracle in tests (role of the reference's
+    ``test_adasum_*`` closed-form checks)."""
+    x = jnp.asarray(stacked)
+    while x.shape[0] > 1:
+        a = x[0::2]
+        b = x[1::2]
+        a32 = a.astype(jnp.float32).reshape(a.shape[0], -1)
+        b32 = b.astype(jnp.float32).reshape(b.shape[0], -1)
+        dot = jnp.sum(a32 * b32, axis=1)
+        asq = jnp.sum(a32 * a32, axis=1)
+        bsq = jnp.sum(b32 * b32, axis=1)
+        shape = (a.shape[0],) + (1,) * (a.ndim - 1)
+        one = jnp.ones_like(dot)
+        acoef = jnp.where(asq > 0, one - dot / (2 * asq), one).reshape(shape)
+        bcoef = jnp.where(bsq > 0, one - dot / (2 * bsq), one).reshape(shape)
+        x = acoef.astype(a.dtype) * a + bcoef.astype(b.dtype) * b
+    return x[0]
+
+
+def eager_adasum(x: np.ndarray) -> np.ndarray:
+    """Eager (host/process-level) Adasum across processes."""
+    from horovod_tpu.ops import collectives as C
+
+    if basics.cross_size() == 1:
+        return np.asarray(x).copy()
+    stacked = C._replicated_to_host(
+        C._compiled_identity_replicated()(C._to_global(np.asarray(x)))
+    )
+    return np.asarray(adasum_reduce_stack(stacked))

@@ -1,0 +1,172 @@
+"""High-level gradient-averaging API: ``DistributedOptimizer`` and
+``DistributedGradientTape`` equivalents.
+
+Reference: ``horovod/tensorflow/__init__.py:230-531`` (``_make_allreduce_
+grads_fn``, ``_DistributedOptimizer``, ``DistributedGradientTape``) and
+``horovod/torch/__init__.py:61-216`` (per-parameter hook optimizer with
+``backward_passes_per_step`` accumulation).
+
+TPU re-design: the optimizer is an **optax gradient transformation** — the
+allreduce is a pure function inside the compiled train step, so XLA overlaps
+it with the backward pass the way the reference's background thread did
+dynamically, but with a static schedule.  There are no hooks, handles, or
+``synchronize()``: data dependencies express completion.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from horovod_tpu.ops import collectives as C
+from horovod_tpu.ops import fusion as F
+from horovod_tpu.ops.compression import Compression
+
+
+def distributed_gradients(
+    grads,
+    op: str = C.Average,
+    *,
+    axis_name=None,
+    compression=Compression.none,
+    fuse: bool = True,
+    fusion_threshold: Optional[int] = None,
+):
+    """Allreduce a gradient pytree (the reference's
+    ``_make_allreduce_grads_fn``, ``tensorflow/__init__.py:230-251``).
+
+    ``fuse=True`` buckets leaves into large flat collectives
+    (:mod:`horovod_tpu.ops.fusion`); compression casts to 16-bit for the
+    wire and restores dtype after (``tensorflow/compression.py``)."""
+    grads, ctx = compression.compress(grads)
+    if fuse and op in (C.Average, C.Sum):
+        out = F.fused_allreduce_tree(
+            grads, op, axis_name=axis_name, threshold=fusion_threshold
+        )
+    else:
+        out = C.allreduce(grads, op, axis_name=axis_name)
+    return compression.decompress(out, ctx)
+
+
+class _AccumState(NamedTuple):
+    inner: Any
+    acc: Any
+    counter: jnp.ndarray
+
+
+def DistributedOptimizer(
+    optimizer: optax.GradientTransformation,
+    *,
+    op: str = C.Average,
+    compression=Compression.none,
+    backward_passes_per_step: int = 1,
+    average_aggregated_gradients: bool = True,
+    axis_name=None,
+    fuse: bool = True,
+    fusion_threshold: Optional[int] = None,
+) -> optax.GradientTransformation:
+    """Wrap an optax optimizer so updates are computed from
+    cross-worker-reduced gradients.
+
+    Reference semantics matched:
+
+    * ``op=Average|Sum|Adasum`` (``tensorflow/__init__.py:410-471``).
+    * ``backward_passes_per_step`` accumulates gradients locally and only
+      allreduces (and steps) every k-th call; non-boundary calls return zero
+      updates (``torch/__init__.py:95-157``).
+    * ``average_aggregated_gradients`` divides the accumulated sum by k
+      before reduction (``tensorflow/__init__.py:328-365``).
+    """
+    if backward_passes_per_step < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+
+    def _reduce(grads):
+        return distributed_gradients(
+            grads,
+            op,
+            axis_name=axis_name,
+            compression=compression,
+            fuse=fuse,
+            fusion_threshold=fusion_threshold,
+        )
+
+    if backward_passes_per_step == 1:
+
+        def init_fn(params):
+            return optimizer.init(params)
+
+        def update_fn(grads, state, params=None, **extra):
+            return optimizer.update(_reduce(grads), state, params, **extra)
+
+        return optax.GradientTransformation(init_fn, update_fn)
+
+    k = backward_passes_per_step
+
+    def init_fn(params):
+        return _AccumState(
+            inner=optimizer.init(params),
+            acc=jax.tree_util.tree_map(jnp.zeros_like, params),
+            counter=jnp.zeros((), jnp.int32),
+        )
+
+    def update_fn(grads, state, params=None, **extra):
+        acc = jax.tree_util.tree_map(lambda a, g: a + g, state.acc, grads)
+        count = state.counter + 1
+        boundary = count >= k
+
+        def do_step(operands):
+            acc, inner, params = operands
+            scale = 1.0 / k if average_aggregated_gradients else 1.0
+            scaled = jax.tree_util.tree_map(
+                lambda a: a * jnp.asarray(scale, a.dtype), acc
+            )
+            reduced = _reduce(scaled)
+            updates, inner2 = optimizer.update(reduced, inner, params, **extra)
+            zeroed = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return updates, inner2, zeroed
+
+        def skip_step(operands):
+            acc, inner, _params = operands
+            updates = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return updates, inner, acc
+
+        updates, inner, acc = jax.lax.cond(
+            boundary, do_step, skip_step, (acc, state.inner, params)
+        )
+        counter = jnp.where(boundary, 0, count)
+        return updates, _AccumState(inner=inner, acc=acc, counter=counter)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def DistributedGradientTape(
+    fun,
+    *,
+    op: str = C.Average,
+    compression=Compression.none,
+    axis_name=None,
+    has_aux: bool = False,
+    fuse: bool = True,
+):
+    """Return ``value_and_grad(fun)`` whose gradients are allreduced.
+
+    JAX analogue of ``hvd.DistributedGradientTape``
+    (``tensorflow/__init__.py:474-531``): TF tapes record eagerly, JAX
+    differentiates functionally, so the "tape" is a transformed
+    ``value_and_grad``.
+
+        loss, grads = hvd.DistributedGradientTape(loss_fn)(params, batch)
+    """
+    vg = jax.value_and_grad(fun, has_aux=has_aux)
+
+    def wrapped(*args, **kwargs):
+        val, grads = vg(*args, **kwargs)
+        grads = distributed_gradients(
+            grads, op, axis_name=axis_name, compression=compression, fuse=fuse
+        )
+        return val, grads
+
+    return wrapped

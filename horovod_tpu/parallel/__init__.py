@@ -1,0 +1,9 @@
+"""TPU-idiomatic parallelism extensions beyond the reference's data
+parallelism (SURVEY.md §2.6: TP/PP/SP/EP are extensions, not ports).
+
+- :mod:`horovod_tpu.parallel.meshes` — multi-axis mesh construction
+- :mod:`horovod_tpu.parallel.ring_attention` — sequence parallelism
+- :mod:`horovod_tpu.parallel.pipeline` — pipeline parallelism
+"""
+
+from horovod_tpu.parallel.meshes import MeshSpec, make_mesh  # noqa: F401

@@ -1,0 +1,83 @@
+"""Multi-axis mesh construction for dp/fsdp/tp/pp/sp/ep parallelism.
+
+The reference's GLOBAL/LOCAL/CROSS communicator triple generalizes on TPU
+to an N-D logical mesh laid onto the physical ICI torus.  Convention:
+axes that carry the heaviest traffic (tp, sp) go innermost so they map to
+ICI neighbors; dp/pp outermost so their lighter collectives can ride DCN
+across slices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+# Canonical axis order, outermost → innermost (DCN-tolerant → ICI-hungry).
+AXIS_ORDER = ("dp", "pp", "ep", "fsdp", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape; unspecified axes default to 1."""
+
+    dp: int = 1
+    pp: int = 1
+    ep: int = 1
+    fsdp: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(getattr(self, a) for a in AXIS_ORDER)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape))
+
+
+def make_mesh(
+    spec: MeshSpec, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build a Mesh with axes (dp, pp, ep, fsdp, sp, tp).
+
+    Uses ``mesh_utils.create_device_mesh`` when available so the logical
+    mesh is laid out along the physical ICI torus (nearest-neighbor tp/sp).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if spec.size != n:
+        raise ValueError(f"MeshSpec size {spec.size} != device count {n}")
+    try:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh(spec.shape, devices=list(devices))
+    except Exception:
+        arr = np.array(
+            sorted(devices, key=lambda d: (d.process_index, d.id)), dtype=object
+        ).reshape(spec.shape)
+    return Mesh(arr, axis_names=AXIS_ORDER)
+
+
+def infer_spec(
+    n_devices: int,
+    *,
+    tp: Optional[int] = None,
+    sp: Optional[int] = None,
+    pp: int = 1,
+    ep: int = 1,
+    fsdp: int = 1,
+) -> MeshSpec:
+    """Fill dp with whatever remains after the model axes are chosen."""
+    tp = tp or 1
+    sp = sp or 1
+    model = tp * sp * pp * ep * fsdp
+    if n_devices % model != 0:
+        raise ValueError(f"{n_devices} devices not divisible by model axes {model}")
+    return MeshSpec(dp=n_devices // model, pp=pp, ep=ep, fsdp=fsdp, sp=sp, tp=tp)

@@ -1,0 +1,120 @@
+"""SPMD step construction: the compiled replacement for the reference's
+entire runtime hot path.
+
+Where the reference enqueues each gradient to a background thread that
+negotiates, fuses and launches NCCL (call stack SURVEY.md §3.2), here the
+whole train step — forward, backward, allreduce, optimizer — is ONE jitted
+SPMD program over the horovod mesh.  XLA overlaps the gradient collectives
+with remaining backward computation (latency hiding, same effect as the
+reference's async background thread) and schedules them on ICI.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu import basics
+
+try:  # jax >= 0.8 stable API
+    _shard_map = jax.shard_map
+    _SHARD_MAP_KW = True
+except AttributeError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_KW = False
+
+
+def shard(fn, *, in_specs, out_specs, mesh=None, check_replication: bool = False):
+    """``shard_map`` over the horovod mesh with version-portable kwargs."""
+    mesh = mesh or basics.mesh()
+    if _SHARD_MAP_KW:
+        return _shard_map(
+            fn,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_vma=check_replication,
+        )
+    return _shard_map(  # pragma: no cover - older jax
+        fn, mesh, in_specs=in_specs, out_specs=out_specs, check_rep=check_replication
+    )
+
+
+def run(fn, *args, in_specs, out_specs, mesh=None):
+    """Run ``fn`` once under shard_map (eagerly jitted)."""
+    return jax.jit(shard(fn, in_specs=in_specs, out_specs=out_specs, mesh=mesh))(
+        *args
+    )
+
+
+def make_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    *,
+    mesh=None,
+    axis: Optional[str] = None,
+    donate: bool = True,
+    has_aux: bool = False,
+):
+    """Build the canonical data-parallel train step.
+
+    ``loss_fn(params, batch) -> loss`` (or ``(loss, aux)`` with
+    ``has_aux``); ``optimizer`` is typically
+    ``hvd.DistributedOptimizer(optax...)`` so the gradient allreduce is
+    inside.  Batch arrays are sharded on dim 0 over the worker axis; params
+    and optimizer state are replicated.  Returns
+    ``step(params, opt_state, batch) -> (params, opt_state, loss[, aux])``.
+
+    This is the compiled equivalent of the reference's
+    ``DistributedGradientTape`` + ``apply_gradients`` hot path
+    (SURVEY.md §3.2) with negotiation/fusion/cache made unnecessary by
+    SPMD compilation.
+    """
+    mesh = mesh or basics.mesh()
+    axis = axis or basics.axis_name()
+
+    def _step(params, opt_state, batch):
+        vg = jax.value_and_grad(loss_fn, has_aux=has_aux)
+        val, grads = vg(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        if has_aux:
+            loss, aux = val
+        else:
+            loss = val
+        loss = lax.pmean(loss, axis)
+        if has_aux:
+            return params, opt_state, loss, aux
+        return params, opt_state, loss
+
+    batch_spec = P(axis)
+    sharded = shard(
+        _step,
+        in_specs=(P(), P(), batch_spec),
+        out_specs=(P(), P(), P()) + ((batch_spec,) if has_aux else ()),
+        mesh=mesh,
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(sharded, donate_argnums=donate_argnums)
+
+
+def init_replicated(params, mesh=None):
+    """Place a pytree replicated across the mesh (host → devices)."""
+    mesh = mesh or basics.mesh()
+    sharding = jax.sharding.NamedSharding(mesh, P())
+    return jax.device_put(params, sharding)
+
+
+def shard_batch(batch, mesh=None, axis: Optional[str] = None):
+    """Place host batch arrays sharded on dim 0 over the worker axis."""
+    mesh = mesh or basics.mesh()
+    axis = axis or basics.axis_name()
+    sharding = jax.sharding.NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(lambda b: jax.device_put(b, sharding), batch)

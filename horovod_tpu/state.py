@@ -1,0 +1,94 @@
+"""State-consistency primitives: broadcast of parameters, optimizer state,
+and arbitrary Python objects.
+
+Reference: ``horovod/torch/__init__.py`` ``broadcast_parameters`` /
+``broadcast_optimizer_state`` / ``broadcast_object`` (~410-640),
+``tensorflow/__init__.py:139-175`` ``broadcast_variables``.  These are the
+checkpoint/resume consistency layer (SURVEY.md §5.4): rank 0 restores, then
+broadcasts, so every worker starts identical.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+from horovod_tpu import basics
+from horovod_tpu.ops import collectives as C
+
+
+def broadcast_parameters(params, root_rank: int = 0):
+    """Broadcast a parameter pytree from ``root_rank``
+    (``torch/__init__.py`` ``broadcast_parameters``).  Works eagerly (host
+    arrays) and in-graph (under shard_map)."""
+    return C.broadcast(params, root_rank)
+
+
+def broadcast_optimizer_state(opt_state, root_rank: int = 0):
+    """Broadcast optimizer state.  Array leaves are broadcast as tensors;
+    non-array leaves (step counters, hyperparams, schedules state) are
+    pickled and broadcast as bytes — the same split the reference makes
+    (``torch/__init__.py`` ``broadcast_optimizer_state``: tensor state via
+    broadcast, scalar state via cloudpickled ``broadcast_object``)."""
+    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+    out = []
+    for leaf in leaves:
+        if isinstance(leaf, (jax.Array, np.ndarray)) or np.isscalar(leaf):
+            arr = np.asarray(leaf)
+            if arr.dtype == object:
+                out.append(broadcast_object(leaf, root_rank))
+            else:
+                b = C.broadcast(arr, root_rank)
+                out.append(np.asarray(b, dtype=arr.dtype).reshape(arr.shape))
+        else:
+            out.append(broadcast_object(leaf, root_rank))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0, name: str = None) -> Any:
+    """Pickle-broadcast an arbitrary object from ``root_rank``
+    (``torch/__init__.py`` ``broadcast_object``; reference uses cloudpickle
+    over a byte tensor).  Two phases: broadcast the length, then the
+    payload."""
+    basics._ctx()
+    if basics.cross_size() == 1:
+        return obj
+    me_is_root = basics.rank() <= root_rank < basics.rank() + basics.local_size()
+    if me_is_root:
+        buf = io.BytesIO()
+        pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+        payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+    else:
+        payload = np.zeros((0,), np.uint8)
+    length = C.broadcast(np.asarray(payload.size, np.int64), root_rank)
+    n = int(length)
+    send = np.zeros((n,), np.uint8)
+    if me_is_root:
+        send[:] = payload
+    data = np.asarray(C.broadcast(send, root_rank), np.uint8)
+    return pickle.loads(data.tobytes())
+
+
+def allgather_object(obj: Any, name: str = None) -> list:
+    """Gather one object per process into a list on every process
+    (Horovod's ``allgather_object``)."""
+    basics._ctx()
+    if basics.cross_size() == 1:
+        return [obj]
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+    lengths = np.asarray(
+        C.allgather(np.asarray([payload.size], np.int64)), np.int64
+    )
+    data = np.asarray(C.allgather(payload), np.uint8)
+    out = []
+    off = 0
+    for n in lengths:
+        out.append(pickle.loads(data[off : off + int(n)].tobytes()))
+        off += int(n)
+    return out

@@ -1,0 +1,159 @@
+"""Chrome-tracing timeline, host-side spans.
+
+Reference: ``horovod/common/timeline.{h,cc}`` — per-tensor lifecycle events
+written as Chrome trace JSON by a dedicated writer thread fed from a
+lock-free queue (SURVEY.md §5.1).
+
+TPU re-design: inside a compiled step there is no negotiation to trace (the
+schedule is static) — device-side detail comes from the XLA/TPU profiler
+(``jax.profiler.trace``), which :func:`Timeline.profile` wraps.  What this
+module traces is the host side the profiler can't see: eager collectives,
+step boundaries, data loading, checkpointing.  Events flow through a
+plain queue to a writer thread so the hot path never touches file IO —
+the same decoupling as the reference's SPSC queue (``timeline.h:68-70``).
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+import jax
+
+
+class Timeline:
+    def __init__(self, path: str, *, pid: Optional[int] = None) -> None:
+        self.path = path
+        self.pid = pid if pid is not None else os.getpid()
+        self._q: "queue.Queue" = queue.Queue(maxsize=1 << 20)
+        self._file = open(path, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._closed = False
+        self._writer = threading.Thread(target=self._drain, daemon=True)
+        self._writer.start()
+        atexit.register(self.close)
+
+    # -- event emission (microsecond timestamps, Chrome trace format) -------
+
+    def _emit(self, ev: dict) -> None:
+        if self._closed:
+            return
+        try:
+            self._q.put_nowait(ev)
+        except queue.Full:  # drop rather than stall the hot path
+            pass
+
+    def begin(self, name: str, category: str = "host", tid: int = 0) -> None:
+        self._emit(
+            {
+                "name": name,
+                "cat": category,
+                "ph": "B",
+                "ts": time.monotonic_ns() / 1e3,
+                "pid": self.pid,
+                "tid": tid,
+            }
+        )
+
+    def end(self, name: str, tid: int = 0) -> None:
+        self._emit(
+            {
+                "name": name,
+                "ph": "E",
+                "ts": time.monotonic_ns() / 1e3,
+                "pid": self.pid,
+                "tid": tid,
+            }
+        )
+
+    def instant(self, name: str, args: Optional[dict] = None) -> None:
+        self._emit(
+            {
+                "name": name,
+                "ph": "i",
+                "s": "p",
+                "ts": time.monotonic_ns() / 1e3,
+                "pid": self.pid,
+                "tid": 0,
+                "args": args or {},
+            }
+        )
+
+    def mark_cycle(self) -> None:
+        """Cycle marker (``HOROVOD_TIMELINE_MARK_CYCLES``,
+        ``operations.cc:392-405``) — on TPU, one per train step."""
+        self.instant("CYCLE")
+
+    @contextlib.contextmanager
+    def activity(self, name: str, category: str = "host", tid: int = 0):
+        """Span context manager (the reference's ActivityStart/End pairs,
+        ``common.h:31-59``)."""
+        self.begin(name, category, tid)
+        try:
+            yield
+        finally:
+            self.end(name, tid)
+
+    @contextlib.contextmanager
+    def profile(self, logdir: str):
+        """Bracket a region with the XLA/TPU profiler — the device-side
+        complement of the host timeline."""
+        with jax.profiler.trace(logdir):
+            yield
+
+    # -- writer thread -------------------------------------------------------
+
+    def _drain(self) -> None:
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                return
+            if not self._first:
+                self._file.write(",\n")
+            self._first = False
+            json.dump(ev, self._file)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._writer.join(timeout=5)
+        self._file.write("\n]\n")
+        self._file.close()
+
+
+_timeline: Optional[Timeline] = None
+
+
+def start_timeline(path: str, mark_cycles: bool = False) -> Timeline:
+    """``hvd.start_timeline`` parity (``common/basics.py``)."""
+    global _timeline
+    if _timeline is not None:
+        raise ValueError("timeline already started")
+    _timeline = Timeline(path)
+    return _timeline
+
+
+def stop_timeline() -> None:
+    global _timeline
+    if _timeline is not None:
+        _timeline.close()
+        _timeline = None
+
+
+def get() -> Optional[Timeline]:
+    from horovod_tpu import basics
+
+    if _timeline is not None:
+        return _timeline
+    if basics.is_initialized():
+        return basics._ctx().timeline
+    return None

@@ -1,0 +1,32 @@
+"""Test fixture: 8 virtual CPU devices standing in for an 8-chip TPU slice.
+
+The reference runs every test body under a 2-process mpirun/horovodrun
+launcher (SURVEY.md §4).  Here the same multi-worker coverage comes from 8
+virtual CPU devices — single process, real XLA collectives through the same
+shard_map code paths that run on ICI.  Multi-process behavior is covered
+separately by the launcher tests, which spawn real processes.
+
+Note: this sandbox's sitecustomize imports jax at interpreter startup with
+the TPU platform selected, so env vars (XLA_FLAGS/JAX_PLATFORMS) are too
+late — we must use jax.config.update before any backend is touched.
+"""
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _hvd():
+    import horovod_tpu as hvd
+
+    hvd.init()
+    yield hvd
+
+
+@pytest.fixture()
+def hvd(_hvd):
+    return _hvd

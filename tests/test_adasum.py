@@ -1,0 +1,124 @@
+"""Adasum correctness: distributed (ppermute recursion) vs the closed-form
+pairwise formula (reference: test/test_adasum_tensorflow.py and
+test_adasum_pytorch.py check against the same math)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import spmd
+from horovod_tpu.ops import adasum
+
+N = 8
+
+
+def _pairwise_np(a, b):
+    dot = float(np.vdot(a.astype(np.float64), b.astype(np.float64)))
+    asq = float(np.vdot(a.astype(np.float64), a.astype(np.float64)))
+    bsq = float(np.vdot(b.astype(np.float64), b.astype(np.float64)))
+    ac = 1.0 - dot / (2 * asq) if asq > 0 else 1.0
+    bc = 1.0 - dot / (2 * bsq) if bsq > 0 else 1.0
+    return ac * a + bc * b
+
+
+def _reference_reduce(stack):
+    x = [s for s in stack]
+    while len(x) > 1:
+        x = [_pairwise_np(x[i], x[i + 1]) for i in range(0, len(x), 2)]
+    return x[0]
+
+
+def _run_distributed(x):
+    def inner(t):
+        return hvd.allreduce(t[0], hvd.Adasum)[None]
+
+    return np.asarray(
+        jax.jit(
+            spmd.shard(inner, in_specs=(P(hvd.AXIS),), out_specs=P(hvd.AXIS))
+        )(x)
+    )
+
+
+class TestAdasumMath:
+    def test_two_orthogonal(self):
+        """Orthogonal gradients add (dot=0 → coefficients 1)."""
+        a = np.array([1.0, 0.0], np.float32)
+        b = np.array([0.0, 1.0], np.float32)
+        out = _pairwise_np(a, b)
+        np.testing.assert_allclose(out, [1.0, 1.0])
+
+    def test_two_identical(self):
+        """Identical gradients average (coefficients 1/2)."""
+        a = np.array([2.0, 4.0], np.float32)
+        out = _pairwise_np(a, a.copy())
+        np.testing.assert_allclose(out, a)
+
+    def test_stack_oracle_matches_serial(self):
+        rng = np.random.RandomState(0)
+        stack = rng.randn(4, 16).astype(np.float32)
+        got = np.asarray(adasum.adasum_reduce_stack(stack))
+        expect = _reference_reduce(stack)
+        np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+class TestAdasumDistributed:
+    def test_matches_oracle(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(N, 32).astype(np.float32)
+        out = _run_distributed(x)
+        expect = _reference_reduce(x)
+        for i in range(N):
+            np.testing.assert_allclose(out[i], expect, rtol=1e-3, atol=1e-4)
+
+    def test_identical_grads_idempotent(self):
+        g = np.random.RandomState(2).randn(16).astype(np.float32)
+        x = np.tile(g, (N, 1))
+        out = _run_distributed(x)
+        np.testing.assert_allclose(out[0], g, rtol=1e-4, atol=1e-5)
+
+    def test_zero_grads(self):
+        x = np.zeros((N, 8), np.float32)
+        out = _run_distributed(x)
+        np.testing.assert_allclose(out, np.zeros((N, 8)))
+
+    def test_scale_insensitivity(self):
+        """Adasum of {g, g} is g regardless of |g| — the property that
+        motivates the algorithm (adasum.h header comment)."""
+        g = np.random.RandomState(3).randn(8).astype(np.float32)
+        for scale in (1e-3, 1.0, 1e3):
+            x = np.tile(g * scale, (N, 1))
+            out = _run_distributed(x)
+            np.testing.assert_allclose(out[0], g * scale, rtol=1e-3)
+
+    def test_hierarchical(self):
+        """(cross, local): local mean then Adasum across hosts
+        (AdasumGpuAllreduce structure)."""
+        hm = hvd.hierarchical_mesh()
+        rng = np.random.RandomState(4)
+        x = rng.randn(*hm.devices.shape, 16).astype(np.float32)
+
+        def inner(t):
+            return hvd.allreduce(
+                t[0, 0], hvd.Adasum, axis_name=("cross", "local")
+            )[None, None]
+
+        out = np.asarray(
+            jax.jit(
+                spmd.shard(
+                    inner,
+                    in_specs=(P("cross", "local"),),
+                    out_specs=P("cross", "local"),
+                    mesh=hm,
+                )
+            )(x)
+        )
+        locals_mean = x.mean(axis=1)  # (cross, 16)
+        expect = _reference_reduce(locals_mean)
+        np.testing.assert_allclose(out[0, 0], expect, rtol=1e-3, atol=1e-4)
+
+    def test_eager_single_process_identity(self):
+        x = np.random.randn(8).astype(np.float32)
+        out = hvd.allreduce(x, hvd.Adasum)
+        np.testing.assert_allclose(out, x)

@@ -1,0 +1,73 @@
+"""Topology/init tests (reference: test_horovod_rank / test_horovod_size
+in test/test_tensorflow.py:68-99 region and test_torch.py)."""
+
+import jax
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import basics
+
+
+def test_init_idempotent():
+    assert hvd.is_initialized()
+    hvd.init()  # second call is a no-op
+    assert hvd.is_initialized()
+
+
+def test_size_is_device_count():
+    assert hvd.size() == jax.device_count() == 8
+
+
+def test_local_and_cross():
+    assert hvd.local_size() == jax.local_device_count()
+    assert hvd.cross_size() == jax.process_count() == 1
+    assert hvd.cross_rank() == 0
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+
+
+def test_homogeneous_and_hier_mesh():
+    assert hvd.is_homogeneous()
+    hm = hvd.hierarchical_mesh()
+    assert hm is not None
+    assert hm.axis_names == (basics.CROSS_AXIS, basics.LOCAL_AXIS)
+    assert hm.devices.size == 8
+
+
+def test_mesh_axis():
+    m = hvd.mesh()
+    assert m.axis_names == (hvd.AXIS,)
+    assert m.devices.size == 8
+
+
+def test_build_flags():
+    assert hvd.xla_built()
+    assert not hvd.mpi_built()
+    assert not hvd.nccl_built()
+    assert not hvd.gloo_built()
+    assert not hvd.mpi_threads_supported()
+
+
+def test_worker_index_in_graph():
+    from jax.sharding import PartitionSpec as P
+    from horovod_tpu import spmd
+
+    out = spmd.run(
+        lambda: hvd.worker_index()[None],
+        in_specs=(),
+        out_specs=P(hvd.AXIS),
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.arange(8))
+
+
+def test_not_initialized_error():
+    # A fresh import-level call path raises before init; simulate by
+    # temporarily clearing the context.
+    ctx = basics._context
+    basics._context = None
+    try:
+        with pytest.raises(basics.NotInitializedError):
+            hvd.size()
+    finally:
+        basics._context = ctx

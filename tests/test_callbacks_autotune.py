@@ -1,0 +1,188 @@
+"""Callback, schedule, timeline, and autotune tests (reference:
+test_keras.py callbacks, test_timeline.py, autotune coverage via
+parameter_manager)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import autotune, callbacks, timeline
+
+
+class _Model:
+    params = {"w": np.ones(2, np.float32)}
+    opt_state = None
+    lr = 0.0
+
+
+class TestCallbacks:
+    def test_metric_average_single_process(self):
+        cb = callbacks.MetricAverageCallback()
+        logs = {"loss": 2.0, "acc": 0.5, "name": "x"}
+        cb.on_epoch_end(0, logs)
+        assert logs["loss"] == pytest.approx(2.0)
+        assert logs["acc"] == pytest.approx(0.5)
+        assert logs["name"] == "x"
+
+    def test_broadcast_global_variables(self):
+        cb = callbacks.BroadcastGlobalVariablesCallback(0)
+        m = _Model()
+        cb.set_model(m)
+        cb.on_train_begin()
+        np.testing.assert_allclose(np.asarray(m.params["w"]), [1, 1])
+
+    def test_lr_schedule_staircase(self):
+        cb = callbacks.LearningRateScheduleCallback(
+            multiplier=lambda e: 0.1**e, initial_lr=1.0
+        )
+        m = _Model()
+        cb.set_model(m)
+        cb.on_epoch_begin(0)
+        assert m.lr == pytest.approx(1.0)
+        cb.on_epoch_begin(2)
+        assert m.lr == pytest.approx(0.01)
+
+    def test_lr_schedule_range(self):
+        cb = callbacks.LearningRateScheduleCallback(
+            multiplier=0.5, start_epoch=2, end_epoch=4, initial_lr=1.0
+        )
+        m = _Model()
+        m.lr = -1.0
+        cb.set_model(m)
+        cb.on_epoch_begin(0)
+        assert m.lr == -1.0  # outside range: untouched
+        cb.on_epoch_begin(3)
+        assert m.lr == pytest.approx(0.5)
+
+    def test_warmup_progression(self):
+        spe = 10
+        cb = callbacks.LearningRateWarmupCallback(
+            warmup_epochs=2, steps_per_epoch=spe, initial_lr=1.0
+        )
+        m = _Model()
+        cb.set_model(m)
+        cb.on_epoch_begin(0)
+        cb.on_batch_begin(0)
+        first = m.lr
+        cb.current_epoch = 1
+        cb.on_batch_begin(9)
+        last = m.lr
+        assert first == pytest.approx(1.0 / hvd.size())
+        assert last > first
+        assert last <= 1.0 + 1e-6
+
+    def test_warmup_requires_steps_per_epoch(self):
+        cb = callbacks.LearningRateWarmupCallback(warmup_epochs=1, initial_lr=1.0)
+        cb.set_model(_Model())
+        cb.on_epoch_begin(0)
+        with pytest.raises(ValueError, match="steps_per_epoch"):
+            cb.on_batch_begin(0)
+
+
+class TestSchedules:
+    def test_warmup_schedule(self):
+        sched = callbacks.warmup_schedule(0.1, warmup_steps=10, size=8)
+        assert float(sched(0)) == pytest.approx(0.1)
+        assert float(sched(10)) == pytest.approx(0.8)
+        assert float(sched(100)) == pytest.approx(0.8)
+
+    def test_multiplier_schedule(self):
+        sched = callbacks.multiplier_schedule(1.0, [(10, 0.1), (20, 0.01)])
+        assert float(sched(0)) == pytest.approx(1.0)
+        assert float(sched(15)) == pytest.approx(0.1)
+        assert float(sched(25)) == pytest.approx(0.01)
+
+
+class TestTimeline:
+    def test_events_written(self, tmp_path):
+        path = str(tmp_path / "tl.json")
+        tl = timeline.Timeline(path)
+        with tl.activity("ALLREDUCE", "collective"):
+            pass
+        tl.instant("NEGOTIATE_ALLREDUCE")
+        tl.mark_cycle()
+        tl.close()
+        with open(path) as f:
+            events = json.load(f)
+        names = [e["name"] for e in events]
+        assert "ALLREDUCE" in names
+        assert "NEGOTIATE_ALLREDUCE" in names
+        assert "CYCLE" in names
+        phases = {e["ph"] for e in events}
+        assert {"B", "E", "i"} <= phases
+
+    def test_start_stop_api(self, tmp_path):
+        path = str(tmp_path / "tl2.json")
+        tl = timeline.start_timeline(path)
+        assert timeline.get() is tl
+        with pytest.raises(ValueError):
+            timeline.start_timeline(path)
+        timeline.stop_timeline()
+        assert timeline.get() is None
+
+
+class TestGaussianProcess:
+    def test_gp_fits_smooth_function(self):
+        gp = autotune.GaussianProcessRegressor(length_scale=0.2)
+        x = np.linspace(0, 1, 12)[:, None]
+        y = np.sin(4 * x).ravel()
+        gp.fit(x, y)
+        mu, sigma = gp.predict(x)
+        np.testing.assert_allclose(mu, y, atol=1e-2)
+        assert np.all(sigma < 0.1)
+
+    def test_gp_uncertainty_grows_off_data(self):
+        gp = autotune.GaussianProcessRegressor(length_scale=0.1)
+        gp.fit(np.array([[0.0], [0.1]]), np.array([1.0, 1.1]))
+        _, s_near = gp.predict(np.array([[0.05]]))
+        _, s_far = gp.predict(np.array([[0.9]]))
+        assert s_far > s_near
+
+
+class TestBayesianOptimization:
+    def test_finds_maximum(self):
+        bo = autotune.BayesianOptimization(bounds=[(0.0, 7.0)], seed=1)
+        f = lambda k: -((k - 4.2) ** 2)  # max at 4.2
+        for _ in range(20):
+            x = bo.suggest()
+            bo.register(x, f(float(x[0])))
+        best = bo.xs[int(np.argmax(bo.ys))]
+        best_knob = bo._denormalize(best)[0]
+        assert abs(best_knob - 4.2) < 1.0
+
+
+class TestAutotuner:
+    def test_converges_and_freezes(self, tmp_path):
+        log = str(tmp_path / "autotune.csv")
+        at = autotune.Autotuner(warmup_samples=1, steps_per_sample=2, log_path=log)
+        # Synthetic world: throughput peaks at 16MB threshold (knob=4)
+        def world(threshold):
+            knob = np.log2(threshold / (1024 * 1024))
+            return 1e9 * np.exp(-((knob - 4.0) ** 2) / 2)
+
+        for _ in range(100):
+            if not at.active:
+                break
+            thr = at.fusion_threshold
+            score = world(thr)
+            # record() wants bytes and seconds; steps_per_sample=2
+            at.record(score, 1.0)
+            at.record(score, 1.0)
+        assert not at.active
+        final_knob = np.log2(at.fusion_threshold / (1024 * 1024))
+        assert abs(final_knob - 4.0) < 2.0
+        with open(log) as f:
+            assert len(f.readlines()) > 3
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "5")
+        at = autotune.Autotuner.from_env()
+        assert at.warmup_samples == 5
+
+    def test_synchronize(self):
+        at = autotune.Autotuner()
+        at.synchronize()  # single process: no-op
+        assert at.fusion_threshold == 64 * 1024 * 1024

@@ -1,0 +1,243 @@
+"""Collective-op tests, in-graph (shard_map over the 8-device mesh) and
+eager.  Mirrors the reference's framework op tests
+(test/test_tensorflow.py allreduce cpu/fused/average, allgather,
+broadcast; test/test_torch.py async/handle tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import spmd
+
+N = 8
+
+
+def _per_worker(shape, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(N, *shape).astype(dtype)
+
+
+def run_per_worker(fn, *arrays, out_spec=P(hvd.AXIS)):
+    """Run fn under shard_map; each worker sees arrays[i] (dim 0 stripped
+    by giving each worker a leading slice of size 1)."""
+
+    def inner(*xs):
+        return fn(*[x[0] for x in xs])
+
+    wrapped = spmd.shard(
+        inner,
+        in_specs=tuple(P(hvd.AXIS) for _ in arrays),
+        out_specs=out_spec,
+    )
+    return jax.jit(wrapped)(*arrays)
+
+
+class TestInGraphAllreduce:
+    def test_sum(self):
+        x = _per_worker((4, 5))
+        out = run_per_worker(lambda t: hvd.allreduce(t, hvd.Sum)[None], x)
+        expect = x.sum(axis=0)
+        for i in range(N):
+            np.testing.assert_allclose(np.asarray(out[i]), expect, rtol=1e-5)
+
+    def test_average(self):
+        x = _per_worker((3, 7))
+        out = run_per_worker(lambda t: hvd.allreduce(t, hvd.Average)[None], x)
+        expect = x.mean(axis=0)
+        np.testing.assert_allclose(np.asarray(out[0]), expect, rtol=1e-5)
+
+    def test_min_max(self):
+        x = _per_worker((6,))
+        mn = run_per_worker(lambda t: hvd.allreduce(t, hvd.Min)[None], x)
+        mx = run_per_worker(lambda t: hvd.allreduce(t, hvd.Max)[None], x)
+        np.testing.assert_allclose(np.asarray(mn[0]), x.min(axis=0), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(mx[0]), x.max(axis=0), rtol=1e-6)
+
+    def test_product(self):
+        x = np.abs(_per_worker((4,))) + 0.5
+        out = run_per_worker(lambda t: hvd.allreduce(t, hvd.Product)[None], x)
+        np.testing.assert_allclose(np.asarray(out[0]), x.prod(axis=0), rtol=1e-4)
+
+    def test_prescale_postscale(self):
+        x = _per_worker((4,))
+        out = run_per_worker(
+            lambda t: hvd.allreduce(
+                t, hvd.Sum, prescale_factor=2.0, postscale_factor=0.5
+            )[None],
+            x,
+        )
+        np.testing.assert_allclose(np.asarray(out[0]), x.sum(axis=0), rtol=1e-5)
+
+    def test_pytree(self):
+        a = _per_worker((2,))
+        b = _per_worker((3,), seed=1)
+        out = run_per_worker(
+            lambda u, v: jax.tree_util.tree_map(
+                lambda t: t[None], hvd.allreduce({"a": u, "b": v}, hvd.Sum)
+            ),
+            a,
+            b,
+            out_spec={"a": P(hvd.AXIS), "b": P(hvd.AXIS)},
+        )
+        np.testing.assert_allclose(np.asarray(out["a"][0]), a.sum(0), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out["b"][0]), b.sum(0), rtol=1e-5)
+
+    def test_hierarchical_axes(self):
+        """Two-axis allreduce over the (cross, local) mesh — the
+        hierarchical path (NCCLHierarchicalAllreduce analogue)."""
+        hm = hvd.hierarchical_mesh()
+        x = _per_worker((4,)).reshape(hm.devices.shape + (4,))
+
+        def inner(t):
+            return hvd.allreduce(
+                t[0, 0], hvd.Sum, axis_name=("cross", "local")
+            )[None, None]
+
+        out = jax.jit(
+            spmd.shard(
+                inner,
+                in_specs=(P("cross", "local"),),
+                out_specs=P("cross", "local"),
+                mesh=hm,
+            )
+        )(x)
+        np.testing.assert_allclose(
+            np.asarray(out)[0, 0], x.sum(axis=(0, 1)), rtol=1e-5
+        )
+
+    def test_unbound_axis_raises(self):
+        with pytest.raises(RuntimeError, match="worker axis"):
+            jax.jit(lambda t: hvd.allreduce(t, hvd.Sum))(jnp.ones((3,)))
+
+
+class TestInGraphOthers:
+    def test_allgather(self):
+        x = _per_worker((2, 3))
+        out = run_per_worker(
+            lambda t: hvd.allgather(t)[None], x, out_spec=P(hvd.AXIS)
+        )
+        expect = x.reshape(N * 2, 3)
+        np.testing.assert_allclose(np.asarray(out[0]), expect, rtol=1e-6)
+
+    def test_broadcast(self):
+        x = _per_worker((4,))
+        for root in (0, 3, 7):
+            out = run_per_worker(
+                lambda t: hvd.broadcast(t, root_rank=root)[None], x
+            )
+            for i in range(N):
+                np.testing.assert_allclose(
+                    np.asarray(out[i]), x[root], rtol=1e-6
+                )
+
+    def test_alltoall(self):
+        x = _per_worker((N, 2))
+        out = run_per_worker(lambda t: hvd.alltoall(t)[None], x)
+        # worker i receives row i from every worker
+        for i in range(N):
+            expect = x[:, i, :]
+            np.testing.assert_allclose(np.asarray(out[i]), expect, rtol=1e-6)
+
+    def test_reducescatter(self):
+        x = _per_worker((N * 2, 3))
+        out = run_per_worker(lambda t: hvd.reducescatter(t, hvd.Sum)[None], x)
+        full = x.sum(axis=0)
+        for i in range(N):
+            np.testing.assert_allclose(
+                np.asarray(out[i]), full[i * 2 : (i + 1) * 2], rtol=1e-5
+            )
+
+
+class TestEager:
+    """Single-process eager semantics: size-1 process group identities."""
+
+    def test_allreduce_identity(self):
+        x = np.random.randn(5, 4).astype(np.float32)
+        np.testing.assert_allclose(hvd.allreduce(x, hvd.Sum), x)
+        np.testing.assert_allclose(hvd.allreduce(x, hvd.Average), x)
+
+    def test_allgather_identity(self):
+        x = np.random.randn(3, 2).astype(np.float32)
+        np.testing.assert_allclose(hvd.allgather(x), x)
+
+    def test_broadcast_identity(self):
+        x = np.random.randn(3).astype(np.float32)
+        np.testing.assert_allclose(hvd.broadcast(x, 0), x)
+
+    def test_grouped_allreduce(self):
+        xs = [np.random.randn(4).astype(np.float32) for _ in range(5)]
+        outs = hvd.grouped_allreduce(xs, hvd.Sum)
+        for a, b in zip(outs, xs):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_barrier(self):
+        hvd.barrier()
+
+    def test_bad_op(self):
+        with pytest.raises(ValueError, match="Unknown reduce op"):
+            hvd.allreduce(np.ones(3), "Mean")
+
+
+class TestAsyncHandles:
+    """Handle-based API (torch/mpi_ops.py synchronize/poll parity)."""
+
+    def test_allreduce_async_synchronize(self):
+        x = np.random.randn(4).astype(np.float32)
+        h = hvd.allreduce_async(x, hvd.Sum)
+        assert hvd.poll(h)
+        out = hvd.synchronize(h)
+        np.testing.assert_allclose(out, x, rtol=1e-6)
+
+    def test_handle_single_use(self):
+        h = hvd.allreduce_async(np.ones(2, np.float32))
+        hvd.synchronize(h)
+        with pytest.raises(ValueError, match="handle"):
+            hvd.synchronize(h)
+
+    def test_multiple_outstanding(self):
+        xs = [np.random.randn(3).astype(np.float32) for _ in range(4)]
+        handles = [hvd.allreduce_async(x, hvd.Sum, name=f"t{i}") for i, x in enumerate(xs)]
+        for h, x in zip(handles, xs):
+            np.testing.assert_allclose(hvd.synchronize(h), x, rtol=1e-6)
+
+    def test_broadcast_allgather_alltoall_async(self):
+        x = np.random.randn(8, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            hvd.synchronize(hvd.broadcast_async(x, 0)), x
+        )
+        np.testing.assert_allclose(
+            hvd.synchronize(hvd.allgather_async(x)), x
+        )
+        np.testing.assert_allclose(
+            hvd.synchronize(hvd.alltoall_async(x)), x
+        )
+
+
+class TestCompression:
+    def test_fp16_roundtrip(self):
+        x = np.random.randn(16).astype(np.float32)
+        comp, ctx = hvd.Compression.fp16.compress(x)
+        assert jnp.asarray(comp).dtype == jnp.float16
+        out = hvd.Compression.fp16.decompress(comp, ctx)
+        assert jnp.asarray(out).dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out), x, atol=1e-2)
+
+    def test_bf16_in_allreduce(self):
+        x = _per_worker((4,))
+        out = run_per_worker(
+            lambda t: hvd.allreduce(t, hvd.Sum, compression=hvd.Compression.bf16)[
+                None
+            ],
+            x,
+        )
+        np.testing.assert_allclose(np.asarray(out[0]), x.sum(0), rtol=0.05, atol=0.05)
+        assert np.asarray(out).dtype == np.float32
+
+    def test_none(self):
+        x = np.ones(3, np.float32)
+        c, ctx = hvd.Compression.none.compress(x)
+        assert c is x
+        assert hvd.Compression.none.decompress(c, ctx) is x

@@ -1,0 +1,206 @@
+"""DistributedOptimizer / DistributedGradientTape / fusion tests
+(reference: test_torch.py optimizer tests, test_tensorflow.py
+DistributedGradientTape tests, backward_passes_per_step)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import spmd
+from horovod_tpu.ops import fusion
+
+N = 8
+
+
+def _loss(params, batch):
+    x, y = batch
+    pred = x @ params["w"] + params["b"]
+    return jnp.mean((pred - y) ** 2)
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(N * 4, 3).astype(np.float32)
+    w = np.array([[1.0], [-2.0], [0.5]], np.float32)
+    y = x @ w + 0.1 * rng.randn(N * 4, 1).astype(np.float32)
+    return x, y
+
+
+def _params():
+    return {
+        "w": jnp.zeros((3, 1), jnp.float32),
+        "b": jnp.zeros((1,), jnp.float32),
+    }
+
+
+class TestDistributedOptimizer:
+    def test_matches_global_batch_sgd(self):
+        """DP train step with DistributedOptimizer == single-worker step on
+        the full batch (the defining correctness property of gradient
+        averaging)."""
+        x, y = _data()
+        params = _params()
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1))
+        step = spmd.make_train_step(_loss, opt, donate=False)
+        opt_state = opt.init(params)
+        p2, _, loss = step(params, opt_state, (x, y))
+
+        # Single-process oracle on the full batch:
+        g = jax.grad(_loss)(params, (x, y))
+        expect = jax.tree_util.tree_map(lambda p, gg: p - 0.1 * gg, params, g)
+        for k in params:
+            np.testing.assert_allclose(
+                np.asarray(p2[k]), np.asarray(expect[k]), rtol=1e-4, atol=1e-5
+            )
+        assert np.isfinite(float(loss))
+
+    def test_sum_op_scales(self):
+        x, y = _data()
+        params = _params()
+        opt_avg = hvd.DistributedOptimizer(optax.sgd(0.1), op=hvd.Average)
+        opt_sum = hvd.DistributedOptimizer(optax.sgd(0.1 / N), op=hvd.Sum)
+        s_avg = spmd.make_train_step(_loss, opt_avg, donate=False)
+        s_sum = spmd.make_train_step(_loss, opt_sum, donate=False)
+        pa, _, _ = s_avg(params, opt_avg.init(params), (x, y))
+        ps, _, _ = s_sum(params, opt_sum.init(params), (x, y))
+        np.testing.assert_allclose(
+            np.asarray(pa["w"]), np.asarray(ps["w"]), rtol=1e-4, atol=1e-6
+        )
+
+    def test_training_converges(self):
+        x, y = _data()
+        params = _params()
+        opt = hvd.DistributedOptimizer(optax.adam(0.05))
+        step = spmd.make_train_step(_loss, opt)
+        opt_state = opt.init(params)
+        losses = []
+        for _ in range(60):
+            params, opt_state, loss = step(params, opt_state, (x, y))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.1
+        np.testing.assert_allclose(np.asarray(params["w"]).ravel(), [1, -2, 0.5], atol=0.3)
+
+    def test_adasum_op(self):
+        x, y = _data()
+        params = _params()
+        opt = hvd.DistributedOptimizer(optax.sgd(0.05), op=hvd.Adasum)
+        step = spmd.make_train_step(_loss, opt)
+        opt_state = opt.init(params)
+        for _ in range(40):
+            params, opt_state, loss = step(params, opt_state, (x, y))
+        assert float(loss) < 1.0
+
+
+class TestBackwardPassesPerStep:
+    def test_accumulation(self):
+        """k accumulation steps then one update == one update with the
+        averaged gradient (torch/__init__.py:95-157 semantics)."""
+        k = 4
+        x, y = _data()
+        params = _params()
+        opt = hvd.DistributedOptimizer(optax.sgd(0.1), backward_passes_per_step=k)
+        step = spmd.make_train_step(_loss, opt, donate=False)
+        opt_state = opt.init(params)
+        p = params
+        for i in range(k):
+            p, opt_state, _ = step(p, opt_state, (x, y))
+            if i < k - 1:
+                # no update applied yet
+                np.testing.assert_allclose(
+                    np.asarray(p["w"]), np.asarray(params["w"])
+                )
+        g = jax.grad(_loss)(params, (x, y))
+        expect = params["w"] - 0.1 * g["w"]
+        np.testing.assert_allclose(np.asarray(p["w"]), np.asarray(expect), rtol=1e-4, atol=1e-6)
+
+    def test_no_average_aggregated(self):
+        k = 2
+        x, y = _data()
+        params = _params()
+        opt = hvd.DistributedOptimizer(
+            optax.sgd(0.1),
+            backward_passes_per_step=k,
+            average_aggregated_gradients=False,
+        )
+        step = spmd.make_train_step(_loss, opt, donate=False)
+        opt_state = opt.init(params)
+        p = params
+        for _ in range(k):
+            p, opt_state, _ = step(p, opt_state, (x, y))
+        g = jax.grad(_loss)(params, (x, y))
+        expect = params["w"] - 0.1 * k * g["w"]  # sum of k identical grads
+        np.testing.assert_allclose(np.asarray(p["w"]), np.asarray(expect), rtol=1e-4, atol=1e-6)
+
+    def test_bad_k(self):
+        with pytest.raises(ValueError):
+            hvd.DistributedOptimizer(optax.sgd(0.1), backward_passes_per_step=0)
+
+
+class TestDistributedGradientTape:
+    def test_grads_averaged(self):
+        x, y = _data()
+        params = _params()
+
+        def inner(xs, ys):
+            tape = hvd.DistributedGradientTape(_loss)
+            loss, grads = tape(params, (xs, ys))
+            return grads["w"][None]
+
+        out = jax.jit(
+            spmd.shard(
+                lambda xs, ys: inner(xs, ys),
+                in_specs=(P(hvd.AXIS), P(hvd.AXIS)),
+                out_specs=P(hvd.AXIS),
+            )
+        )(x, y)
+        full = jax.grad(_loss)(params, (x, y))
+        np.testing.assert_allclose(
+            np.asarray(out[0]), np.asarray(full["w"]), rtol=1e-4, atol=1e-5
+        )
+
+
+class TestFusion:
+    def test_buckets_respect_threshold_and_dtype(self):
+        leaves = [np.ones(10, np.float32), np.ones(10, np.float32),
+                  np.ones(10, np.int32), np.ones(1000, np.float32)]
+        buckets = fusion.make_buckets(leaves, threshold=100)
+        # int32 leaf must be in its own bucket; big leaf alone
+        for b in buckets:
+            dtypes = {np.asarray(leaves[i]).dtype for i in b}
+            assert len(dtypes) == 1
+        flat = sorted(i for b in buckets for i in b)
+        assert flat == [0, 1, 2, 3]
+
+    def test_fused_tree_matches_unfused(self):
+        rng = np.random.RandomState(0)
+        tree = {
+            "a": rng.randn(N, 4).astype(np.float32),
+            "b": rng.randn(N, 5).astype(np.float32),
+            "c": rng.randn(N, 2, 3).astype(np.float32),
+        }
+
+        def inner(a, b, c):
+            t = {"a": a[0], "b": b[0], "c": c[0]}
+            out = fusion.fused_allreduce_tree(t, hvd.Sum, threshold=1 << 20)
+            return jax.tree_util.tree_map(lambda l: l[None], out)
+
+        out = jax.jit(
+            spmd.shard(
+                inner,
+                in_specs=(P(hvd.AXIS),) * 3,
+                out_specs={"a": P(hvd.AXIS), "b": P(hvd.AXIS), "c": P(hvd.AXIS)},
+            )
+        )(tree["a"], tree["b"], tree["c"])
+        for k in tree:
+            np.testing.assert_allclose(
+                np.asarray(out[k][0]), tree[k].sum(axis=0), rtol=1e-4, atol=1e-5
+            )
+
+    def test_tiny_threshold_many_buckets(self):
+        leaves = [np.ones(100, np.float32) for _ in range(5)]
+        buckets = fusion.make_buckets(leaves, threshold=1)
+        assert len(buckets) == 5

@@ -1,0 +1,142 @@
+"""State broadcast, object collectives, Join, and elastic State tests
+(reference: test_torch.py test_broadcast_state:911, broadcast_object,
+test_horovod_join_allreduce:1540)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import elastic, spmd
+from horovod_tpu.join import masked_average
+
+N = 8
+
+
+class TestBroadcastState:
+    def test_broadcast_parameters_eager(self):
+        params = {"w": np.random.randn(3, 2).astype(np.float32)}
+        out = hvd.broadcast_parameters(params, root_rank=0)
+        np.testing.assert_allclose(out["w"], params["w"])
+
+    def test_broadcast_parameters_in_graph(self):
+        x = np.random.RandomState(0).randn(N, 4).astype(np.float32)
+
+        def inner(t):
+            return hvd.broadcast_parameters({"w": t[0]}, root_rank=2)["w"][None]
+
+        out = jax.jit(
+            spmd.shard(inner, in_specs=(P(hvd.AXIS),), out_specs=P(hvd.AXIS))
+        )(x)
+        for i in range(N):
+            np.testing.assert_allclose(np.asarray(out)[i], x[2])
+
+    def test_broadcast_optimizer_state(self):
+        opt = optax.adam(1e-3)
+        params = {"w": jnp.ones((3,))}
+        st = opt.init(params)
+        out = hvd.broadcast_optimizer_state(st, root_rank=0)
+        # structure preserved and numerically identical (single process)
+        l1 = jax.tree_util.tree_leaves(st)
+        l2 = jax.tree_util.tree_leaves(out)
+        assert len(l1) == len(l2)
+        for a, b in zip(l1, l2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    def test_broadcast_object(self):
+        obj = {"lr": 0.1, "sched": [1, 2, 3], "name": "resnet"}
+        assert hvd.broadcast_object(obj, 0) == obj
+
+    def test_allgather_object(self):
+        out = hvd.allgather_object({"r": 0})
+        assert out == [{"r": 0}]
+
+
+class TestJoin:
+    def test_masked_average_all_active(self):
+        x = np.random.RandomState(0).randn(N, 4).astype(np.float32)
+        act = np.ones((N, 1), np.float32)
+
+        def inner(t, a):
+            return masked_average(t[0], a[0, 0])[None]
+
+        out = jax.jit(
+            spmd.shard(
+                inner,
+                in_specs=(P(hvd.AXIS), P(hvd.AXIS)),
+                out_specs=P(hvd.AXIS),
+            )
+        )(x, act)
+        np.testing.assert_allclose(np.asarray(out)[0], x.mean(0), rtol=1e-5)
+
+    def test_masked_average_some_joined(self):
+        """Joined (inactive) workers contribute zeros and shrink the
+        divisor — controller.cc:780-803 ready-count semantics."""
+        x = np.random.RandomState(1).randn(N, 4).astype(np.float32)
+        act = np.ones((N, 1), np.float32)
+        act[5:] = 0.0  # workers 5,6,7 have joined
+
+        def inner(t, a):
+            return masked_average(t[0], a[0, 0])[None]
+
+        out = jax.jit(
+            spmd.shard(
+                inner,
+                in_specs=(P(hvd.AXIS), P(hvd.AXIS)),
+                out_specs=P(hvd.AXIS),
+            )
+        )(x, act)
+        expect = x[:5].mean(0)
+        for i in range(N):
+            np.testing.assert_allclose(np.asarray(out)[i], expect, rtol=1e-4)
+
+    def test_masked_average_all_joined_no_nan(self):
+        x = np.ones((N, 3), np.float32)
+        act = np.zeros((N, 1), np.float32)
+
+        def inner(t, a):
+            return masked_average(t[0], a[0, 0])[None]
+
+        out = jax.jit(
+            spmd.shard(
+                inner,
+                in_specs=(P(hvd.AXIS), P(hvd.AXIS)),
+                out_specs=P(hvd.AXIS),
+            )
+        )(x, act)
+        assert np.all(np.isfinite(np.asarray(out)))
+        np.testing.assert_allclose(np.asarray(out), 0.0)
+
+    def test_eager_join_returns_last_rank(self):
+        assert hvd.join() == hvd.rank()
+
+
+class TestElasticState:
+    def test_sync_and_checkpoint_roundtrip(self, tmp_path):
+        params = {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)}
+        st = elastic.State(params=params, epoch=3, meta={"run": "x"})
+        st.sync()
+        path = str(tmp_path / "ckpt.pkl")
+        st.save(path)
+
+        st2 = elastic.State(params={"w": jnp.zeros((2, 3))}, epoch=0, meta={})
+        assert st2.restore(path)
+        np.testing.assert_allclose(np.asarray(st2.params["w"]), np.asarray(params["w"]))
+        assert st2.epoch == 3
+        assert st2.meta == {"run": "x"}
+
+    def test_restore_missing(self, tmp_path):
+        st = elastic.State(params={"w": jnp.zeros(2)})
+        assert not st.restore(str(tmp_path / "nope.pkl"))
+
+    def test_elastic_run_decorator(self):
+        st = elastic.State(x=1)
+
+        @elastic.run
+        def train(state):
+            return state.x + 1
+
+        assert train(st) == 2
