@@ -1,0 +1,136 @@
+"""Synthetic ResNet-50 benchmark — the reference's measurement protocol
+(``examples/tensorflow2_synthetic_benchmark.py:36-131``): synthetic data,
+default batch 32/worker, 10 warmup batches, 10 iterations x 10 batches,
+reports images/sec per worker.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/sec/chip", "vs_baseline": N}
+
+vs_baseline compares against the reference's published per-GPU throughput:
+ResNet-101 at 1656.82 total img/s over 16 Pascal GPUs => 103.55
+img/s/GPU (``docs/benchmarks.rst:29-43``); we use it as the per-accelerator
+yardstick for ResNet-50 (the closest published number; ResNet-50 is
+slightly cheaper so this flatters the baseline, not us).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+REFERENCE_IMG_PER_SEC_PER_ACCEL = 1656.82 / 16  # docs/benchmarks.rst:29-43
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="ResNet50")
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--num-warmup-batches", type=int, default=10)
+    ap.add_argument("--num-batches-per-iter", type=int, default=10)
+    ap.add_argument("--num-iters", type=int, default=10)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--fp16-allreduce", action="store_true")
+    args = ap.parse_args()
+
+    import horovod_tpu as hvd
+    from horovod_tpu import spmd
+    from horovod_tpu.models import resnet
+
+    hvd.init()
+
+    model = resnet.create(args.model, num_classes=1000)
+    rng = jax.random.PRNGKey(42)
+    variables = resnet.init_variables(model, rng, args.image_size, batch=2)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    compression = hvd.Compression.bf16 if args.fp16_allreduce else hvd.Compression.none
+    opt = hvd.DistributedOptimizer(
+        optax.sgd(0.01 * hvd.size(), momentum=0.9), compression=compression
+    )
+    opt_state = opt.init(params)
+
+    def loss_fn(p, batch):
+        images, labels, stats = batch["images"], batch["labels"], batch["stats"]
+        logits, new_model_state = model.apply(
+            {"params": p, "batch_stats": stats},
+            images,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        one_hot = jax.nn.one_hot(labels, 1000)
+        loss = optax.softmax_cross_entropy(logits, one_hot).mean()
+        return loss, new_model_state["batch_stats"]
+
+    axis = hvd.AXIS
+    mesh = hvd.mesh()
+
+    from jax.sharding import PartitionSpec as P
+
+    def _step(params, opt_state, stats, images, labels):
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, {"images": images, "labels": labels, "stats": stats}
+        )
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, new_stats, jax.lax.pmean(loss, axis)
+
+    step = jax.jit(
+        spmd.shard(
+            _step,
+            in_specs=(P(), P(), P(), P(axis), P(axis)),
+            out_specs=(P(), P(), P(), P()),
+            mesh=mesh,
+        ),
+        donate_argnums=(0, 1, 2),
+    )
+
+    n = hvd.size()
+    global_batch = args.batch_size * n
+    images = np.random.rand(global_batch, args.image_size, args.image_size, 3).astype(
+        np.float32
+    )
+    labels = np.random.randint(0, 1000, (global_batch,)).astype(np.int32)
+
+    # warmup (compile + stabilize)
+    for _ in range(max(args.num_warmup_batches // args.num_batches_per_iter, 1)):
+        for _ in range(args.num_batches_per_iter):
+            params, opt_state, batch_stats, loss = step(
+                params, opt_state, batch_stats, images, labels
+            )
+    jax.block_until_ready(loss)
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            params, opt_state, batch_stats, loss = step(
+                params, opt_state, batch_stats, images, labels
+            )
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        img_secs.append(global_batch * args.num_batches_per_iter / dt / n)
+
+    mean = float(np.mean(img_secs))
+    conf = float(1.96 * np.std(img_secs))
+    print(
+        json.dumps(
+            {
+                "metric": f"{args.model} synthetic train throughput per chip "
+                f"(batch {args.batch_size}/chip, {n} chip(s))",
+                "value": round(mean, 2),
+                "unit": "img/sec/chip",
+                "vs_baseline": round(mean / REFERENCE_IMG_PER_SEC_PER_ACCEL, 3),
+                "stddev95": round(conf, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Small MLP classifier — the MNIST example model
+(reference: ``examples/tensorflow2_mnist.py`` / ``pytorch_mnist.py``)."""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def init_params(rng, sizes: Sequence[int] = (784, 256, 128, 10)) -> Dict:
+    params = {}
+    keys = jax.random.split(rng, len(sizes) - 1)
+    for i, (a, b) in enumerate(zip(sizes[:-1], sizes[1:])):
+        params[f"w{i}"] = jax.random.normal(keys[i], (a, b)) / jnp.sqrt(a)
+        params[f"b{i}"] = jnp.zeros((b,))
+    return params
+
+
+def forward(params: Dict, x):
+    n = len(params) // 2
+    h = x.reshape(x.shape[0], -1)
+    for i in range(n):
+        h = h @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+def loss_fn(params: Dict, batch):
+    x, y = batch
+    logits = forward(params, x)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, y[:, None], axis=-1).squeeze(-1)
+    return jnp.mean(logz - gold)
+
+
+def accuracy(params: Dict, batch):
+    x, y = batch
+    return jnp.mean(jnp.argmax(forward(params, x), axis=-1) == y)

@@ -1,0 +1,9 @@
+"""Launcher layer (reference layer 5): ``horovodrun``-equivalent CLI,
+host parsing, config-file/env normalization, rendezvous, process spawn.
+
+Reference: ``horovod/run/run.py:395-960``, ``run/gloo_run.py``,
+``run/common/util/config_parser.py``, ``run/http/http_server.py``.
+"""
+
+from horovod_tpu.runner.hosts import HostSpec, SlotInfo, allocate, parse_hosts  # noqa: F401
+from horovod_tpu.runner.launch import launch_job  # noqa: F401

@@ -1,0 +1,133 @@
+"""CLI flags ⇄ YAML config file ⇄ ``HOROVOD_*`` env vars.
+
+Reference: ``run/common/util/config_parser.py`` (names kept), the flag
+groups of ``run/run.py:451-617``, and the override-precedence rule
+(CLI beats config file, ``run/run.py:337-393``; tested by
+``test_run.py:176-233``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+# env var names (reference config_parser.py constants)
+HOROVOD_FUSION_THRESHOLD = "HOROVOD_FUSION_THRESHOLD"
+HOROVOD_CYCLE_TIME = "HOROVOD_CYCLE_TIME"
+HOROVOD_CACHE_CAPACITY = "HOROVOD_CACHE_CAPACITY"
+HOROVOD_HIERARCHICAL_ALLREDUCE = "HOROVOD_HIERARCHICAL_ALLREDUCE"
+HOROVOD_HIERARCHICAL_ALLGATHER = "HOROVOD_HIERARCHICAL_ALLGATHER"
+HOROVOD_AUTOTUNE = "HOROVOD_AUTOTUNE"
+HOROVOD_AUTOTUNE_LOG = "HOROVOD_AUTOTUNE_LOG"
+HOROVOD_AUTOTUNE_WARMUP_SAMPLES = "HOROVOD_AUTOTUNE_WARMUP_SAMPLES"
+HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE = "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE"
+HOROVOD_TIMELINE = "HOROVOD_TIMELINE"
+HOROVOD_TIMELINE_MARK_CYCLES = "HOROVOD_TIMELINE_MARK_CYCLES"
+HOROVOD_STALL_CHECK_DISABLE = "HOROVOD_STALL_CHECK_DISABLE"
+HOROVOD_STALL_CHECK_TIME_SECONDS = "HOROVOD_STALL_CHECK_TIME_SECONDS"
+HOROVOD_STALL_SHUTDOWN_TIME_SECONDS = "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS"
+HOROVOD_LOG_LEVEL = "HOROVOD_LOG_LEVEL"
+
+
+def _set(env: Dict[str, str], name: str, value: Any) -> None:
+    if value is not None:
+        env[name] = str(int(value) if isinstance(value, bool) else value)
+
+
+def set_env_from_args(env: Dict[str, str], args) -> Dict[str, str]:
+    """Translate parsed args into HOROVOD_* env (reference
+    ``config_parser.set_env_from_args``, run/common/util/config_parser.py:
+    140-180)."""
+    _set(env, HOROVOD_FUSION_THRESHOLD, getattr(args, "fusion_threshold_mb", None) and int(args.fusion_threshold_mb * 1024 * 1024))
+    _set(env, HOROVOD_CYCLE_TIME, getattr(args, "cycle_time_ms", None))
+    _set(env, HOROVOD_CACHE_CAPACITY, getattr(args, "cache_capacity", None))
+    _set(env, HOROVOD_HIERARCHICAL_ALLREDUCE, getattr(args, "hierarchical_allreduce", None))
+    _set(env, HOROVOD_HIERARCHICAL_ALLGATHER, getattr(args, "hierarchical_allgather", None))
+    if getattr(args, "autotune", False):
+        _set(env, HOROVOD_AUTOTUNE, 1)
+        _set(env, HOROVOD_AUTOTUNE_LOG, getattr(args, "autotune_log_file", None))
+        _set(env, HOROVOD_AUTOTUNE_WARMUP_SAMPLES, getattr(args, "autotune_warmup_samples", None))
+        _set(env, HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE, getattr(args, "autotune_steps_per_sample", None))
+    _set(env, HOROVOD_TIMELINE, getattr(args, "timeline_filename", None))
+    if getattr(args, "timeline_mark_cycles", False):
+        _set(env, HOROVOD_TIMELINE_MARK_CYCLES, 1)
+    if getattr(args, "no_stall_check", False):
+        _set(env, HOROVOD_STALL_CHECK_DISABLE, 1)
+    else:
+        _set(env, HOROVOD_STALL_CHECK_TIME_SECONDS, getattr(args, "stall_check_warning_time_seconds", None))
+        _set(env, HOROVOD_STALL_SHUTDOWN_TIME_SECONDS, getattr(args, "stall_check_shutdown_time_seconds", None))
+    _set(env, HOROVOD_LOG_LEVEL, getattr(args, "log_level", None))
+    return env
+
+
+# config-file key → argparse dest (reference config.test.yaml layout)
+_CONFIG_MAP = {
+    ("params", "fusion-threshold-mb"): "fusion_threshold_mb",
+    ("params", "cycle-time-ms"): "cycle_time_ms",
+    ("params", "cache-capacity"): "cache_capacity",
+    ("params", "hierarchical-allreduce"): "hierarchical_allreduce",
+    ("params", "hierarchical-allgather"): "hierarchical_allgather",
+    ("autotune", "enabled"): "autotune",
+    ("autotune", "log-file"): "autotune_log_file",
+    ("autotune", "warmup-samples"): "autotune_warmup_samples",
+    ("autotune", "steps-per-sample"): "autotune_steps_per_sample",
+    ("timeline", "filename"): "timeline_filename",
+    ("timeline", "mark-cycles"): "timeline_mark_cycles",
+    ("stall-check", "disable"): "no_stall_check",
+    ("stall-check", "warning-time-seconds"): "stall_check_warning_time_seconds",
+    ("stall-check", "shutdown-time-seconds"): "stall_check_shutdown_time_seconds",
+}
+
+
+def read_config_file(path: str) -> Dict[str, Any]:
+    """Parse the YAML config file into {argparse_dest: value}.  Uses a
+    minimal hand parser (two-level maps of scalars) so the launcher has no
+    YAML dependency — the reference's config surface is exactly this shape
+    (``test/data/config.test.yaml``)."""
+    values: Dict[str, Any] = {}
+    section = None
+    with open(path) as f:
+        for raw in f:
+            line = raw.split("#")[0].rstrip()
+            if not line.strip():
+                continue
+            indent = len(line) - len(line.lstrip())
+            key, _, val = line.strip().partition(":")
+            key = key.strip()
+            val = val.strip()
+            if indent == 0:
+                section = key
+                continue
+            dest = _CONFIG_MAP.get((section, key))
+            if dest is None:
+                continue
+            values[dest] = _parse_scalar(val)
+    return values
+
+
+def _parse_scalar(val: str) -> Any:
+    low = val.lower()
+    if low in ("true", "yes", "on"):
+        return True
+    if low in ("false", "no", "off"):
+        return False
+    try:
+        return int(val)
+    except ValueError:
+        pass
+    try:
+        return float(val)
+    except ValueError:
+        pass
+    return val
+
+
+def apply_config_file(args, path: Optional[str]) -> None:
+    """Apply config-file values for args the CLI did not override
+    (CLI > config file > defaults; reference override-actions
+    run/run.py:337-393)."""
+    if not path:
+        return
+    overridden = getattr(args, "_explicit_args", set())
+    for dest, val in read_config_file(path).items():
+        if dest not in overridden:
+            setattr(args, dest, val)

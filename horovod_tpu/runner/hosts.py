@@ -1,0 +1,93 @@
+"""Host string / hostfile parsing and rank allocation.
+
+Reference: host parsing in ``run/run.py:679-694`` (``-H host1:4,host2:4``
+and ``--hostfile``) and the slot allocation that computes
+rank/local_rank/cross_rank per process (``run/gloo_run.py:53-111``
+``_allocate``).  On TPU one process drives all of a host's chips, so a
+"slot" is a host process, not a chip.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class HostSpec:
+    hostname: str
+    slots: int  # chips on this host
+
+
+@dataclass(frozen=True)
+class SlotInfo:
+    """Env contract for one launched process (the reference exports
+    HOROVOD_RANK / SIZE / LOCAL_RANK / LOCAL_SIZE / CROSS_RANK / CROSS_SIZE
+    per slot, gloo_run.py:262-288)."""
+
+    hostname: str
+    rank: int          # process rank (== cross rank here)
+    size: int          # number of processes
+    local_size: int    # chips driven by this process
+    world_chips: int   # total chips
+
+    def to_env(self) -> dict:
+        return {
+            "HOROVOD_RANK": str(self.rank),
+            "HOROVOD_SIZE": str(self.size),
+            "HOROVOD_NUM_PROC": str(self.size),
+            "HOROVOD_LOCAL_RANK": "0",
+            "HOROVOD_LOCAL_SIZE": str(self.local_size),
+            "HOROVOD_CROSS_RANK": str(self.rank),
+            "HOROVOD_CROSS_SIZE": str(self.size),
+            "HOROVOD_WORLD_CHIPS": str(self.world_chips),
+        }
+
+
+def parse_hosts(hosts: Optional[str] = None, hostfile: Optional[str] = None) -> List[HostSpec]:
+    """``-H h1:4,h2:4`` or a hostfile with ``hostname slots=N`` lines."""
+    specs: List[HostSpec] = []
+    if hosts and hostfile:
+        raise ValueError("specify either hosts or hostfile, not both")
+    if hostfile:
+        with open(hostfile) as f:
+            for line in f:
+                line = line.split("#")[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                name = parts[0]
+                slots = 1
+                for p in parts[1:]:
+                    if p.startswith("slots="):
+                        slots = int(p[len("slots="):])
+                specs.append(HostSpec(name, slots))
+        return specs
+    if not hosts:
+        return [HostSpec("localhost", 0)]  # 0 = use all local chips
+    for item in hosts.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        if ":" in item:
+            name, slots = item.rsplit(":", 1)
+            specs.append(HostSpec(name, int(slots)))
+        else:
+            specs.append(HostSpec(item, 1))
+    return specs
+
+
+def allocate(specs: List[HostSpec]) -> List[SlotInfo]:
+    """One process per host; ranks in host order (gloo_run _allocate)."""
+    size = len(specs)
+    world = sum(s.slots for s in specs)
+    return [
+        SlotInfo(
+            hostname=s.hostname,
+            rank=i,
+            size=size,
+            local_size=s.slots,
+            world_chips=world,
+        )
+        for i, s in enumerate(specs)
+    ]

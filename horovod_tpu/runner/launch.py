@@ -1,0 +1,112 @@
+"""Job launch: spawn one process per host with the rank/rendezvous env.
+
+Reference: ``run/gloo_run.py`` (rank allocation → RendezvousServer → per
+slot ssh/local spawn with HOROVOD_* env → output capture → kill-all on any
+failure).  The mpirun path (``run/mpi_run.py``) has no TPU analogue: there
+is no external runtime to delegate to, so this module IS the process
+manager.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import sys
+import threading
+from typing import Dict, List, Optional
+
+from horovod_tpu.runner import safe_shell_exec
+from horovod_tpu.runner.hosts import HostSpec, SlotInfo, allocate
+from horovod_tpu.runner.rendezvous import RendezvousServer
+
+SSH_COMMAND_PREFIX = "ssh -o PasswordAuthentication=no -o StrictHostKeyChecking=no"
+
+
+def _is_local(hostname: str) -> bool:
+    return hostname in ("localhost", "127.0.0.1", os.uname().nodename)
+
+
+def build_command(
+    slot: SlotInfo,
+    command: List[str],
+    env: Dict[str, str],
+    coordinator_addr: str,
+    coordinator_port: int,
+) -> (List[str], Dict[str, str]):
+    """The env contract every rank receives (reference
+    ``gloo_run.py:262-288``)."""
+    slot_env = dict(env)
+    slot_env.update(slot.to_env())
+    slot_env["HOROVOD_COORDINATOR_ADDR"] = coordinator_addr
+    slot_env["HOROVOD_COORDINATOR_PORT"] = str(coordinator_port)
+    slot_env["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = coordinator_addr  # compat name
+    slot_env["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(coordinator_port)
+    if _is_local(slot.hostname):
+        return command, slot_env
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}"
+        for k, v in slot_env.items()
+        if k.startswith(("HOROVOD_", "PYTHON", "PATH", "JAX_", "XLA_"))
+    )
+    remote = f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1 ; env {exports} {' '.join(shlex.quote(c) for c in command)}"
+    return shlex.split(SSH_COMMAND_PREFIX) + [slot.hostname, remote], env
+
+
+def launch_job(
+    command: List[str],
+    host_specs: List[HostSpec],
+    *,
+    env: Optional[Dict[str, str]] = None,
+    output_filename: Optional[str] = None,
+    coordinator_port: int = 0,
+    _executor=safe_shell_exec.execute,
+) -> int:
+    """Launch ``command`` on every host; returns first nonzero exit code
+    (and terminates all other ranks when any rank fails — the reference's
+    any-failure-kills-all policy, ``gloo_run.py:162-259``)."""
+    env = dict(env if env is not None else os.environ)
+    slots = allocate(host_specs)
+    server = RendezvousServer(coordinator_port)
+    port = server.start()
+    addr = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
+
+    exit_codes: List[Optional[int]] = [None] * len(slots)
+    failure = threading.Event()
+    threads = []
+
+    def _run(i: int, slot: SlotInfo) -> None:
+        cmd, slot_env = build_command(slot, command, env, addr, port)
+        out = err = None
+        if output_filename:
+            os.makedirs(output_filename, exist_ok=True)
+            out = open(os.path.join(output_filename, f"rank.{slot.rank}.stdout"), "w")
+            err = open(os.path.join(output_filename, f"rank.{slot.rank}.stderr"), "w")
+        prefix = f"[{slot.rank}]<stdout>:" if len(slots) > 1 else None
+        try:
+            rc = _executor(
+                cmd,
+                env=slot_env,
+                stdout=out or sys.stdout,
+                stderr=err or sys.stderr,
+                prefix=prefix,
+                events=[failure],
+            )
+        finally:
+            for f in (out, err):
+                if f:
+                    f.close()
+        exit_codes[i] = rc
+        if rc != 0:
+            failure.set()
+
+    try:
+        for i, slot in enumerate(slots):
+            t = threading.Thread(target=_run, args=(i, slot), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+    finally:
+        server.stop()
+    bad = [rc for rc in exit_codes if rc]
+    return bad[0] if bad else 0

@@ -1,0 +1,131 @@
+"""HTTP KV rendezvous server + client.
+
+Reference: ``run/http/http_server.py:33-222`` (``KVStoreHandler`` GET/PUT,
+``RendezvousServer``, scope finalization via DELETE) and the client side
+``gloo/http_store.cc`` / ``run/http/http_client.py``.
+
+Role on TPU: the launcher starts this server; worker processes use it to
+exchange the coordinator address, publish per-host results, and as the
+KV behind run-function mode.  (The JAX distributed runtime does collective
+bootstrap; this store is the transport-agnostic side channel the reference
+kept for the same purpose.)
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _key(self) -> Tuple[str, str]:
+        parts = self.path.strip("/").split("/", 1)
+        scope = parts[0] if parts else ""
+        key = parts[1] if len(parts) > 1 else ""
+        return scope, key
+
+    def do_PUT(self):
+        scope, key = self._key()
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        with self.server._lock:
+            self.server._store.setdefault(scope, {})[key] = value
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_GET(self):
+        scope, key = self._key()
+        with self.server._lock:
+            value = self.server._store.get(scope, {}).get(key)
+        if value is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_DELETE(self):  # scope finalization (RendezvousHandler:105)
+        scope, _ = self._key()
+        with self.server._lock:
+            self.server._store.pop(scope, None)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class RendezvousServer:
+    """Threaded HTTP KV store (``KVStoreServer`` / ``RendezvousServer``)."""
+
+    def __init__(self, port: int = 0) -> None:
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
+        self._httpd._store: Dict[str, Dict[str, bytes]] = {}
+        self._httpd._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        if self._thread:
+            self._thread.join(timeout=5)
+        self._httpd.server_close()
+
+
+class KVClient:
+    """Blocking KV client (``run/http/http_client.py`` equivalents)."""
+
+    def __init__(self, addr: str, port: int, timeout: float = 30.0) -> None:
+        self._base = f"http://{addr}:{port}"
+        self._timeout = timeout
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        req = urlrequest.Request(
+            f"{self._base}/{scope}/{key}", data=value, method="PUT"
+        )
+        urlrequest.urlopen(req, timeout=self._timeout).read()
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        try:
+            return urlrequest.urlopen(
+                f"{self._base}/{scope}/{key}", timeout=self._timeout
+            ).read()
+        except urlerror.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def wait(self, scope: str, key: str, timeout: float = 60.0) -> bytes:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            v = self.get(scope, key)
+            if v is not None:
+                return v
+            time.sleep(0.1)
+        raise TimeoutError(f"rendezvous key {scope}/{key} not published")
+
+    def delete_scope(self, scope: str) -> None:
+        req = urlrequest.Request(f"{self._base}/{scope}/", method="DELETE")
+        urlrequest.urlopen(req, timeout=self._timeout).read()

@@ -1,0 +1,158 @@
+"""``horovodrun`` CLI for TPU jobs.
+
+Reference: ``run/run.py:395-960`` — same flag groups (job size/hosts,
+tuneable params, autotune, timeline, stall check, logging, config file with
+CLI-override precedence), translated to the TPU launch model: one process
+per host, JAX coordination service instead of mpirun/ssh-orted, chips
+discovered from the TPU runtime.
+
+Usage:
+    horovodrun -np 2 -H host1:4,host2:4 python train.py
+    horovodrun --config-file cfg.yaml python train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from horovod_tpu.runner import config_parser
+from horovod_tpu.runner.hosts import parse_hosts
+from horovod_tpu.runner.launch import launch_job
+
+
+class _RecordAction(argparse.Action):
+    """Track explicitly-passed flags so config-file values don't override
+    them (reference override-actions, ``run/run.py:337-393``)."""
+
+    def __call__(self, parser, namespace, values, option_string=None):
+        if not hasattr(namespace, "_explicit_args"):
+            namespace._explicit_args = set()
+        namespace._explicit_args.add(self.dest)
+        setattr(
+            namespace,
+            self.dest,
+            True if self.nargs == 0 and values in (None, []) else values,
+        )
+
+
+class _RecordStore(_RecordAction):
+    pass
+
+
+class _RecordTrue(_RecordAction):
+    def __init__(self, *a, **kw):
+        kw["nargs"] = 0
+        super().__init__(*a, **kw)
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="horovodrun", description="Launch a horovod_tpu training job."
+    )
+    p.add_argument("-v", "--version", action="store_true", dest="version")
+    p.add_argument("-np", "--num-proc", type=int, dest="np", default=None,
+                   help="number of host processes (defaults to number of -H hosts)")
+    group_hosts = p.add_mutually_exclusive_group()
+    group_hosts.add_argument("-H", "--hosts", dest="hosts", default=None,
+                             help="host1:chips,host2:chips")
+    group_hosts.add_argument("--hostfile", dest="hostfile", default=None)
+    p.add_argument("--output-filename", dest="output_filename", default=None,
+                   help="per-rank stdout/stderr capture directory")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--config-file", dest="config_file", default=None)
+    p.add_argument("--start-port", type=int, dest="start_port", default=0,
+                   help="rendezvous port (0 = ephemeral)")
+
+    tune = p.add_argument_group("tuneable parameter arguments")
+    tune.add_argument("--fusion-threshold-mb", type=float, action=_RecordStore,
+                      dest="fusion_threshold_mb", default=None)
+    tune.add_argument("--cycle-time-ms", type=float, action=_RecordStore,
+                      dest="cycle_time_ms", default=None)
+    tune.add_argument("--cache-capacity", type=int, action=_RecordStore,
+                      dest="cache_capacity", default=None)
+    tune.add_argument("--hierarchical-allreduce", action=_RecordTrue,
+                      dest="hierarchical_allreduce", default=None)
+    tune.add_argument("--hierarchical-allgather", action=_RecordTrue,
+                      dest="hierarchical_allgather", default=None)
+
+    at = p.add_argument_group("autotune arguments")
+    at.add_argument("--autotune", action=_RecordTrue, dest="autotune", default=False)
+    at.add_argument("--autotune-log-file", action=_RecordStore,
+                    dest="autotune_log_file", default=None)
+    at.add_argument("--autotune-warmup-samples", type=int, action=_RecordStore,
+                    dest="autotune_warmup_samples", default=None)
+    at.add_argument("--autotune-steps-per-sample", type=int, action=_RecordStore,
+                    dest="autotune_steps_per_sample", default=None)
+
+    tl = p.add_argument_group("timeline arguments")
+    tl.add_argument("--timeline-filename", action=_RecordStore,
+                    dest="timeline_filename", default=None)
+    tl.add_argument("--timeline-mark-cycles", action=_RecordTrue,
+                    dest="timeline_mark_cycles", default=False)
+
+    st = p.add_argument_group("stall check arguments")
+    st.add_argument("--no-stall-check", action=_RecordTrue,
+                    dest="no_stall_check", default=False)
+    st.add_argument("--stall-check-warning-time-seconds", type=int,
+                    action=_RecordStore,
+                    dest="stall_check_warning_time_seconds", default=None)
+    st.add_argument("--stall-check-shutdown-time-seconds", type=int,
+                    action=_RecordStore,
+                    dest="stall_check_shutdown_time_seconds", default=None)
+
+    lg = p.add_argument_group("logging arguments")
+    lg.add_argument("--log-level", action=_RecordStore, dest="log_level",
+                    default=None,
+                    choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR", "FATAL"])
+
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command to launch")
+    args = p.parse_args(argv)
+    if not hasattr(args, "_explicit_args"):
+        args._explicit_args = set()
+    return args
+
+
+def _run(args: argparse.Namespace) -> int:
+    if args.version:
+        import horovod_tpu
+
+        print(horovod_tpu.__version__)
+        return 0
+    if not args.command:
+        raise SystemExit("horovodrun: no command specified")
+    config_parser.apply_config_file(args, args.config_file)
+    host_specs = parse_hosts(args.hosts, args.hostfile)
+    if args.np is not None:
+        if args.hosts is None and args.hostfile is None:
+            host_specs = [host_specs[0]] * 0 or [
+                type(host_specs[0])("localhost", 0)
+            ]
+        if len(host_specs) not in (args.np, 1):
+            raise SystemExit(
+                f"horovodrun: -np {args.np} does not match {len(host_specs)} hosts"
+            )
+        if len(host_specs) == 1 and args.np > 1:
+            host_specs = host_specs * args.np
+    env = dict(os.environ)
+    config_parser.set_env_from_args(env, args)
+    if args.verbose:
+        print(f"horovodrun: launching on {len(host_specs)} host(s)")
+    return launch_job(
+        args.command,
+        host_specs,
+        env=env,
+        output_filename=args.output_filename,
+        coordinator_port=args.start_port,
+    )
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> None:
+    sys.exit(_run(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    run_commandline()

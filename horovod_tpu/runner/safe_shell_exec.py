@@ -1,0 +1,100 @@
+"""Process-group spawn with guaranteed cleanup.
+
+Reference: ``run/common/util/safe_shell_exec.py`` — spawn in a fresh
+process group, forward signals, kill the whole group on termination so no
+orphan ranks survive a failed launch (``gloo_run.py:201`` SIGTERM path).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+from typing import Dict, IO, List, Optional
+
+GRACEFUL_TERMINATION_TIME_S = 5
+
+
+def _tee(stream: IO[bytes], sinks: List[IO], prefix: bytes) -> None:
+    for line in iter(stream.readline, b""):
+        for sink in sinks:
+            try:
+                buf = getattr(sink, "buffer", sink)
+                buf.write(prefix + line)
+                sink.flush()
+            except Exception:
+                pass
+    stream.close()
+
+
+def execute(
+    command,
+    env: Optional[Dict[str, str]] = None,
+    stdout: Optional[IO] = None,
+    stderr: Optional[IO] = None,
+    prefix: Optional[str] = None,
+    events: Optional[List[threading.Event]] = None,
+) -> int:
+    """Run command in its own process group; tee output with an optional
+    rank prefix (the reference's ``--tag-output`` behavior); kill the group
+    if any event in ``events`` fires."""
+    proc = subprocess.Popen(
+        command,
+        env=env,
+        shell=isinstance(command, str),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        preexec_fn=os.setsid,
+    )
+
+    p = (prefix.encode() if prefix else b"")
+    threads = [
+        threading.Thread(
+            target=_tee, args=(proc.stdout, [stdout or sys.stdout], p), daemon=True
+        ),
+        threading.Thread(
+            target=_tee, args=(proc.stderr, [stderr or sys.stderr], p), daemon=True
+        ),
+    ]
+    for t in threads:
+        t.start()
+
+    stop = threading.Event()
+
+    def _watch():
+        while not stop.wait(0.1):
+            if any(e.is_set() for e in (events or [])):
+                terminate_process_group(proc)
+                return
+
+    watcher = threading.Thread(target=_watch, daemon=True)
+    watcher.start()
+    try:
+        ret = proc.wait()
+    finally:
+        stop.set()
+        watcher.join(timeout=1)
+        for t in threads:
+            t.join(timeout=1)
+        if proc.poll() is None:
+            terminate_process_group(proc)
+    return ret
+
+
+def terminate_process_group(proc: subprocess.Popen) -> None:
+    try:
+        pgid = os.getpgid(proc.pid)
+    except ProcessLookupError:
+        return
+    try:
+        os.killpg(pgid, signal.SIGTERM)
+        try:
+            proc.wait(timeout=GRACEFUL_TERMINATION_TIME_S)
+            return
+        except subprocess.TimeoutExpired:
+            pass
+        os.killpg(pgid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass

@@ -105,6 +105,49 @@ def make_train_step(
     return jax.jit(sharded, donate_argnums=donate_argnums)
 
 
+def make_gspmd_train_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    *,
+    mesh,
+    param_spec,
+    batch_spec,
+    donate: bool = True,
+):
+    """Build a train step in GSPMD style: parameters/batch carry
+    NamedShardings over an N-D mesh (dp/fsdp/tp/sp/pp/ep — see
+    :mod:`horovod_tpu.parallel.meshes`), and XLA's sharding propagation
+    inserts every collective — gradient psums over dp/fsdp, tp
+    all-gathers/reduce-scatters, sp/pp permutes.
+
+    This is the second (TPU-idiomatic) face of the framework: where
+    :func:`make_train_step` expresses Horovod's explicit-collective
+    programming model, this one expresses "pick a mesh, annotate shardings,
+    let XLA insert collectives" for arbitrary multi-axis parallelism the
+    reference never had (SURVEY.md §2.6 extensions).
+    """
+    p_shard = jax.tree_util.tree_map(
+        lambda s: jax.sharding.NamedSharding(mesh, s), param_spec
+    )
+    b_shard = jax.tree_util.tree_map(
+        lambda s: jax.sharding.NamedSharding(mesh, s), batch_spec
+    )
+    repl = jax.sharding.NamedSharding(mesh, P())
+
+    def _step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return jax.jit(
+        _step,
+        in_shardings=(p_shard, None, b_shard),
+        out_shardings=(p_shard, None, repl),
+        donate_argnums=(0, 1) if donate else (),
+    )
+
+
 def init_replicated(params, mesh=None):
     """Place a pytree replicated across the mesh (host → devices)."""
     mesh = mesh or basics.mesh()

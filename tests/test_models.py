@@ -1,0 +1,187 @@
+"""Model zoo + GSPMD multi-axis sharding tests (the dryrun_multichip path:
+dp/tp/sp/pp/ep over the 8-device test mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import spmd
+from horovod_tpu.models import mlp, transformer as T
+from horovod_tpu.parallel.meshes import MeshSpec, infer_spec, make_mesh
+
+
+class TestMLP:
+    def test_forward_and_loss(self):
+        params = mlp.init_params(jax.random.PRNGKey(0), (16, 8, 4))
+        x = np.random.randn(5, 16).astype(np.float32)
+        y = np.random.randint(0, 4, (5,))
+        logits = mlp.forward(params, x)
+        assert logits.shape == (5, 4)
+        loss = mlp.loss_fn(params, (x, y))
+        assert np.isfinite(float(loss))
+        acc = mlp.accuracy(params, (x, y))
+        assert 0.0 <= float(acc) <= 1.0
+
+    def test_trains_with_distributed_optimizer(self):
+        params = mlp.init_params(jax.random.PRNGKey(0), (8, 16, 2))
+        rng = np.random.RandomState(0)
+        x = rng.randn(64, 8).astype(np.float32)
+        y = (x.sum(axis=1) > 0).astype(np.int32)
+        opt = hvd.DistributedOptimizer(optax.adam(0.01))
+        step = spmd.make_train_step(mlp.loss_fn, opt)
+        st = opt.init(params)
+        losses = []
+        for _ in range(40):
+            params, st, loss = step(params, st, (x, y))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5
+
+
+class TestTransformer:
+    CFG = T.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=16
+    )
+
+    def test_forward_shapes(self):
+        params = T.init_params(jax.random.PRNGKey(0), self.CFG)
+        batch = T.synthetic_batch(0, self.CFG, batch=2)
+        logits = T.forward(params, batch["tokens"], self.CFG)
+        assert logits.shape == (2, 16, 64)
+        assert logits.dtype == jnp.float32
+
+    def test_causality(self):
+        """Changing a future token must not affect earlier logits."""
+        params = T.init_params(jax.random.PRNGKey(0), self.CFG)
+        batch = T.synthetic_batch(0, self.CFG, batch=1)
+        toks = np.asarray(batch["tokens"]).copy()
+        l1 = np.asarray(T.forward(params, jnp.asarray(toks), self.CFG))
+        toks2 = toks.copy()
+        toks2[0, -1] = (toks2[0, -1] + 1) % 64
+        l2 = np.asarray(T.forward(params, jnp.asarray(toks2), self.CFG))
+        np.testing.assert_allclose(l1[0, :-1], l2[0, :-1], atol=1e-4)
+        assert np.abs(l1[0, -1] - l2[0, -1]).max() > 1e-6
+
+    def test_loss_finite_and_decreases(self):
+        cfg = self.CFG
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        batch = T.synthetic_batch(0, cfg, batch=4)
+        opt = optax.adam(1e-2)
+        st = opt.init(params)
+
+        @jax.jit
+        def step(p, s, b):
+            loss, g = jax.value_and_grad(lambda p: T.loss_fn(p, b, cfg))(p)
+            u, s = opt.update(g, s, p)
+            return optax.apply_updates(p, u), s, loss
+
+        losses = []
+        for _ in range(15):
+            params, st, loss = step(params, st, batch)
+            losses.append(float(loss))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_moe_forward(self):
+        cfg = T.TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=16, n_experts=4,
+        )
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        assert "router" in params["layers"]
+        batch = T.synthetic_batch(0, cfg, batch=2)
+        logits = T.forward(params, batch["tokens"], cfg)
+        assert np.isfinite(np.asarray(logits)).all()
+
+
+class TestGSPMDShardedStep:
+    def test_dp_tp_sp_step(self):
+        """Full train step over a (dp=2, sp=2, tp=2) mesh with real
+        parameter/activation shardings — the dryrun_multichip path."""
+        spec = infer_spec(8, tp=2, sp=2)
+        mesh = make_mesh(spec)
+        cfg = T.TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=16, n_experts=2,
+        )
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        batch = T.synthetic_batch(0, cfg, batch=4, seq=16)
+        opt = optax.sgd(1e-2)
+        step = spmd.make_gspmd_train_step(
+            lambda p, b: T.loss_fn(p, b, cfg),
+            opt,
+            mesh=mesh,
+            param_spec=T.param_specs(cfg),
+            batch_spec=T.batch_specs(),
+            donate=False,
+        )
+        p2, _, loss = step(params, opt.init(params), batch)
+        assert np.isfinite(float(loss))
+        # sharded params actually changed
+        d = np.abs(np.asarray(p2["embed"]) - np.asarray(params["embed"])).max()
+        assert d > 0
+
+    def test_sharded_matches_unsharded(self):
+        """The GSPMD-sharded step computes the same numbers as a plain
+        single-device step (collective insertion is semantics-preserving)."""
+        spec = infer_spec(8, tp=2, sp=2)
+        mesh = make_mesh(spec)
+        cfg = T.TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=16
+        )
+        params = T.init_params(jax.random.PRNGKey(1), cfg)
+        batch = T.synthetic_batch(1, cfg, batch=4, seq=16)
+        opt = optax.sgd(1e-1)
+        step = spmd.make_gspmd_train_step(
+            lambda p, b: T.loss_fn(p, b, cfg),
+            opt,
+            mesh=mesh,
+            param_spec=T.param_specs(cfg),
+            batch_spec=T.batch_specs(),
+            donate=False,
+        )
+        p_sharded, _, loss_sharded = step(params, opt.init(params), batch)
+
+        loss_ref, g = jax.value_and_grad(lambda p: T.loss_fn(p, batch, cfg))(params)
+        u, _ = opt.update(g, opt.init(params), params)
+        p_ref = optax.apply_updates(params, u)
+        np.testing.assert_allclose(
+            float(loss_sharded), float(loss_ref), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(p_sharded["head"]), np.asarray(p_ref["head"]),
+            rtol=5e-3, atol=1e-4,
+        )
+
+    def test_mesh_spec_validation(self):
+        with pytest.raises(ValueError):
+            infer_spec(8, tp=3)
+        with pytest.raises(ValueError):
+            make_mesh(MeshSpec(dp=16))
+
+
+class TestGraftEntry:
+    def test_entry_compiles(self):
+        import importlib.util, pathlib
+
+        spec = importlib.util.spec_from_file_location(
+            "graft_entry", pathlib.Path(__file__).parent.parent / "__graft_entry__.py"
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        fn, args = mod.entry()
+        out = jax.jit(fn)(*args)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_dryrun_multichip(self, capsys):
+        import importlib.util, pathlib
+
+        spec = importlib.util.spec_from_file_location(
+            "graft_entry2", pathlib.Path(__file__).parent.parent / "__graft_entry__.py"
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.dryrun_multichip(8)
+        assert "dryrun_multichip OK" in capsys.readouterr().out

@@ -1,0 +1,253 @@
+"""Launcher tests (reference: test/test_run.py — arg parsing, config-file
+precedence, command construction with mocked exec; plus a REAL 2-process
+local launch, which the reference only gets via CI's mpirun wrapper)."""
+
+import os
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from horovod_tpu.runner import config_parser, launch, rendezvous
+from horovod_tpu.runner.hosts import HostSpec, SlotInfo, allocate, parse_hosts
+from horovod_tpu.runner.run import parse_args, _run
+
+
+class TestHostParsing:
+    def test_hosts_string(self):
+        specs = parse_hosts("a:4,b:8")
+        assert specs == [HostSpec("a", 4), HostSpec("b", 8)]
+
+    def test_host_no_slots(self):
+        assert parse_hosts("a,b") == [HostSpec("a", 1), HostSpec("b", 1)]
+
+    def test_hostfile(self, tmp_path):
+        f = tmp_path / "hosts"
+        f.write_text("# comment\nnode1 slots=4\nnode2 slots=2\n\n")
+        assert parse_hosts(hostfile=str(f)) == [
+            HostSpec("node1", 4),
+            HostSpec("node2", 2),
+        ]
+
+    def test_both_raises(self):
+        with pytest.raises(ValueError):
+            parse_hosts("a:1", "file")
+
+    def test_default_localhost(self):
+        assert parse_hosts() == [HostSpec("localhost", 0)]
+
+    def test_allocate(self):
+        slots = allocate([HostSpec("a", 4), HostSpec("b", 4)])
+        assert slots[0].rank == 0 and slots[1].rank == 1
+        assert all(s.size == 2 for s in slots)
+        assert all(s.world_chips == 8 for s in slots)
+        env = slots[1].to_env()
+        assert env["HOROVOD_RANK"] == "1"
+        assert env["HOROVOD_CROSS_SIZE"] == "2"
+        assert env["HOROVOD_LOCAL_SIZE"] == "4"
+
+
+class TestArgsAndConfig:
+    def test_basic_parse(self):
+        args = parse_args(["-np", "2", "-H", "h1:4,h2:4", "python", "train.py"])
+        assert args.np == 2
+        assert args.hosts == "h1:4,h2:4"
+        assert args.command == ["python", "train.py"]
+
+    def test_flag_groups(self):
+        args = parse_args(
+            [
+                "--fusion-threshold-mb", "32",
+                "--autotune",
+                "--timeline-filename", "/tmp/t.json",
+                "--no-stall-check",
+                "--log-level", "DEBUG",
+                "cmd",
+            ]
+        )
+        env = config_parser.set_env_from_args({}, args)
+        assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+        assert env["HOROVOD_AUTOTUNE"] == "1"
+        assert env["HOROVOD_TIMELINE"] == "/tmp/t.json"
+        assert env["HOROVOD_STALL_CHECK_DISABLE"] == "1"
+        assert env["HOROVOD_LOG_LEVEL"] == "DEBUG"
+
+    def test_config_file_and_cli_precedence(self, tmp_path):
+        """CLI flags beat config-file values (test_run.py:176-233)."""
+        cfg = tmp_path / "cfg.yaml"
+        cfg.write_text(
+            textwrap.dedent(
+                """
+                params:
+                  fusion-threshold-mb: 16
+                  cycle-time-ms: 3.5
+                autotune:
+                  enabled: true
+                  warmup-samples: 5
+                timeline:
+                  filename: /tmp/from_config.json
+                stall-check:
+                  disable: false
+                  warning-time-seconds: 120
+                """
+            )
+        )
+        args = parse_args(
+            ["--fusion-threshold-mb", "64", "--config-file", str(cfg), "cmd"]
+        )
+        config_parser.apply_config_file(args, args.config_file)
+        assert args.fusion_threshold_mb == 64.0  # CLI wins
+        assert args.cycle_time_ms == 3.5  # config applies
+        assert args.autotune is True
+        assert args.autotune_warmup_samples == 5
+        assert args.timeline_filename == "/tmp/from_config.json"
+        assert args.stall_check_warning_time_seconds == 120
+
+    def test_version(self, capsys):
+        args = parse_args(["--version"])
+        assert _run(args) == 0
+        import horovod_tpu
+
+        assert horovod_tpu.__version__ in capsys.readouterr().out
+
+    def test_no_command(self):
+        with pytest.raises(SystemExit):
+            _run(parse_args(["-np", "1"]))
+
+
+class TestRendezvous:
+    def test_kv_roundtrip(self):
+        server = rendezvous.RendezvousServer()
+        port = server.start()
+        try:
+            client = rendezvous.KVClient("127.0.0.1", port)
+            assert client.get("scope", "k") is None
+            client.put("scope", "k", b"value")
+            assert client.get("scope", "k") == b"value"
+            assert client.wait("scope", "k") == b"value"
+            client.delete_scope("scope")
+            assert client.get("scope", "k") is None
+        finally:
+            server.stop()
+
+    def test_wait_timeout(self):
+        server = rendezvous.RendezvousServer()
+        port = server.start()
+        try:
+            client = rendezvous.KVClient("127.0.0.1", port)
+            with pytest.raises(TimeoutError):
+                client.wait("s", "missing", timeout=0.3)
+        finally:
+            server.stop()
+
+    def test_concurrent_publish(self):
+        server = rendezvous.RendezvousServer()
+        port = server.start()
+        try:
+            client = rendezvous.KVClient("127.0.0.1", port)
+
+            def pub(i):
+                client.put("s", f"k{i}", str(i).encode())
+
+            ts = [threading.Thread(target=pub, args=(i,)) for i in range(8)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+            for i in range(8):
+                assert client.get("s", f"k{i}") == str(i).encode()
+        finally:
+            server.stop()
+
+
+class TestLaunch:
+    def test_command_construction_local(self):
+        slot = SlotInfo("localhost", 0, 2, 4, 8)
+        cmd, env = launch.build_command(
+            slot, ["python", "t.py"], {"PATH": "/bin"}, "127.0.0.1", 5000
+        )
+        assert cmd == ["python", "t.py"]
+        assert env["HOROVOD_RANK"] == "0"
+        assert env["HOROVOD_COORDINATOR_ADDR"] == "127.0.0.1"
+        assert env["HOROVOD_COORDINATOR_PORT"] == "5000"
+        assert env["HOROVOD_GLOO_RENDEZVOUS_PORT"] == "5000"
+
+    def test_command_construction_ssh(self):
+        slot = SlotInfo("remotehost", 1, 2, 4, 8)
+        cmd, _ = launch.build_command(
+            slot, ["python", "t.py"], {}, "10.0.0.1", 5000
+        )
+        assert cmd[0] == "ssh"
+        assert "remotehost" in cmd
+        remote = cmd[-1]
+        assert "HOROVOD_RANK=1" in remote
+        assert "python t.py" in remote
+
+    def test_mocked_launch_all_ranks(self):
+        """Reference-style mocked exec: assert each rank got the right env
+        (test_run.py:259-352 pattern)."""
+        calls = []
+
+        def fake_exec(cmd, env=None, **kw):
+            calls.append((cmd, env))
+            return 0
+
+        rc = launch.launch_job(
+            ["python", "x.py"],
+            [HostSpec("localhost", 4), HostSpec("localhost", 4)],
+            env={},
+            _executor=fake_exec,
+        )
+        assert rc == 0
+        assert len(calls) == 2
+        ranks = sorted(int(env["HOROVOD_RANK"]) for _, env in calls)
+        assert ranks == [0, 1]
+
+    def test_failure_propagates(self):
+        def fake_exec(cmd, env=None, **kw):
+            return 3 if env["HOROVOD_RANK"] == "1" else 0
+
+        rc = launch.launch_job(
+            ["x"],
+            [HostSpec("localhost", 1)] * 2,
+            env={},
+            _executor=fake_exec,
+        )
+        assert rc == 3
+
+    def test_real_two_process_launch(self, tmp_path):
+        """Actually spawn 2 local processes that rendezvous through the KV
+        server and verify each other's ranks — real end-to-end launch."""
+        script = tmp_path / "worker.py"
+        script.write_text(
+            textwrap.dedent(
+                """
+                import os, sys
+                sys.path.insert(0, os.environ["REPO"])
+                from horovod_tpu.runner.rendezvous import KVClient
+                rank = os.environ["HOROVOD_RANK"]
+                size = int(os.environ["HOROVOD_SIZE"])
+                c = KVClient(os.environ["HOROVOD_COORDINATOR_ADDR"],
+                             int(os.environ["HOROVOD_COORDINATOR_PORT"]))
+                c.put("test", f"rank{rank}", rank.encode())
+                for r in range(size):
+                    assert c.wait("test", f"rank{r}", timeout=30).decode() == str(r)
+                print(f"rank {rank} ok")
+                """
+            )
+        )
+        out = tmp_path / "out"
+        env = {
+            "PATH": os.environ.get("PATH", ""),
+            "REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            # prevent the sandbox sitecustomize from grabbing the TPU
+            "PALLAS_AXON_POOL_IPS": "",
+        }
+        rc = launch.launch_job(
+            [sys.executable, str(script)],
+            [HostSpec("localhost", 1)] * 2,
+            env=env,
+            output_filename=str(out),
+        )
+        assert rc == 0
+        assert "ok" in (out / "rank.0.stdout").read_text()
+        assert "ok" in (out / "rank.1.stdout").read_text()
