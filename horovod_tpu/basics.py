@@ -104,13 +104,28 @@ def _bootstrap_distributed() -> None:
     )
     if nproc is None or nproc <= 1:
         return
-    if jax.process_count() >= nproc:
-        return  # already initialized (e.g. by the TPU runtime itself)
+    # Must not touch the XLA backend before jax.distributed.initialize
+    # (jax.process_count() would initialize it); inspect the coordination
+    # client state directly.
+    try:
+        from jax._src import distributed as _jd
+
+        if _jd.global_state.client is not None:
+            return  # already initialized (e.g. by the TPU runtime itself)
+    except Exception:
+        if jax.process_count() >= nproc:
+            return
+    # The JAX coordination service needs its own port: the launcher's
+    # HOROVOD_COORDINATOR_PORT is the rendezvous KV server, so rank 0 binds
+    # KV+2 for the gRPC service unless HOROVOD_JAX_PORT says otherwise.
+    jax_port = os.environ.get("HOROVOD_JAX_PORT")
+    if jax_port is None:
+        base = os.environ.get("HOROVOD_COORDINATOR_PORT")
+        jax_port = str(int(base) + 2) if base else "9373"
     if addr is None:
-        port = os.environ.get("HOROVOD_COORDINATOR_PORT", "9373")
-        addr = f"127.0.0.1:{port}"
+        addr = f"127.0.0.1:{jax_port}"
     elif ":" not in addr:
-        addr = f"{addr}:{os.environ.get('HOROVOD_COORDINATOR_PORT', '9373')}"
+        addr = f"{addr}:{jax_port}"
     jax.distributed.initialize(
         coordinator_address=addr, num_processes=nproc, process_id=rank
     )
@@ -180,9 +195,24 @@ def init(
         axis_name=axis_name,
     )
 
-    # Auxiliary subsystems, env-gated exactly like the reference.
+    # Native control-plane runtime (C++): negotiation/fusion/cache/stall/
+    # timeline for the eager path.  Optional — without it eager ops run
+    # directly in program order.
+    native_rt = None
+    try:
+        from horovod_tpu import eager_runtime
+
+        native_rt = eager_runtime.start(
+            timeline_path=os.environ.get("HOROVOD_TIMELINE", "")
+        )
+    except Exception as e:  # pragma: no cover - defensive
+        logger.warning("native runtime unavailable, using direct path: %s", e)
+
+    # Auxiliary subsystems, env-gated exactly like the reference.  When the
+    # native runtime is up it owns the HOROVOD_TIMELINE file (rank 0); the
+    # Python Timeline otherwise.
     timeline_path = os.environ.get("HOROVOD_TIMELINE")
-    if timeline_path:
+    if timeline_path and native_rt is None:
         from horovod_tpu.timeline import Timeline
 
         if _context.process_rank == 0:  # rank 0 writes, like the reference
@@ -206,6 +236,12 @@ def shutdown() -> None:
     global _context
     if _context is None:
         return
+    try:
+        from horovod_tpu import eager_runtime
+
+        eager_runtime.stop()
+    except Exception:  # pragma: no cover - defensive
+        pass
     if _context.timeline is not None:
         _context.timeline.close()
     _context = None

@@ -1,0 +1,255 @@
+"""Eager-path runtime: Python side of the native control plane.
+
+Wires the native library (:mod:`horovod_tpu.native` — negotiation, fusion
+planning, response cache, stall inspection, timeline) to the JAX eager data
+plane (:mod:`horovod_tpu.ops.collectives` ``_eager_*`` implementations).
+
+Division of labor, mirroring the reference's architecture
+(``common/operations.cc`` background loop -> ``ops/*`` execution):
+
+* Python enqueues a named request per eager collective and blocks on a
+  handle (the reference's framework-binding role,
+  ``torch/mpi_ops_v2.cc:52-79``).
+* The native background thread negotiates global readiness each cycle and
+  calls back into :meth:`EagerRuntime._execute` with a (possibly fused)
+  Response (the reference's ``PerformOperation``,
+  ``common/operations.cc:295``).
+* ``_execute`` runs the collective as an XLA program over the process mesh
+  and parks results until the waiting caller collects them.
+
+A rank that has Joined keeps executing responses with zero-filled inputs
+(the reference's zero-tensor substitution, ``global_state.h:104-107``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from horovod_tpu import basics
+
+try:
+    from horovod_tpu import native
+except Exception:  # pragma: no cover - native package always importable
+    native = None  # type: ignore
+
+_OP_TO_NATIVE = {}
+_NATIVE_TO_OP = {}
+
+
+def _op_maps():
+    from horovod_tpu.ops import collectives as C
+
+    global _OP_TO_NATIVE, _NATIVE_TO_OP
+    if not _OP_TO_NATIVE:
+        _OP_TO_NATIVE = {
+            C.Average: native.OP_AVERAGE,
+            C.Sum: native.OP_SUM,
+            C.Adasum: native.OP_ADASUM,
+            C.Min: native.OP_MIN,
+            C.Max: native.OP_MAX,
+            C.Product: native.OP_PRODUCT,
+        }
+        _NATIVE_TO_OP = {v: k for k, v in _OP_TO_NATIVE.items()}
+    return _OP_TO_NATIVE, _NATIVE_TO_OP
+
+
+class CollectiveError(RuntimeError):
+    """A collective failed — coordinator-detected mismatch, stall shutdown,
+    or abort (reference: Response::ERROR delivered to the status callback)."""
+
+
+class EagerRuntime:
+    def __init__(self, rt: "native.NativeRuntime") -> None:
+        self._rt = rt
+        self._lock = threading.Lock()
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._results: Dict[str, Any] = {}
+        self._counters = {k: itertools.count() for k in
+                          ("allreduce", "allgather", "broadcast", "alltoall",
+                           "barrier")}
+        rt.set_executor(self._execute)
+
+    # ---- naming (reference: "allreduce.noname.N" convention in the torch
+    # binding when no name is given; deterministic because every rank issues
+    # eager ops in the same program order) --------------------------------
+
+    def auto_name(self, kind: str, name: Optional[str]) -> str:
+        if name:
+            return name
+        return f"{kind}.noname.{next(self._counters[kind])}"
+
+    # ---- submission ------------------------------------------------------
+
+    def submit(self, name: str, op_type: int, x: np.ndarray, *,
+               reduce_op: int = 0, root_rank: int = 0,
+               prescale: float = 1.0, postscale: float = 1.0) -> int:
+        with self._lock:
+            if name in self._inputs:
+                raise CollectiveError(
+                    f"tensor name {name!r} already pending (duplicate "
+                    "submission race — reference DUPLICATE_NAME_ERROR)")
+            self._inputs[name] = x
+        try:
+            return self._rt.enqueue(
+                name, op_type, tuple(x.shape), x.dtype,
+                reduce_op=reduce_op, root_rank=root_rank,
+                prescale=prescale, postscale=postscale)
+        except Exception:
+            with self._lock:
+                self._inputs.pop(name, None)
+            raise
+
+    def submit_barrier(self) -> int:
+        name = self.auto_name("barrier", None)
+        return self._rt.enqueue(name, native.BARRIER, (), np.dtype("uint8"))
+
+    def barrier(self) -> None:
+        h = self.submit_barrier()
+        try:
+            self._rt.wait(h)
+        except native.NativeError as e:
+            raise CollectiveError(str(e)) from e
+
+    def join(self) -> None:
+        """Block until all ranks joined (native JOIN accounting; this rank's
+        executor keeps contributing zeros meanwhile)."""
+        h = self._rt.enqueue_join()
+        self._rt.wait(h)
+
+    def poll(self, handle: int) -> bool:
+        return self._rt.poll(handle)
+
+    def wait(self, handle: int, name: str):
+        try:
+            self._rt.wait(handle)
+        except native.NativeError as e:
+            with self._lock:
+                self._inputs.pop(name, None)
+                self._results.pop(name, None)
+            raise CollectiveError(str(e)) from e
+        with self._lock:
+            self._inputs.pop(name, None)
+            if name not in self._results:
+                raise CollectiveError(f"no result produced for {name!r}")
+            return self._results.pop(name)
+
+    # ---- execution callback (native background thread) -------------------
+
+    def _execute(self, resp: "native.Response") -> int:
+        from horovod_tpu.ops import collectives as C
+
+        _, to_op = _op_maps()
+        try:
+            with self._lock:
+                inputs = []
+                mine = []  # whether this rank actually submitted each tensor
+                for tname, shape in zip(resp.tensor_names, resp.shapes):
+                    if tname in self._inputs:
+                        inputs.append(np.asarray(self._inputs[tname]))
+                        mine.append(True)
+                    else:
+                        # Joined rank: contribute zeros.
+                        inputs.append(np.zeros(
+                            shape, dtype=native.dtype_name(resp.dtype)))
+                        mine.append(False)
+
+            if resp.type == native.ALLREDUCE:
+                op = to_op[resp.op]
+                flat = (np.concatenate([a.ravel() for a in inputs])
+                        if len(inputs) > 1 else inputs[0].ravel())
+                pre = resp.prescale if resp.prescale != 1.0 else None
+                post = resp.postscale if resp.postscale != 1.0 else None
+                red = C._eager_allreduce(flat, op, pre, post)
+                off = 0
+                outs = []
+                for a in inputs:
+                    outs.append(red[off:off + a.size].reshape(a.shape))
+                    off += a.size
+            elif resp.type == native.ALLGATHER:
+                outs = [C._eager_allgather(inputs[0])]
+            elif resp.type == native.BROADCAST:
+                outs = [C._eager_broadcast(inputs[0], resp.root_rank)]
+            elif resp.type == native.ALLTOALL:
+                outs = [C._eager_alltoall(inputs[0], None)]
+            else:
+                return native.STATUS_INVALID
+
+            with self._lock:
+                for tname, out, is_mine in zip(resp.tensor_names, outs, mine):
+                    if is_mine:
+                        self._results[tname] = out
+            return native.STATUS_OK
+        except Exception:
+            import traceback
+
+            traceback.print_exc()
+            return native.STATUS_INVALID
+
+    # ---- introspection ---------------------------------------------------
+
+    def cycles(self) -> int:
+        return self._rt.cycles()
+
+    def cache_hits(self) -> int:
+        return self._rt.cache_hits()
+
+    def cache_entries(self) -> int:
+        return self._rt.cache_entries()
+
+    def shutdown(self) -> None:
+        self._rt.shutdown()
+
+
+# ---- lifecycle ---------------------------------------------------------------
+
+_runtime: Optional[EagerRuntime] = None
+_start_lock = threading.Lock()
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get("HOROVOD_NATIVE", "1") not in ("0", "false", "")
+
+
+def start(timeline_path: Optional[str] = None) -> Optional[EagerRuntime]:
+    """Start the native eager runtime for this process (idempotent).
+    Returns None when the native library is unavailable or disabled, in
+    which case eager ops use the direct (un-negotiated) path."""
+    global _runtime
+    with _start_lock:
+        if _runtime is not None:
+            return _runtime
+        if native is None or not enabled_by_env() or not native.native_built():
+            return None
+        rank = basics.process_rank()
+        size = basics.num_processes()
+        addr = os.environ.get("HOROVOD_COORDINATOR_ADDR", "127.0.0.1")
+        if ":" in addr:
+            addr = addr.split(":")[0]
+        # Distinct from the rendezvous KV port and the JAX coordination
+        # port (KV+2): the native control plane listens on KV+3.
+        port = os.environ.get("HOROVOD_NATIVE_PORT")
+        if port is None:
+            base = os.environ.get("HOROVOD_COORDINATOR_PORT")
+            port = str(int(base) + 3) if base else "9374"
+        port = int(port)
+        rt = native.NativeRuntime()
+        rt.init(rank, size, addr, port, timeline_path=timeline_path)
+        _runtime = EagerRuntime(rt)
+        return _runtime
+
+
+def get() -> Optional[EagerRuntime]:
+    return _runtime
+
+
+def stop() -> None:
+    global _runtime
+    with _start_lock:
+        if _runtime is not None:
+            _runtime.shutdown()
+            _runtime = None

@@ -53,8 +53,22 @@ def masked_average(grads, active, *, axis_name=None):
 def join() -> int:
     """Block until every process has called ``join``; returns the last
     joining worker rank (the reference returns the last joined rank so
-    callers can broadcast final state from it)."""
+    callers can broadcast final state from it).
+
+    With the native runtime this is the reference's true JOIN protocol
+    (``EnqueueJoin`` ``operations.cc:919-943``): while blocked here, other
+    ranks' allreduces proceed with this rank contributing zeros; the
+    coordinator releases everyone once all ranks joined."""
     basics._ctx()
+    from horovod_tpu import eager_runtime
+
+    rt = eager_runtime.get()
     my = np.asarray(float(basics.rank()), np.float32)
-    last = C._eager_allreduce(my, C.Max, None, None)
+    if rt is not None:
+        rt.join()
+        # Through the public (native-routed) op so launch order stays
+        # globally consistent with any still-draining async collectives.
+        last = C.allreduce(my, C.Max, name="join.last_rank")
+    else:
+        last = C._eager_allreduce(my, C.Max, None, None)
     return int(last)

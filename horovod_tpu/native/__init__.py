@@ -1,0 +1,389 @@
+"""ctypes bindings for the native control-plane runtime (libhvd_native.so).
+
+The native library is the TPU re-design of the reference's C++ core
+(``horovod/common/*`` — background thread, controller/negotiation, tensor
+queue, response cache, stall inspector, timeline).  It owns *coordination*:
+which eager collectives are globally ready, in what order, fused how.  It
+never touches tensor bytes — execution of each negotiated (fused) response
+is delegated back to Python through :func:`set_executor`, where the
+collective runs as an XLA program on the TPU data plane.
+
+Loading mirrors the reference's ctypes extension loading
+(``horovod/common/util.py:check_extension``): the shared library is built
+from the in-tree sources with ``make`` on first use if missing or stale.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libhvd_native.so")
+
+# --- enums, mirroring src/common.h -------------------------------------------
+
+ALLREDUCE, ALLGATHER, BROADCAST, JOIN, ALLTOALL, BARRIER = range(6)
+RESP_ERROR = 6
+
+OP_AVERAGE, OP_SUM, OP_ADASUM, OP_MIN, OP_MAX, OP_PRODUCT = range(6)
+
+_DTYPE_NAMES = [
+    "uint8", "int8", "uint16", "int16", "int32", "int64",
+    "float16", "float32", "float64", "bool", "bfloat16",
+]
+_DTYPE_TO_ENUM = {n: i for i, n in enumerate(_DTYPE_NAMES)}
+
+STATUS_OK = 0
+STATUS_ABORTED = 1
+STATUS_INVALID = 2
+STATUS_SHUTDOWN = 3
+STATUS_DUPLICATE = 4
+
+
+def dtype_enum(np_dtype) -> int:
+    name = str(np_dtype)
+    if name not in _DTYPE_TO_ENUM:
+        raise TypeError(f"dtype {name!r} is not supported by the native runtime")
+    return _DTYPE_TO_ENUM[name]
+
+
+def dtype_name(enum_val: int) -> str:
+    return _DTYPE_NAMES[enum_val]
+
+
+# --- build + load ------------------------------------------------------------
+
+_load_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    src_dir = os.path.join(_DIR, "src")
+    for f in os.listdir(src_dir):
+        if os.path.getmtime(os.path.join(src_dir, f)) > lib_mtime:
+            return True
+    return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_error
+    with _load_lock:
+        if _lib is not None:
+            return _lib
+        if _build_error is not None:
+            return None
+        if _needs_build():
+            # Serialize across processes: N ranks launched together must not
+            # race `make` rewriting the .so while others dlopen it.
+            import fcntl
+
+            lock_path = os.path.join(_DIR, ".build.lock")
+            try:
+                with open(lock_path, "w") as lock_f:
+                    fcntl.flock(lock_f, fcntl.LOCK_EX)
+                    try:
+                        if _needs_build():  # re-check under the lock
+                            subprocess.run(
+                                ["make", "-s"], cwd=_DIR, check=True,
+                                capture_output=True, text=True,
+                            )
+                    finally:
+                        fcntl.flock(lock_f, fcntl.LOCK_UN)
+            except (subprocess.CalledProcessError, OSError) as e:
+                _build_error = getattr(e, "stderr", str(e)) or str(e)
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as e:
+            _build_error = str(e)
+            return None
+        _declare(lib)
+        _lib = lib
+        return lib
+
+
+_EXECUTE_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int, ctypes.POINTER(ctypes.c_ubyte), ctypes.c_int
+)
+
+
+def _declare(lib: ctypes.CDLL) -> None:
+    lib.hvd_init.restype = ctypes.c_int
+    lib.hvd_init.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double, ctypes.c_longlong, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int,
+    ]
+    lib.hvd_shutdown.restype = None
+    lib.hvd_is_initialized.restype = ctypes.c_int
+    lib.hvd_set_execute_callback.restype = None
+    lib.hvd_set_execute_callback.argtypes = [_EXECUTE_FN]
+    lib.hvd_enqueue.restype = ctypes.c_longlong
+    lib.hvd_enqueue.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double,
+    ]
+    lib.hvd_enqueue_join.restype = ctypes.c_longlong
+    lib.hvd_poll.restype = ctypes.c_int
+    lib.hvd_poll.argtypes = [ctypes.c_longlong]
+    lib.hvd_wait.restype = ctypes.c_int
+    lib.hvd_wait.argtypes = [ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int]
+    lib.hvd_cycles.restype = ctypes.c_longlong
+    lib.hvd_cache_hits.restype = ctypes.c_longlong
+    lib.hvd_cache_entries.restype = ctypes.c_longlong
+    lib.hvd_set_fusion_bytes.restype = None
+    lib.hvd_set_fusion_bytes.argtypes = [ctypes.c_longlong]
+
+
+def native_built() -> bool:
+    """True if the native library is available (built or buildable)."""
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+# --- response wire parsing (src/message.cc Response::Serialize) --------------
+
+
+@dataclass
+class Response:
+    type: int
+    op: int
+    dtype: int
+    tensor_names: List[str] = field(default_factory=list)
+    shapes: List[tuple] = field(default_factory=list)
+    root_rank: int = 0
+    prescale: float = 1.0
+    postscale: float = 1.0
+    error: str = ""
+    joined_ranks: List[int] = field(default_factory=list)
+
+
+class _Reader:
+    def __init__(self, buf: bytes) -> None:
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        b = self.buf[self.pos : self.pos + n]
+        if len(b) != n:
+            raise ValueError("truncated native response")
+        self.pos += n
+        return b
+
+    def u8(self) -> int:
+        return self.take(1)[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self.take(4))[0]
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self.take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack("<q", self.take(8))[0]
+
+    def f64(self) -> float:
+        return struct.unpack("<d", self.take(8))[0]
+
+    def str_(self) -> str:
+        return self.take(self.u32()).decode("utf-8")
+
+    def shape(self) -> tuple:
+        return tuple(self.i64() for _ in range(self.u32()))
+
+
+def parse_response(buf: bytes) -> Response:
+    r = _Reader(buf)
+    resp = Response(type=r.u8(), op=r.u8(), dtype=r.u8())
+    n = r.u32()
+    for _ in range(n):
+        resp.tensor_names.append(r.str_())
+        resp.shapes.append(r.shape())
+    resp.root_rank = r.i32()
+    resp.prescale = r.f64()
+    resp.postscale = r.f64()
+    resp.error = r.str_()
+    nj = r.u32()
+    resp.joined_ranks = [r.i32() for _ in range(nj)]
+    return resp
+
+
+# --- runtime wrapper ----------------------------------------------------------
+
+
+class NativeError(RuntimeError):
+    def __init__(self, code: int, reason: str) -> None:
+        super().__init__(reason or f"native status {code}")
+        self.code = code
+
+
+class NativeRuntime:
+    """Owns the native runtime lifecycle for this process."""
+
+    def __init__(self) -> None:
+        self._lib = _load()
+        if self._lib is None:
+            raise RuntimeError(
+                f"native runtime unavailable: {_build_error}"
+            )
+        self._cb_ref = None  # keep the CFUNCTYPE object alive
+        self._initialized = False
+
+    def init(
+        self,
+        rank: int,
+        size: int,
+        coordinator_addr: str = "127.0.0.1",
+        coordinator_port: int = 9374,
+        *,
+        connect_timeout_sec: float = 60.0,
+        cycle_time_ms: Optional[float] = None,
+        fusion_threshold_bytes: Optional[int] = None,
+        cache_capacity: Optional[int] = None,
+        stall_warn_sec: Optional[float] = None,
+        stall_shutdown_sec: Optional[float] = None,
+        timeline_path: Optional[str] = None,
+        timeline_mark_cycles: Optional[bool] = None,
+    ) -> None:
+        """Start the background runtime.  Unset knobs fall back to the same
+        ``HOROVOD_*`` env vars the reference parses in BackgroundThreadLoop
+        (``common/operations.cc:392-489``)."""
+        env = os.environ.get
+
+        def _f(v, env_name, default, cast):
+            if v is not None:
+                return v
+            raw = env(env_name)
+            return cast(raw) if raw not in (None, "") else default
+
+        cycle_time_ms = _f(cycle_time_ms, "HOROVOD_CYCLE_TIME", 1.0, float)
+        fusion_threshold_bytes = _f(
+            fusion_threshold_bytes, "HOROVOD_FUSION_THRESHOLD", 64 << 20, int
+        )
+        cache_capacity = _f(cache_capacity, "HOROVOD_CACHE_CAPACITY", 1024, int)
+        stall_warn_sec = _f(
+            stall_warn_sec, "HOROVOD_STALL_CHECK_TIME_SECONDS", 60.0, float
+        )
+        stall_shutdown_sec = _f(
+            stall_shutdown_sec, "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", 0.0, float
+        )
+        if timeline_path is None:
+            timeline_path = env("HOROVOD_TIMELINE", "")
+        if timeline_mark_cycles is None:
+            timeline_mark_cycles = env("HOROVOD_TIMELINE_MARK_CYCLES", "0") not in (
+                "", "0", "false",
+            )
+
+        err = ctypes.create_string_buffer(1024)
+        rc = self._lib.hvd_init(
+            rank, size, coordinator_addr.encode(), coordinator_port,
+            connect_timeout_sec, cycle_time_ms, fusion_threshold_bytes,
+            cache_capacity, stall_warn_sec, stall_shutdown_sec,
+            timeline_path.encode(), 1 if timeline_mark_cycles else 0,
+            err, len(err),
+        )
+        if rc != 0:
+            raise RuntimeError(
+                f"native init failed: {err.value.decode(errors='replace')}"
+            )
+        self._initialized = True
+
+    def set_executor(self, fn: Callable[[Response], int]) -> None:
+        """Register the Python executor.  ``fn`` receives a parsed
+        :class:`Response` and returns a STATUS_* code; it runs on the native
+        background thread."""
+
+        def _trampoline(buf_ptr, length):
+            try:
+                raw = bytes(
+                    ctypes.cast(
+                        buf_ptr, ctypes.POINTER(ctypes.c_ubyte * length)
+                    ).contents
+                )
+                return int(fn(parse_response(raw)))
+            except Exception:
+                import traceback
+
+                traceback.print_exc()
+                return STATUS_INVALID
+
+        self._cb_ref = _EXECUTE_FN(_trampoline)
+        self._lib.hvd_set_execute_callback(self._cb_ref)
+
+    def enqueue(
+        self,
+        name: str,
+        op_type: int,
+        shape: tuple,
+        np_dtype,
+        *,
+        reduce_op: int = OP_SUM,
+        root_rank: int = 0,
+        prescale: float = 1.0,
+        postscale: float = 1.0,
+    ) -> int:
+        arr = (ctypes.c_longlong * len(shape))(*shape)
+        h = self._lib.hvd_enqueue(
+            name.encode(), op_type, reduce_op, dtype_enum(np_dtype), arr,
+            len(shape), root_rank, prescale, postscale,
+        )
+        if h == -1:
+            raise NativeError(
+                STATUS_DUPLICATE,
+                f"A tensor named {name!r} was already submitted and is "
+                "pending — this indicates two concurrent collective calls "
+                "reused a name (reference DUPLICATE_NAME_ERROR).",
+            )
+        if h < 0:
+            raise NativeError(STATUS_ABORTED, "native runtime not initialized")
+        return int(h)
+
+    def enqueue_join(self) -> int:
+        h = self._lib.hvd_enqueue_join()
+        if h < 0:
+            raise NativeError(STATUS_ABORTED, "join enqueue failed")
+        return int(h)
+
+    def poll(self, handle: int) -> bool:
+        return bool(self._lib.hvd_poll(handle))
+
+    def wait(self, handle: int) -> None:
+        """Block until completion; raise NativeError on failure."""
+        err = ctypes.create_string_buffer(1024)
+        rc = self._lib.hvd_wait(handle, err, len(err))
+        if rc != STATUS_OK:
+            raise NativeError(rc, err.value.decode(errors="replace"))
+
+    # introspection (used by tests and the autotuner)
+    def cycles(self) -> int:
+        return int(self._lib.hvd_cycles())
+
+    def cache_hits(self) -> int:
+        return int(self._lib.hvd_cache_hits())
+
+    def cache_entries(self) -> int:
+        return int(self._lib.hvd_cache_entries())
+
+    def set_fusion_bytes(self, b: int) -> None:
+        self._lib.hvd_set_fusion_bytes(b)
+
+    def shutdown(self) -> None:
+        if self._initialized:
+            self._lib.hvd_shutdown()
+            self._initialized = False

@@ -1,0 +1,100 @@
+// C API exported to the host language over ctypes.
+//
+// Plays the role of the reference's C exports (horovod/common/operations.cc:
+// 650-788 horovod_init/... and 792-943 EnqueueTensorAllreduce/...) with a
+// handle-based completion model like the PyTorch binding's HandleManager
+// (horovod/torch/handle_manager.cc:21-55): enqueue returns a handle, the
+// host polls/waits it; the actual collective execution is delegated back to
+// the host through hvd_set_execute_callback.
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "message.h"
+#include "runtime.h"
+
+using hvd::Request;
+using hvd::Runtime;
+
+namespace {
+void CopyErr(const std::string& s, char* buf, int len) {
+  if (buf && len > 0) {
+    std::strncpy(buf, s.c_str(), static_cast<size_t>(len) - 1);
+    buf[len - 1] = '\0';
+  }
+}
+}  // namespace
+
+extern "C" {
+
+int hvd_init(int rank, int size, const char* coordinator_addr,
+             int coordinator_port, double connect_timeout_sec,
+             double cycle_time_ms, long long fusion_threshold_bytes,
+             int cache_capacity, double stall_warn_sec,
+             double stall_shutdown_sec, const char* timeline_path,
+             int timeline_mark_cycles, char* err_buf, int err_len) {
+  hvd::RuntimeOptions opts;
+  opts.rank = rank;
+  opts.size = size;
+  opts.coordinator_addr = coordinator_addr ? coordinator_addr : "127.0.0.1";
+  opts.coordinator_port = coordinator_port;
+  opts.connect_timeout_sec = connect_timeout_sec;
+  opts.cycle_time_ms = cycle_time_ms;
+  opts.fusion_threshold_bytes = fusion_threshold_bytes;
+  opts.cache_capacity = cache_capacity;
+  opts.stall_warn_sec = stall_warn_sec;
+  opts.stall_shutdown_sec = stall_shutdown_sec;
+  opts.timeline_path = timeline_path ? timeline_path : "";
+  opts.timeline_mark_cycles = timeline_mark_cycles != 0;
+  std::string err;
+  if (!Runtime::Get().Init(opts, &err)) {
+    CopyErr(err, err_buf, err_len);
+    return -1;
+  }
+  return 0;
+}
+
+void hvd_shutdown() { Runtime::Get().Shutdown(); }
+
+int hvd_is_initialized() { return Runtime::Get().initialized() ? 1 : 0; }
+
+void hvd_set_execute_callback(hvd::ExecuteFn fn) {
+  Runtime::Get().set_execute_fn(fn);
+}
+
+// type/op/dtype use the enum values in common.h; shape is an int64 array.
+long long hvd_enqueue(const char* name, int type, int reduce_op, int dtype,
+                      const long long* shape, int ndim, int root_rank,
+                      double prescale, double postscale) {
+  Request req;
+  req.name = name ? name : "";
+  req.type = static_cast<hvd::ReqType>(type);
+  req.op = static_cast<hvd::ReduceOp>(reduce_op);
+  req.dtype = static_cast<hvd::DType>(dtype);
+  req.root_rank = root_rank;
+  req.prescale = prescale;
+  req.postscale = postscale;
+  req.shape.assign(shape, shape + ndim);
+  return Runtime::Get().Enqueue(req);
+}
+
+long long hvd_enqueue_join() { return Runtime::Get().EnqueueJoin(); }
+
+int hvd_poll(long long handle) {
+  return Runtime::Get().Poll(handle) ? 1 : 0;
+}
+
+// Blocks until the handle completes; returns the StatusCode (0 = OK) and
+// fills err_buf with the failure reason when nonzero.
+int hvd_wait(long long handle, char* err_buf, int err_len) {
+  hvd::Status s = Runtime::Get().Wait(handle);
+  if (!s.ok()) CopyErr(s.reason, err_buf, err_len);
+  return static_cast<int>(s.code);
+}
+
+long long hvd_cycles() { return Runtime::Get().cycles(); }
+long long hvd_cache_hits() { return Runtime::Get().cache_hits(); }
+long long hvd_cache_entries() { return Runtime::Get().cache_entries(); }
+void hvd_set_fusion_bytes(long long b) { Runtime::Get().set_fusion_bytes(b); }
+
+}  // extern "C"

@@ -1,0 +1,268 @@
+#include "comm.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstring>
+#include <thread>
+
+namespace hvd {
+
+namespace {
+
+bool SendAll(int fd, const void* buf, size_t len) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool RecvAll(int fd, void* buf, size_t len) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+void SetNoDelay(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+}  // namespace
+
+SocketComm::~SocketComm() { Shutdown(); }
+
+void SocketComm::Shutdown() {
+  for (int fd : peer_fds_)
+    if (fd >= 0) ::close(fd);
+  peer_fds_.clear();
+  if (listen_fd_ >= 0) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+  }
+}
+
+bool SocketComm::Init(int rank, int size, const std::string& addr, int port,
+                      double timeout_sec, std::string* err) {
+  rank_ = rank;
+  size_ = size;
+  if (size <= 1) return true;
+
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::duration<double>(timeout_sec);
+
+  if (rank == 0) {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) {
+      *err = std::string("socket(): ") + strerror(errno);
+      return false;
+    }
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in sa{};
+    sa.sin_family = AF_INET;
+    sa.sin_addr.s_addr = htonl(INADDR_ANY);
+    sa.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&sa), sizeof(sa)) < 0) {
+      *err = std::string("bind(:") + std::to_string(port) + "): " + strerror(errno);
+      return false;
+    }
+    if (::listen(listen_fd_, size) < 0) {
+      *err = std::string("listen(): ") + strerror(errno);
+      return false;
+    }
+    peer_fds_.assign(size, -1);
+    for (int i = 1; i < size; ++i) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        *err = std::string("accept(): ") + strerror(errno);
+        return false;
+      }
+      SetNoDelay(fd);
+      int32_t peer_rank = -1;
+      if (!RecvAll(fd, &peer_rank, 4) || peer_rank < 1 || peer_rank >= size ||
+          peer_fds_[peer_rank] != -1) {
+        *err = "coordinator: bad rank handshake";
+        ::close(fd);
+        return false;
+      }
+      peer_fds_[peer_rank] = fd;
+    }
+  } else {
+    // Resolve coordinator address.
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    std::string port_s = std::to_string(port);
+    if (::getaddrinfo(addr.c_str(), port_s.c_str(), &hints, &res) != 0 || !res) {
+      *err = "getaddrinfo(" + addr + ") failed";
+      return false;
+    }
+    int fd = -1;
+    // Retry with backoff: the coordinator may not be listening yet.
+    for (;;) {
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) == 0) break;
+      if (fd >= 0) ::close(fd);
+      fd = -1;
+      if (std::chrono::steady_clock::now() > deadline) {
+        ::freeaddrinfo(res);
+        *err = "connect(" + addr + ":" + port_s + ") timed out";
+        return false;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    ::freeaddrinfo(res);
+    SetNoDelay(fd);
+    int32_t my_rank = rank;
+    if (!SendAll(fd, &my_rank, 4)) {
+      *err = "rank handshake send failed";
+      ::close(fd);
+      return false;
+    }
+    peer_fds_.assign(1, fd);
+  }
+  return true;
+}
+
+bool SocketComm::SendFrame(int fd, const std::vector<uint8_t>& payload,
+                           std::string* err) {
+  uint32_t len = static_cast<uint32_t>(payload.size());
+  if (!SendAll(fd, &len, 4) ||
+      (len > 0 && !SendAll(fd, payload.data(), payload.size()))) {
+    *err = std::string("send: ") + strerror(errno);
+    return false;
+  }
+  return true;
+}
+
+bool SocketComm::RecvFrame(int fd, std::vector<uint8_t>* payload, std::string* err) {
+  uint32_t len = 0;
+  if (!RecvAll(fd, &len, 4)) {
+    *err = "recv: peer closed";
+    return false;
+  }
+  payload->resize(len);
+  if (len > 0 && !RecvAll(fd, payload->data(), len)) {
+    *err = "recv: truncated frame";
+    return false;
+  }
+  return true;
+}
+
+bool SocketComm::Gather(const std::vector<uint8_t>& payload,
+                        std::vector<std::vector<uint8_t>>* out, std::string* err) {
+  out->clear();
+  if (size_ <= 1) {
+    out->push_back(payload);
+    return true;
+  }
+  if (rank_ == 0) {
+    out->resize(size_);
+    (*out)[0] = payload;
+    for (int r = 1; r < size_; ++r)
+      if (!RecvFrame(peer_fds_[r], &(*out)[r], err)) return false;
+    return true;
+  }
+  return SendFrame(peer_fds_[0], payload, err);
+}
+
+bool SocketComm::Bcast(std::vector<uint8_t>* payload, std::string* err) {
+  if (size_ <= 1) return true;
+  if (rank_ == 0) {
+    for (int r = 1; r < size_; ++r)
+      if (!SendFrame(peer_fds_[r], *payload, err)) return false;
+    return true;
+  }
+  return RecvFrame(peer_fds_[0], payload, err);
+}
+
+bool SocketComm::AllreduceBits(std::vector<uint64_t>* bits, bool is_and,
+                               std::string* err) {
+  if (size_ <= 1) return true;
+  std::vector<uint8_t> payload(bits->size() * 8);
+  std::memcpy(payload.data(), bits->data(), payload.size());
+  std::vector<std::vector<uint8_t>> gathered;
+  if (!Gather(payload, &gathered, err)) return false;
+  if (rank_ == 0) {
+    std::vector<uint64_t> acc = *bits;
+    for (int r = 1; r < size_; ++r) {
+      if (gathered[r].size() != payload.size()) {
+        *err = "bit-vector size mismatch across ranks";
+        return false;
+      }
+      const uint64_t* peer =
+          reinterpret_cast<const uint64_t*>(gathered[r].data());
+      for (size_t i = 0; i < acc.size(); ++i)
+        acc[i] = is_and ? (acc[i] & peer[i]) : (acc[i] | peer[i]);
+    }
+    std::memcpy(payload.data(), acc.data(), payload.size());
+  }
+  if (!Bcast(&payload, err)) return false;
+  std::memcpy(bits->data(), payload.data(), payload.size());
+  return true;
+}
+
+bool SocketComm::AllreduceBitsAndOr(const std::vector<uint64_t>& bits,
+                                    std::vector<uint64_t>* bits_and,
+                                    std::vector<uint64_t>* bits_or,
+                                    std::string* err) {
+  *bits_and = bits;
+  *bits_or = bits;
+  if (size_ <= 1) return true;
+  size_t nbytes = bits.size() * 8;
+  std::vector<uint8_t> payload(nbytes);
+  std::memcpy(payload.data(), bits.data(), nbytes);
+  std::vector<std::vector<uint8_t>> gathered;
+  if (!Gather(payload, &gathered, err)) return false;
+  std::vector<uint8_t> wire(2 * nbytes);
+  if (rank_ == 0) {
+    for (int r = 1; r < size_; ++r) {
+      if (gathered[r].size() != nbytes) {
+        *err = "bit-vector size mismatch across ranks";
+        return false;
+      }
+      const uint64_t* peer = reinterpret_cast<const uint64_t*>(gathered[r].data());
+      for (size_t i = 0; i < bits.size(); ++i) {
+        (*bits_and)[i] &= peer[i];
+        (*bits_or)[i] |= peer[i];
+      }
+    }
+    std::memcpy(wire.data(), bits_and->data(), nbytes);
+    std::memcpy(wire.data() + nbytes, bits_or->data(), nbytes);
+  }
+  if (!Bcast(&wire, err)) return false;
+  std::memcpy(bits_and->data(), wire.data(), nbytes);
+  std::memcpy(bits_or->data(), wire.data() + nbytes, nbytes);
+  return true;
+}
+
+bool SocketComm::Barrier(std::string* err) {
+  std::vector<uint64_t> bits(1, 0);
+  return AllreduceBits(&bits, /*is_and=*/true, err);
+}
+
+}  // namespace hvd

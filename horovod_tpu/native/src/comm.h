@@ -1,0 +1,79 @@
+// Control-plane communicator: length-prefixed messages over TCP in a star
+// topology rooted at rank 0.
+//
+// Replaces the roles MPI / Gloo play for the reference's *controller*
+// (horovod/common/mpi/mpi_controller.cc:107-199 — gatherv of ready-tensor
+// requests to rank 0 and bcast of final responses;
+// gloo/gloo_context.cc:113-160 — TCP bootstrap).  Only coordination
+// metadata flows here (tensor names/shapes, bit-vectors); tensor data rides
+// ICI/DCN inside XLA programs and never touches these sockets, so a simple
+// star is the right topology: one RTT per negotiation round, no fan-in
+// tree needed at control-plane message sizes.
+#ifndef HVD_NATIVE_COMM_H
+#define HVD_NATIVE_COMM_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+class SocketComm {
+ public:
+  SocketComm() = default;
+  ~SocketComm();
+
+  // Establish the full star: rank 0 binds/listens on port and accepts
+  // size-1 identified connections; other ranks dial addr:port with
+  // retry/backoff (the launcher may start workers before the coordinator).
+  // Returns false (with reason) on failure.  size==1 is a no-op.
+  bool Init(int rank, int size, const std::string& addr, int port,
+            double timeout_sec, std::string* err);
+  void Shutdown();
+
+  int rank() const { return rank_; }
+  int size() const { return size_; }
+
+  // Collect one byte-buffer per rank at rank 0 (reference:
+  // MPIController::RecvReadyTensors' gatherv).  At rank 0 `out` holds
+  // size entries indexed by rank (own payload included); at workers `out`
+  // is left empty.
+  bool Gather(const std::vector<uint8_t>& payload,
+              std::vector<std::vector<uint8_t>>* out, std::string* err);
+
+  // Broadcast a byte-buffer from rank 0 to everyone (reference:
+  // SendFinalTensors' bcast).  At workers `payload` is replaced by the
+  // received buffer.
+  bool Bcast(std::vector<uint8_t>* payload, std::string* err);
+
+  // Bit-vector allreduce (AND or OR) — the response-cache fast path's
+  // primitive (reference: MPIController::CrossRankBitwiseAnd/Or,
+  // mpi/mpi_controller.cc:87-105).  Implemented as gather+combine+bcast.
+  bool AllreduceBits(std::vector<uint64_t>* bits, bool is_and, std::string* err);
+
+  // Combined AND + OR of the same local vector in ONE round (the
+  // reference needs both to detect cache-bit divergence — a tensor some
+  // ranks submitted-cached and others haven't submitted at all — see
+  // CacheCoordinator::sync, response_cache.h:107-167).  On return,
+  // bits_and/bits_or hold the global AND/OR of every rank's `bits`.
+  bool AllreduceBitsAndOr(const std::vector<uint64_t>& bits,
+                          std::vector<uint64_t>* bits_and,
+                          std::vector<uint64_t>* bits_or, std::string* err);
+
+  bool Barrier(std::string* err);
+
+ private:
+  bool SendFrame(int fd, const std::vector<uint8_t>& payload, std::string* err);
+  bool RecvFrame(int fd, std::vector<uint8_t>* payload, std::string* err);
+
+  int rank_ = 0;
+  int size_ = 1;
+  int listen_fd_ = -1;
+  // rank 0: peer_fds_[r] = socket to rank r (index 0 unused).
+  // workers: peer_fds_[0] = socket to rank 0.
+  std::vector<int> peer_fds_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_NATIVE_COMM_H

@@ -1,0 +1,106 @@
+// Core shared types for the horovod_tpu native runtime.
+//
+// TPU-native re-design of the reference's common layer
+// (reference: horovod/common/common.h).  The native runtime is the CONTROL
+// plane only: it negotiates which tensors are globally ready, plans fusion,
+// caches responses, detects stalls and writes the timeline.  Tensor bytes
+// never enter this library — on TPU the data plane is XLA/PJRT and the
+// execution of a negotiated (possibly fused) collective is delegated to the
+// host language through a callback.
+#ifndef HVD_NATIVE_COMMON_H
+#define HVD_NATIVE_COMMON_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+// Request/response types (reference: horovod/common/message.h:49-60).
+enum class ReqType : uint8_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  JOIN = 3,
+  ALLTOALL = 4,
+  BARRIER = 5,
+};
+
+enum class RespType : uint8_t {
+  ALLREDUCE = 0,
+  ALLGATHER = 1,
+  BROADCAST = 2,
+  JOIN = 3,
+  ALLTOALL = 4,
+  BARRIER = 5,
+  ERROR = 6,
+};
+
+// Reduce ops (reference exposes Average/Sum/Adasum; Min/Max/Product are
+// TPU-side extensions mirrored from the Python layer).
+enum class ReduceOp : uint8_t {
+  AVERAGE = 0,
+  SUM = 1,
+  ADASUM = 2,
+  MIN = 3,
+  MAX = 4,
+  PRODUCT = 5,
+};
+
+// Dtypes, numpy-aligned (reference: DataType in common/message.h).
+enum class DType : uint8_t {
+  UINT8 = 0,
+  INT8 = 1,
+  UINT16 = 2,
+  INT16 = 3,
+  INT32 = 4,
+  INT64 = 5,
+  FLOAT16 = 6,
+  FLOAT32 = 7,
+  FLOAT64 = 8,
+  BOOL = 9,
+  BFLOAT16 = 10,
+};
+
+inline int64_t DTypeSize(DType d) {
+  switch (d) {
+    case DType::UINT8:
+    case DType::INT8:
+    case DType::BOOL:
+      return 1;
+    case DType::UINT16:
+    case DType::INT16:
+    case DType::FLOAT16:
+    case DType::BFLOAT16:
+      return 2;
+    case DType::INT32:
+    case DType::FLOAT32:
+      return 4;
+    case DType::INT64:
+    case DType::FLOAT64:
+      return 8;
+  }
+  return 1;
+}
+
+// Completion status delivered to a waiting handle (reference:
+// StatusType in common/common.h:143-151).
+enum class StatusCode : uint8_t {
+  OK = 0,
+  ABORTED = 1,
+  INVALID = 2,       // coordinator detected rank mismatch (shape/dtype/op)
+  SHUTDOWN = 3,      // runtime shut down before completion
+  DUPLICATE = 4,     // tensor name already pending (double-submission race)
+};
+
+struct Status {
+  StatusCode code = StatusCode::OK;
+  std::string reason;
+  static Status OK() { return {}; }
+  static Status Error(StatusCode c, std::string r) { return {c, std::move(r)}; }
+  bool ok() const { return code == StatusCode::OK; }
+};
+
+}  // namespace hvd
+
+#endif  // HVD_NATIVE_COMMON_H

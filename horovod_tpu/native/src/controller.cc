@@ -1,0 +1,317 @@
+#include "controller.h"
+
+#include <algorithm>
+
+namespace hvd {
+
+namespace {
+constexpr size_t kFlagBits = 2;  // bit0 = no-uncached-work, bit1 = not-joined
+
+inline void SetBit(std::vector<uint64_t>& v, size_t i) {
+  v[i / 64] |= (uint64_t{1} << (i % 64));
+}
+inline bool GetBit(const std::vector<uint64_t>& v, size_t i) {
+  return (v[i / 64] >> (i % 64)) & 1;
+}
+}  // namespace
+
+bool Controller::ComputeResponseList(std::vector<Request> pending,
+                                     bool local_join, bool want_shutdown,
+                                     ResponseList* out, std::string* err) {
+  out->responses.clear();
+  out->shutdown = false;
+
+  // ---- Cache coordination (reference controller.cc:125-193) -------------
+  // Partition pending requests into cache hits and misses, then agree
+  // globally with one bit-vector AND.
+  size_t nbits = kFlagBits + cache_.capacity();
+  std::vector<uint64_t> bits((nbits + 63) / 64, 0);
+  std::vector<Request> uncached;
+  std::vector<std::pair<size_t, Request>> cached;  // (bit, request)
+  for (auto& req : pending) {
+    if (req.type == ReqType::JOIN) {
+      uncached.push_back(std::move(req));
+      continue;
+    }
+    size_t bit = cache_.Lookup(req);
+    if (bit == ResponseCache::kNotCached) {
+      uncached.push_back(std::move(req));
+    } else {
+      SetBit(bits, kFlagBits + bit);
+      cached.emplace_back(bit, std::move(req));
+    }
+  }
+  // Stall inspection must run every cycle, not only when a slow-path round
+  // happens to occur (a stalled tensor generates no new traffic, so waiting
+  // for the next ingest would never fire).  A stall-shutdown forces a
+  // slow-path round (by withholding bit0) so the abort reaches every rank.
+  if (comm_->rank() == 0 && stall_.CheckForStalls(comm_->size()))
+    stall_abort_ = true;
+
+  bool has_join_request =
+      std::any_of(uncached.begin(), uncached.end(),
+                  [](const Request& r) { return r.type == ReqType::JOIN; });
+  if (uncached.empty() && !want_shutdown && !stall_abort_) SetBit(bits, 0);
+  if (!local_join && !has_join_request) SetBit(bits, 1);
+  // A joined rank must not veto other ranks' cached work: it contributes
+  // zeros, so its bit-vector is all-ones for cache slots.
+  if (local_join)
+    for (size_t b = 0; b < cache_.capacity(); ++b) SetBit(bits, kFlagBits + b);
+
+  std::vector<uint64_t> and_bits, or_bits;
+  if (!comm_->AllreduceBitsAndOr(bits, &and_bits, &or_bits, err)) return false;
+
+  bool nobody_joined = GetBit(and_bits, 1);
+
+  std::vector<Response> single;  // single-tensor responses, execution order
+  if (nobody_joined) {
+    // Fast path: globally-agreed cache bits execute straight from cache.
+    // Bits cleared by the AND (some rank missed) fall back to the slow
+    // path (reference: CacheCoordinator::sync -> invalid bits rejoin the
+    // request list).  Iterate in BIT order, not local submission order:
+    // execution order must be identical on every rank (the reference's
+    // CacheCoordinator keeps its hits in a std::set for the same reason),
+    // and ranks may have submitted the same tensors in different orders.
+    std::sort(cached.begin(), cached.end(),
+              [](const auto& a, const auto& b) { return a.first < b.first; });
+    for (auto& [bit, req] : cached) {
+      if (GetBit(and_bits, kFlagBits + bit)) {
+        cache_.CountHit();
+        single.push_back(cache_.Get(bit));
+      } else {
+        uncached.push_back(std::move(req));
+      }
+    }
+  } else {
+    // Join in flight somewhere: the cache's stored responses don't carry
+    // the live joined set, so everything renegotiates this cycle.
+    for (auto& [bit, req] : cached) uncached.push_back(std::move(req));
+  }
+
+  // The slow path is a COLLECTIVE round: every rank must enter it whenever
+  // any rank has uncached work, so the decision may only depend on the
+  // globally-agreed vectors.  Three triggers:
+  //   (1) some rank had uncached requests at submission time (bit0 AND
+  //       cleared);
+  //   (2) a cache bit diverged — set by some ranks, absent on others
+  //       (OR != AND): the setters just moved those tensors into their
+  //       uncached lists, so a round is needed even though bit0 passed;
+  //   (3) a join is in flight (everything renegotiates with join
+  //       accounting).
+  bool cache_divergence = false;
+  for (size_t w = 0; w < and_bits.size(); ++w) {
+    uint64_t a = and_bits[w], o = or_bits[w];
+    if (w == 0) {  // mask off the two flag bits
+      a &= ~uint64_t{3};
+      o &= ~uint64_t{3};
+    }
+    if (a != o) {
+      cache_divergence = true;
+      break;
+    }
+  }
+  bool need_slow = !GetBit(and_bits, 0) || cache_divergence || !nobody_joined;
+
+  // ---- Slow path: full gather + construct + bcast -----------------------
+  if (need_slow) {
+    RequestList mine;
+    mine.rank = comm_->rank();
+    mine.shutdown = want_shutdown;
+    mine.requests = std::move(uncached);
+
+    std::vector<std::vector<uint8_t>> gathered;
+    if (!comm_->Gather(mine.Serialize(), &gathered, err)) return false;
+
+    ResponseList constructed;
+    if (comm_->rank() == 0) {
+      std::vector<RequestList> lists;
+      lists.reserve(gathered.size());
+      for (auto& buf : gathered) lists.push_back(RequestList::Parse(buf));
+      CoordinatorIngest(lists, &constructed);
+    }
+    std::vector<uint8_t> wire = constructed.Serialize();
+    if (!comm_->Bcast(&wire, err)) return false;
+    constructed = ResponseList::Parse(wire);
+
+    out->shutdown = constructed.shutdown;
+    // Insert fresh single-tensor responses into the cache — every rank does
+    // this in identical bcast order, keeping bit positions aligned.
+    for (auto& resp : constructed.responses) {
+      if (resp.type != RespType::ERROR && resp.type != RespType::JOIN &&
+          resp.type != RespType::BARRIER && resp.joined_ranks.empty() &&
+          resp.tensor_names.size() == 1) {
+        Request key;
+        key.type = static_cast<ReqType>(resp.type);
+        key.op = resp.op;
+        key.dtype = resp.dtype;
+        key.name = resp.tensor_names[0];
+        key.shape = resp.shapes[0];
+        key.root_rank = resp.root_rank;
+        key.prescale = resp.prescale;
+        key.postscale = resp.postscale;
+        cache_.Put(key, resp);
+      }
+      single.push_back(std::move(resp));
+    }
+  }
+
+  out->responses = Fuse(single);
+  return true;
+}
+
+void Controller::CoordinatorIngest(const std::vector<RequestList>& lists,
+                                   ResponseList* out) {
+  bool shutdown = false;
+  for (const auto& list : lists) {
+    shutdown = shutdown || list.shutdown;
+    for (const auto& req : list.requests) {
+      if (req.type == ReqType::JOIN) {
+        joined_ranks_.insert(list.rank);
+        continue;
+      }
+      auto& entry = message_table_[req.name];
+      if (!entry.ranks.count(list.rank)) {
+        entry.requests.push_back(req);
+        entry.ranks.insert(list.rank);
+        stall_.RecordRank(req.name, list.rank);
+      }
+    }
+  }
+
+  // Readiness: all non-joined ranks have submitted (reference
+  // IncrementTensorCount: count == size - joined_size).
+  int needed = comm_->size() - static_cast<int>(joined_ranks_.size());
+  std::vector<std::string> ready;
+  for (const auto& kv : message_table_) {
+    if (static_cast<int>(kv.second.ranks.size()) >= needed)
+      ready.push_back(kv.first);
+  }
+  for (const auto& name : ready) {
+    out->responses.push_back(ConstructResponse(name));
+    message_table_.erase(name);
+    stall_.RemoveTensor(name);
+  }
+
+  // All ranks joined: emit the JOIN response that resets join state
+  // everywhere (reference controller.cc:291-298).
+  if (static_cast<int>(joined_ranks_.size()) == comm_->size()) {
+    Response j;
+    j.type = RespType::JOIN;
+    j.tensor_names.push_back("join");
+    j.shapes.push_back({});
+    out->responses.push_back(j);
+    joined_ranks_.clear();
+  }
+
+  out->shutdown = shutdown || stall_abort_;
+}
+
+bool Controller::CheckConsistency(const std::vector<Request>& reqs,
+                                  std::string* error) {
+  const Request& first = reqs.front();
+  for (const auto& r : reqs) {
+    if (r.type != first.type) {
+      *error = "Mismatched collective operations submitted for tensor '" +
+               first.name + "'";
+      return false;
+    }
+    if (r.dtype != first.dtype) {
+      *error = "Mismatched data types for tensor '" + first.name + "'";
+      return false;
+    }
+    if (r.type == ReqType::ALLREDUCE &&
+        (r.op != first.op || r.shape != first.shape ||
+         r.prescale != first.prescale || r.postscale != first.postscale)) {
+      *error = "Mismatched allreduce shape/op for tensor '" + first.name + "'";
+      return false;
+    }
+    if (r.type == ReqType::BROADCAST &&
+        (r.shape != first.shape || r.root_rank != first.root_rank)) {
+      *error = "Mismatched broadcast shape or root rank for tensor '" +
+               first.name + "'";
+      return false;
+    }
+    if ((r.type == ReqType::ALLGATHER || r.type == ReqType::ALLTOALL) &&
+        r.shape.size() == first.shape.size() && !r.shape.empty()) {
+      // First dim may vary; trailing dims must match.
+      for (size_t d = 1; d < r.shape.size(); ++d) {
+        if (r.shape[d] != first.shape[d]) {
+          *error = "Mismatched trailing dimensions for gathered tensor '" +
+                   first.name + "'";
+          return false;
+        }
+      }
+    } else if ((r.type == ReqType::ALLGATHER || r.type == ReqType::ALLTOALL) &&
+               r.shape.size() != first.shape.size()) {
+      *error = "Mismatched rank (ndim) for gathered tensor '" + first.name + "'";
+      return false;
+    }
+  }
+  return true;
+}
+
+Response Controller::ConstructResponse(const std::string& name) {
+  auto& entry = message_table_[name];
+  const Request& first = entry.requests.front();
+  Response resp;
+  resp.tensor_names.push_back(name);
+  resp.shapes.push_back(first.shape);
+  resp.op = first.op;
+  resp.dtype = first.dtype;
+  resp.root_rank = first.root_rank;
+  resp.prescale = first.prescale;
+  resp.postscale = first.postscale;
+
+  std::string error;
+  if (!CheckConsistency(entry.requests, &error)) {
+    resp.type = RespType::ERROR;
+    resp.error = error;
+    return resp;
+  }
+  // Gather/broadcast are unsupported while ranks are joined (reference
+  // controller.cc:445-449, 519-523).
+  if (!joined_ranks_.empty() && first.type != ReqType::ALLREDUCE &&
+      first.type != ReqType::BARRIER) {
+    resp.type = RespType::ERROR;
+    resp.error = "Allgather/broadcast/alltoall are not supported while a "
+                 "rank has joined; tensor '" + name + "'";
+    return resp;
+  }
+  switch (first.type) {
+    case ReqType::ALLREDUCE: resp.type = RespType::ALLREDUCE; break;
+    case ReqType::ALLGATHER: resp.type = RespType::ALLGATHER; break;
+    case ReqType::BROADCAST: resp.type = RespType::BROADCAST; break;
+    case ReqType::ALLTOALL: resp.type = RespType::ALLTOALL; break;
+    case ReqType::BARRIER: resp.type = RespType::BARRIER; break;
+    case ReqType::JOIN: resp.type = RespType::JOIN; break;
+  }
+  resp.joined_ranks.assign(joined_ranks_.begin(), joined_ranks_.end());
+  return resp;
+}
+
+std::vector<Response> Controller::Fuse(
+    const std::vector<Response>& responses) const {
+  std::vector<Response> fused;
+  for (const auto& r : responses) {
+    bool can_merge =
+        !fused.empty() && r.type == RespType::ALLREDUCE &&
+        fused.back().type == RespType::ALLREDUCE &&
+        fused.back().op == r.op && fused.back().dtype == r.dtype &&
+        fused.back().prescale == r.prescale &&
+        fused.back().postscale == r.postscale &&
+        fused.back().joined_ranks == r.joined_ranks && r.error.empty() &&
+        fused.back().error.empty() &&
+        fused.back().NumBytes() + r.NumBytes() <= fusion_bytes_;
+    if (can_merge) {
+      auto& dst = fused.back();
+      dst.tensor_names.insert(dst.tensor_names.end(), r.tensor_names.begin(),
+                              r.tensor_names.end());
+      dst.shapes.insert(dst.shapes.end(), r.shapes.begin(), r.shapes.end());
+    } else {
+      fused.push_back(r);
+    }
+  }
+  return fused;
+}
+
+}  // namespace hvd

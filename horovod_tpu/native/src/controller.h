@@ -1,0 +1,88 @@
+// Coordination controller: decides, each cycle, which tensors are globally
+// ready and packages them into (fused) responses.
+//
+// Re-design of the reference's Controller (horovod/common/controller.cc:
+// ComputeResponseList 55-347, ConstructResponse 369-602, FuseResponses
+// 631-752, IncrementTensorCount 780-803) over the TCP star communicator
+// instead of MPI/Gloo.  Differences by design:
+//   * The steady-state fast path uses ONE bit-vector AND per cycle with two
+//     reserved flag bits (bit0 = "I have no uncached work", bit1 = "I am
+//     not joined/joining"), so a fully-cached cycle costs a single
+//     coordination round and a join anywhere safely disables the fast path.
+//   * Responses carry the joined-rank set so the executor (host language)
+//     can substitute zeros — the reference allocates zero tensors inside
+//     the C++ op layer (global_state.h:104-107); on TPU the zero tensor is
+//     a constant in the executing XLA program.
+#ifndef HVD_NATIVE_CONTROLLER_H
+#define HVD_NATIVE_CONTROLLER_H
+
+#include <map>
+#include <set>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "comm.h"
+#include "common.h"
+#include "message.h"
+#include "response_cache.h"
+#include "stall_inspector.h"
+
+namespace hvd {
+
+class Controller {
+ public:
+  Controller(SocketComm* comm, size_t cache_capacity, int64_t fusion_bytes,
+             double stall_warn_sec, double stall_shutdown_sec)
+      : comm_(comm),
+        cache_(cache_capacity),
+        fusion_bytes_(fusion_bytes),
+        stall_(stall_warn_sec, stall_shutdown_sec) {}
+
+  // One negotiation round.  `pending` are this rank's freshly-popped
+  // requests; `local_join` marks that this rank has an outstanding Join;
+  // `want_shutdown` rides to the coordinator (reference
+  // message.h:112-114).  Returns false on a communication failure
+  // (`err` filled), in which case the job must abort.
+  bool ComputeResponseList(std::vector<Request> pending, bool local_join,
+                           bool want_shutdown, ResponseList* out,
+                           std::string* err);
+
+  // Fuse a response list for execution: adjacent single-tensor ALLREDUCE
+  // responses with identical (op, dtype, joined set, scales) merge until
+  // fusion_bytes_ is reached (reference FuseResponses).
+  std::vector<Response> Fuse(const std::vector<Response>& responses) const;
+
+  int64_t cache_hits() const { return cache_.hits(); }
+  size_t cache_entries() const { return cache_.NumEntries(); }
+  void set_fusion_bytes(int64_t b) { fusion_bytes_ = b; }
+  int64_t fusion_bytes() const { return fusion_bytes_; }
+
+ private:
+  // Coordinator-only (rank 0) slow path: ingest gathered request lists,
+  // emit single-tensor responses for tensors now ready on all non-joined
+  // ranks, plus ERROR responses for metadata mismatches.
+  void CoordinatorIngest(const std::vector<RequestList>& lists,
+                         ResponseList* out);
+  Response ConstructResponse(const std::string& name);
+  static bool CheckConsistency(const std::vector<Request>& reqs,
+                               std::string* error);
+
+  SocketComm* comm_;
+  ResponseCache cache_;
+  int64_t fusion_bytes_;
+  StallInspector stall_;
+
+  // Coordinator state (rank 0 only), reference MessageTable.
+  struct TableEntry {
+    std::vector<Request> requests;  // one per submitting rank
+    std::set<int> ranks;
+  };
+  std::map<std::string, TableEntry> message_table_;  // ordered => determinism
+  std::set<int> joined_ranks_;
+  bool stall_abort_ = false;  // rank 0: stall exceeded the shutdown bound
+};
+
+}  // namespace hvd
+
+#endif  // HVD_NATIVE_CONTROLLER_H

@@ -1,0 +1,108 @@
+#include "message.h"
+
+namespace hvd {
+
+void Request::Serialize(Writer& w) const {
+  w.i32(rank);
+  w.u8(static_cast<uint8_t>(type));
+  w.u8(static_cast<uint8_t>(op));
+  w.u8(static_cast<uint8_t>(dtype));
+  w.str(name);
+  w.i32(root_rank);
+  w.shape(shape);
+  w.f64(prescale);
+  w.f64(postscale);
+}
+
+Request Request::Parse(Reader& r) {
+  Request q;
+  q.rank = r.i32();
+  q.type = static_cast<ReqType>(r.u8());
+  q.op = static_cast<ReduceOp>(r.u8());
+  q.dtype = static_cast<DType>(r.u8());
+  q.name = r.str();
+  q.root_rank = r.i32();
+  q.shape = r.shape();
+  q.prescale = r.f64();
+  q.postscale = r.f64();
+  return q;
+}
+
+std::vector<uint8_t> RequestList::Serialize() const {
+  Writer w;
+  w.i32(rank);
+  w.u8(shutdown ? 1 : 0);
+  w.u32(static_cast<uint32_t>(requests.size()));
+  for (const auto& q : requests) q.Serialize(w);
+  return std::move(w.buf);
+}
+
+RequestList RequestList::Parse(const std::vector<uint8_t>& buf) {
+  Reader r(buf);
+  RequestList l;
+  l.rank = r.i32();
+  l.shutdown = r.u8() != 0;
+  uint32_t n = r.u32();
+  l.requests.reserve(n);
+  for (uint32_t i = 0; i < n; ++i) l.requests.push_back(Request::Parse(r));
+  return l;
+}
+
+void Response::Serialize(Writer& w) const {
+  w.u8(static_cast<uint8_t>(type));
+  w.u8(static_cast<uint8_t>(op));
+  w.u8(static_cast<uint8_t>(dtype));
+  w.u32(static_cast<uint32_t>(tensor_names.size()));
+  for (size_t i = 0; i < tensor_names.size(); ++i) {
+    w.str(tensor_names[i]);
+    w.shape(i < shapes.size() ? shapes[i] : std::vector<int64_t>{});
+  }
+  w.i32(root_rank);
+  w.f64(prescale);
+  w.f64(postscale);
+  w.str(error);
+  w.u32(static_cast<uint32_t>(joined_ranks.size()));
+  for (int32_t jr : joined_ranks) w.i32(jr);
+}
+
+Response Response::Parse(Reader& r) {
+  Response p;
+  p.type = static_cast<RespType>(r.u8());
+  p.op = static_cast<ReduceOp>(r.u8());
+  p.dtype = static_cast<DType>(r.u8());
+  uint32_t n = r.u32();
+  p.tensor_names.reserve(n);
+  p.shapes.reserve(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    p.tensor_names.push_back(r.str());
+    p.shapes.push_back(r.shape());
+  }
+  p.root_rank = r.i32();
+  p.prescale = r.f64();
+  p.postscale = r.f64();
+  p.error = r.str();
+  uint32_t j = r.u32();
+  p.joined_ranks.reserve(j);
+  for (uint32_t i = 0; i < j; ++i) p.joined_ranks.push_back(r.i32());
+  return p;
+}
+
+std::vector<uint8_t> ResponseList::Serialize() const {
+  Writer w;
+  w.u8(shutdown ? 1 : 0);
+  w.u32(static_cast<uint32_t>(responses.size()));
+  for (const auto& p : responses) p.Serialize(w);
+  return std::move(w.buf);
+}
+
+ResponseList ResponseList::Parse(const std::vector<uint8_t>& buf) {
+  Reader r(buf);
+  ResponseList l;
+  l.shutdown = r.u8() != 0;
+  uint32_t n = r.u32();
+  l.responses.reserve(n);
+  for (uint32_t i = 0; i < n; ++i) l.responses.push_back(Response::Parse(r));
+  return l;
+}
+
+}  // namespace hvd

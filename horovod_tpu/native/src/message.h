@@ -1,0 +1,93 @@
+// Coordination protocol messages.
+//
+// Mirrors the reference's Request/Response pair (horovod/common/message.h:
+// 47-100 Request, 132-192 Response, lists at 102-125/194-217) with the same
+// roles: a Request travels worker -> coordinator announcing "this tensor is
+// ready on my rank"; a Response travels coordinator -> workers announcing
+// "this (fused set of) tensor(s) is ready everywhere — execute it now".
+// Serialization is the hand-rolled wire format in wire.h instead of
+// flatbuffers.
+#ifndef HVD_NATIVE_MESSAGE_H
+#define HVD_NATIVE_MESSAGE_H
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+#include "common.h"
+#include "wire.h"
+
+namespace hvd {
+
+struct Request {
+  int32_t rank = 0;
+  ReqType type = ReqType::ALLREDUCE;
+  ReduceOp op = ReduceOp::AVERAGE;
+  DType dtype = DType::FLOAT32;
+  std::string name;
+  int32_t root_rank = 0;  // broadcast only
+  std::vector<int64_t> shape;
+  double prescale = 1.0;
+  double postscale = 1.0;
+
+  int64_t NumBytes() const {
+    int64_t n = DTypeSize(dtype);
+    for (int64_t d : shape) n *= d;
+    return n;
+  }
+
+  void Serialize(Writer& w) const;
+  static Request Parse(Reader& r);
+};
+
+struct RequestList {
+  int32_t rank = 0;
+  bool shutdown = false;  // rides the coordination message, reference
+                          // message.h:112-114
+  std::vector<Request> requests;
+
+  std::vector<uint8_t> Serialize() const;
+  static RequestList Parse(const std::vector<uint8_t>& buf);
+};
+
+struct Response {
+  RespType type = RespType::ALLREDUCE;
+  ReduceOp op = ReduceOp::AVERAGE;
+  DType dtype = DType::FLOAT32;
+  // All tensors fused into this response (>=1; >1 only for ALLREDUCE, like
+  // the reference's FuseResponses, controller.cc:631-752).
+  std::vector<std::string> tensor_names;
+  std::vector<std::vector<int64_t>> shapes;
+  int32_t root_rank = 0;
+  double prescale = 1.0;
+  double postscale = 1.0;
+  std::string error;             // RespType::ERROR only
+  // Ranks that have Joined: the executor substitutes zeros for them
+  // (reference: global_state.h:104-107 / controller.cc:780-803).
+  std::vector<int32_t> joined_ranks;
+
+  int64_t NumBytes() const {
+    int64_t total = 0;
+    for (const auto& s : shapes) {
+      int64_t n = DTypeSize(dtype);
+      for (int64_t d : s) n *= d;
+      total += n;
+    }
+    return total;
+  }
+
+  void Serialize(Writer& w) const;
+  static Response Parse(Reader& r);
+};
+
+struct ResponseList {
+  bool shutdown = false;
+  std::vector<Response> responses;
+
+  std::vector<uint8_t> Serialize() const;
+  static ResponseList Parse(const std::vector<uint8_t>& buf);
+};
+
+}  // namespace hvd
+
+#endif  // HVD_NATIVE_MESSAGE_H

@@ -1,0 +1,69 @@
+#include "response_cache.h"
+
+#include <algorithm>
+
+namespace hvd {
+
+// Bit positions must be identical on every rank: they are only mutated by
+// Put/Touch, which every rank performs in the same order (responses are
+// processed in broadcast order), so assignments and LRU evictions stay in
+// lockstep — the same invariant the reference maintains by processing the
+// bcast ResponseList identically everywhere.
+
+bool ResponseCache::Matches(const Request& a, const Request& b) const {
+  return a.type == b.type && a.op == b.op && a.dtype == b.dtype &&
+         a.shape == b.shape && a.root_rank == b.root_rank &&
+         a.prescale == b.prescale && a.postscale == b.postscale;
+}
+
+size_t ResponseCache::Lookup(const Request& req) {
+  auto it = by_name_.find(req.name);
+  if (it == by_name_.end()) return kNotCached;
+  // Metadata changed (e.g. tensor re-registered with a new shape): force a
+  // full renegotiation; the fresh Put will overwrite this bit in place on
+  // every rank, keeping positions aligned.
+  if (!Matches(entries_[it->second].request, req)) return kNotCached;
+  return it->second;
+}
+
+void ResponseCache::Put(const Request& req, const Response& resp) {
+  if (capacity_ == 0) return;
+  auto it = by_name_.find(req.name);
+  if (it != by_name_.end()) {
+    entries_[it->second] = Entry{req, resp};
+    lru_.remove(it->second);
+    lru_.push_front(it->second);
+    return;
+  }
+  size_t bit;
+  if (entries_.size() < capacity_) {
+    bit = entries_.size();
+    entries_.push_back(Entry{req, resp});
+  } else {
+    bit = lru_.back();  // evict least-recently-executed
+    lru_.pop_back();
+    by_name_.erase(entries_[bit].request.name);
+    entries_[bit] = Entry{req, resp};
+  }
+  by_name_[req.name] = bit;
+  lru_.push_front(bit);
+}
+
+void ResponseCache::Erase(const std::string& name) {
+  auto it = by_name_.find(name);
+  if (it == by_name_.end()) return;
+  // Keep the slot (bit positions of other entries must not shift); mark it
+  // unreachable by name so Lookup misses and Put may reuse it via LRU.
+  lru_.remove(it->second);
+  lru_.push_back(it->second);
+  entries_[it->second].request.name.clear();
+  by_name_.erase(it);
+}
+
+void ResponseCache::Clear() {
+  entries_.clear();
+  by_name_.clear();
+  lru_.clear();
+}
+
+}  // namespace hvd

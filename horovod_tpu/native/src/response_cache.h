@@ -1,0 +1,64 @@
+// LRU response cache — the steady-state negotiation bypass.
+//
+// Re-implements the reference's ResponseCache + CacheCoordinator
+// (horovod/common/response_cache.{h,cc}; fast path wired at
+// controller.cc:125-193): after a tensor has been negotiated once, later
+// cycles only need to agree that every rank re-submitted the *same* tensor,
+// which a bit-vector AND establishes in one round instead of a full
+// gather+construct+bcast.  Entries are invalidated when a resubmission's
+// metadata (shape/dtype/op) changes.
+#ifndef HVD_NATIVE_RESPONSE_CACHE_H
+#define HVD_NATIVE_RESPONSE_CACHE_H
+
+#include <cstdint>
+#include <list>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "message.h"
+
+namespace hvd {
+
+class ResponseCache {
+ public:
+  explicit ResponseCache(size_t capacity) : capacity_(capacity) {}
+
+  static constexpr size_t kNotCached = SIZE_MAX;
+
+  // Bit position for a request if cached AND metadata matches; kNotCached
+  // otherwise (a metadata mismatch also evicts the stale entry, mirroring
+  // the reference's invalidation on changed tensor params).
+  size_t Lookup(const Request& req);
+
+  // Insert a single-tensor response produced by a full negotiation.
+  void Put(const Request& req, const Response& resp);
+
+  const Response& Get(size_t bit) const { return entries_[bit].response; }
+  const Request& GetRequest(size_t bit) const { return entries_[bit].request; }
+
+  void Erase(const std::string& name);
+  void Clear();
+
+  size_t NumEntries() const { return entries_.size(); }
+  size_t capacity() const { return capacity_; }
+  int64_t hits() const { return hits_; }
+  void CountHit() { ++hits_; }
+
+ private:
+  struct Entry {
+    Request request;
+    Response response;
+  };
+  bool Matches(const Request& a, const Request& b) const;
+
+  size_t capacity_;
+  std::vector<Entry> entries_;                       // bit -> entry
+  std::unordered_map<std::string, size_t> by_name_;  // name -> bit
+  std::list<size_t> lru_;                            // front = most recent
+  int64_t hits_ = 0;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_NATIVE_RESPONSE_CACHE_H

@@ -1,0 +1,135 @@
+#include "runtime.h"
+
+#include <chrono>
+
+namespace hvd {
+
+Runtime& Runtime::Get() {
+  static Runtime* instance = new Runtime();
+  return *instance;
+}
+
+bool Runtime::Init(const RuntimeOptions& opts, std::string* err) {
+  if (initialized_.load()) return true;
+  opts_ = opts;
+  if (!comm_.Init(opts.rank, opts.size, opts.coordinator_addr,
+                  opts.coordinator_port, opts.connect_timeout_sec, err))
+    return false;
+  controller_.reset(new Controller(&comm_, opts.cache_capacity,
+                                   opts.fusion_threshold_bytes,
+                                   opts.stall_warn_sec,
+                                   opts.stall_shutdown_sec));
+  if (!opts.timeline_path.empty() && opts.rank == 0)
+    timeline_.Initialize(opts.timeline_path);
+  stop_.store(false);
+  shutdown_requested_.store(false);
+  bg_thread_ = std::thread([this] { BackgroundLoop(); });
+  initialized_.store(true);
+  return true;
+}
+
+void Runtime::Shutdown() {
+  if (!initialized_.load()) return;
+  // Ride the shutdown flag through one more coordination cycle so every
+  // rank agrees to stop (reference: RequestList::shutdown honored at
+  // controller.cc:247-250), then join the background thread.
+  shutdown_requested_.store(true);
+  if (bg_thread_.joinable()) bg_thread_.join();
+  queue_.AbortAll(Status::Error(StatusCode::SHUTDOWN, "horovod_tpu shut down"));
+  timeline_.Shutdown();
+  comm_.Shutdown();
+  controller_.reset();
+  initialized_.store(false);
+}
+
+int64_t Runtime::Enqueue(const Request& req) {
+  if (!initialized_.load()) return -2;
+  int64_t h = queue_.Add(req);
+  if (h >= 0) timeline_.Begin(req.name, Timeline::kNegotiate);
+  return h;
+}
+
+int64_t Runtime::EnqueueJoin() {
+  Request req;
+  req.type = ReqType::JOIN;
+  req.name = "join";
+  req.rank = opts_.rank;
+  return Enqueue(req);
+}
+
+void Runtime::BackgroundLoop() {
+  using clock = std::chrono::steady_clock;
+  auto cycle = std::chrono::duration<double, std::milli>(opts_.cycle_time_ms);
+  while (!stop_.load()) {
+    auto start = clock::now();
+    if (!RunLoopOnce()) break;
+    cycles_.fetch_add(1);
+    if (opts_.timeline_mark_cycles) timeline_.MarkCycle();
+    auto elapsed = clock::now() - start;
+    if (elapsed < cycle) std::this_thread::sleep_for(cycle - elapsed);
+  }
+  stop_.store(true);
+}
+
+bool Runtime::RunLoopOnce() {
+  std::vector<Request> pending = queue_.PopAll();
+  for (const auto& r : pending)
+    if (r.type == ReqType::JOIN) local_join_ = true;
+
+  bool want_shutdown = shutdown_requested_.load();
+  ResponseList out;
+  std::string err;
+  if (!controller_->ComputeResponseList(std::move(pending), local_join_,
+                                        want_shutdown, &out, &err)) {
+    queue_.AbortAll(Status::Error(StatusCode::ABORTED,
+                                  "coordination failed: " + err));
+    return false;
+  }
+  for (const auto& resp : out.responses) Dispatch(resp);
+  if (out.shutdown) {
+    queue_.AbortAll(
+        Status::Error(StatusCode::SHUTDOWN, "shutdown requested"));
+    return false;
+  }
+  return true;
+}
+
+void Runtime::Dispatch(const Response& resp) {
+  for (const auto& n : resp.tensor_names)
+    timeline_.End(n, Timeline::kNegotiate);
+
+  switch (resp.type) {
+    case RespType::ERROR:
+      queue_.Complete(resp.tensor_names,
+                      Status::Error(StatusCode::INVALID, resp.error));
+      return;
+    case RespType::JOIN:
+      local_join_ = false;
+      queue_.Complete({"join"}, Status::OK());
+      return;
+    case RespType::BARRIER:
+      queue_.Complete(resp.tensor_names, Status::OK());
+      return;
+    default:
+      break;
+  }
+
+  for (const auto& n : resp.tensor_names)
+    timeline_.Begin(n, Timeline::kExecute);
+  Status status = Status::OK();
+  if (execute_fn_ != nullptr) {
+    Writer w;
+    resp.Serialize(w);
+    int rc = execute_fn_(w.buf.data(), static_cast<int>(w.buf.size()));
+    if (rc != 0)
+      status = Status::Error(static_cast<StatusCode>(rc),
+                             "executor reported failure");
+  } else {
+    status = Status::Error(StatusCode::INVALID, "no executor registered");
+  }
+  for (const auto& n : resp.tensor_names)
+    timeline_.End(n, Timeline::kExecute);
+  queue_.Complete(resp.tensor_names, status);
+}
+
+}  // namespace hvd

@@ -1,0 +1,93 @@
+// Global runtime state + the background negotiation thread.
+//
+// Re-design of the reference's HorovodGlobalState + BackgroundThreadLoop /
+// RunLoopOnce (horovod/common/global_state.h:42-122,
+// common/operations.cc:333-537).  The loop's job here is pure control:
+// pop pending requests, negotiate global readiness through the controller,
+// then hand each (fused) response to the EXECUTOR CALLBACK registered by
+// the host language, which runs the actual collective as an XLA program on
+// the TPU data plane.  The reference's ready-event polling and fusion-buffer
+// memcpys have no equivalent — XLA data dependencies and compiler fusion
+// replace them (SURVEY.md §7).
+#ifndef HVD_NATIVE_RUNTIME_H
+#define HVD_NATIVE_RUNTIME_H
+
+#include <atomic>
+#include <string>
+#include <thread>
+
+#include "comm.h"
+#include "controller.h"
+#include "tensor_queue.h"
+#include "timeline.h"
+
+namespace hvd {
+
+// Executor callback: receives a serialized Response (wire.h format),
+// performs the collective, returns a StatusCode as int.
+typedef int (*ExecuteFn)(const uint8_t* response, int len);
+
+struct RuntimeOptions {
+  int rank = 0;
+  int size = 1;
+  std::string coordinator_addr = "127.0.0.1";
+  int coordinator_port = 9374;
+  double connect_timeout_sec = 60.0;
+  double cycle_time_ms = 1.0;  // reference default 5ms (operations.cc:416);
+                               // control-plane-only cycles can run tighter
+  int64_t fusion_threshold_bytes = 64 << 20;  // reference operations.cc:408
+  int cache_capacity = 1024;                  // reference global_state.h:88
+  double stall_warn_sec = 60.0;
+  double stall_shutdown_sec = 0.0;
+  std::string timeline_path;  // empty = disabled; rank 0 only
+  bool timeline_mark_cycles = false;
+};
+
+class Runtime {
+ public:
+  static Runtime& Get();
+
+  bool Init(const RuntimeOptions& opts, std::string* err);
+  void Shutdown();
+  bool initialized() const { return initialized_.load(); }
+
+  void set_execute_fn(ExecuteFn fn) { execute_fn_ = fn; }
+
+  int64_t Enqueue(const Request& req);
+  int64_t EnqueueJoin();
+  bool Poll(int64_t handle) { return queue_.Poll(handle); }
+  Status Wait(int64_t handle) { return queue_.Wait(handle); }
+
+  int64_t cycles() const { return cycles_.load(); }
+  int64_t cache_hits() { return controller_ ? controller_->cache_hits() : 0; }
+  int64_t cache_entries() {
+    return controller_ ? static_cast<int64_t>(controller_->cache_entries()) : 0;
+  }
+  void set_fusion_bytes(int64_t b) {
+    if (controller_) controller_->set_fusion_bytes(b);
+  }
+
+ private:
+  Runtime() = default;
+  void BackgroundLoop();
+  bool RunLoopOnce();
+  void Dispatch(const Response& resp);
+
+  RuntimeOptions opts_;
+  SocketComm comm_;
+  std::unique_ptr<Controller> controller_;
+  TensorQueue queue_;
+  Timeline timeline_;
+  ExecuteFn execute_fn_ = nullptr;
+
+  std::thread bg_thread_;
+  std::atomic<bool> initialized_{false};
+  std::atomic<bool> stop_{false};
+  std::atomic<bool> shutdown_requested_{false};
+  std::atomic<int64_t> cycles_{0};
+  bool local_join_ = false;  // background-thread-only state
+};
+
+}  // namespace hvd
+
+#endif  // HVD_NATIVE_RUNTIME_H

@@ -1,0 +1,52 @@
+#include "stall_inspector.h"
+
+#include <cstdio>
+
+namespace hvd {
+
+void StallInspector::RecordRank(const std::string& tensor, int rank) {
+  auto it = pending_.find(tensor);
+  if (it == pending_.end()) {
+    Pending p;
+    p.first_seen = std::chrono::steady_clock::now();
+    p.ranks.insert(rank);
+    pending_.emplace(tensor, std::move(p));
+  } else {
+    it->second.ranks.insert(rank);
+  }
+}
+
+void StallInspector::RemoveTensor(const std::string& tensor) {
+  pending_.erase(tensor);
+}
+
+bool StallInspector::CheckForStalls(int world_size) {
+  bool shutdown = false;
+  auto now = std::chrono::steady_clock::now();
+  for (auto& kv : pending_) {
+    double age =
+        std::chrono::duration<double>(now - kv.second.first_seen).count();
+    if (age > warn_sec_ && !kv.second.warned) {
+      std::string missing;
+      for (int r = 0; r < world_size; ++r)
+        if (!kv.second.ranks.count(r))
+          missing += (missing.empty() ? "" : ", ") + std::to_string(r);
+      std::fprintf(stderr,
+                   "[horovod_tpu] WARNING: One or more tensors were submitted "
+                   "to be reduced/gathered but some ranks never did: tensor "
+                   "'%s' is missing ranks [%s] after %.0fs. This may hang.\n",
+                   kv.first.c_str(), missing.c_str(), age);
+      kv.second.warned = true;
+    }
+    if (shutdown_sec_ > 0 && age > shutdown_sec_) {
+      std::fprintf(stderr,
+                   "[horovod_tpu] ERROR: tensor '%s' stalled beyond the "
+                   "shutdown bound (%.0fs); aborting the job.\n",
+                   kv.first.c_str(), shutdown_sec_);
+      shutdown = true;
+    }
+  }
+  return shutdown;
+}
+
+}  // namespace hvd

@@ -1,0 +1,46 @@
+// Coordinator-side stall detection.
+//
+// Re-implements the reference's StallInspector
+// (horovod/common/stall_inspector.{h,cc}; wired into the controller at
+// controller.cc:112-121): if some ranks submitted a tensor and others have
+// not after `warn_sec`, log which ranks are missing; after `shutdown_sec`
+// (if set) request a global abort — the semantic failure detector for
+// "rank 3 never called allreduce on tensor X" hangs.
+#ifndef HVD_NATIVE_STALL_INSPECTOR_H
+#define HVD_NATIVE_STALL_INSPECTOR_H
+
+#include <chrono>
+#include <set>
+#include <string>
+#include <unordered_map>
+
+namespace hvd {
+
+class StallInspector {
+ public:
+  StallInspector(double warn_sec, double shutdown_sec)
+      : warn_sec_(warn_sec), shutdown_sec_(shutdown_sec) {}
+
+  void RecordRank(const std::string& tensor, int rank);
+  void RemoveTensor(const std::string& tensor);
+
+  // Scan for stalls; logs warnings to stderr (rank-0 process).  Returns
+  // true if any tensor exceeded the shutdown bound.
+  bool CheckForStalls(int world_size);
+
+  double warn_sec() const { return warn_sec_; }
+
+ private:
+  struct Pending {
+    std::chrono::steady_clock::time_point first_seen;
+    std::set<int> ranks;
+    bool warned = false;
+  };
+  double warn_sec_;
+  double shutdown_sec_;
+  std::unordered_map<std::string, Pending> pending_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_NATIVE_STALL_INSPECTOR_H

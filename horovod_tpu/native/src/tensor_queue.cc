@@ -1,0 +1,73 @@
+#include "tensor_queue.h"
+
+namespace hvd {
+
+int64_t TensorQueue::Add(const Request& req) {
+  std::lock_guard<std::mutex> lk(mu_);
+  if (name_to_handle_.count(req.name)) return -1;  // duplicate-name race
+  int64_t h = next_handle_++;
+  name_to_handle_[req.name] = h;
+  handles_[h] = HandleState{h, false, Status::OK()};
+  pending_.push_back(req);
+  return h;
+}
+
+std::vector<Request> TensorQueue::PopAll() {
+  std::lock_guard<std::mutex> lk(mu_);
+  std::vector<Request> out(pending_.begin(), pending_.end());
+  pending_.clear();
+  return out;
+}
+
+void TensorQueue::Complete(const std::vector<std::string>& names,
+                           const Status& status) {
+  std::lock_guard<std::mutex> lk(mu_);
+  for (const auto& n : names) {
+    auto it = name_to_handle_.find(n);
+    if (it == name_to_handle_.end()) continue;
+    auto hit = handles_.find(it->second);
+    if (hit != handles_.end()) {
+      hit->second.done = true;
+      hit->second.status = status;
+    }
+    name_to_handle_.erase(it);
+  }
+  cv_.notify_all();
+}
+
+void TensorQueue::AbortAll(const Status& status) {
+  std::lock_guard<std::mutex> lk(mu_);
+  pending_.clear();
+  for (auto& kv : handles_) {
+    if (!kv.second.done) {
+      kv.second.done = true;
+      kv.second.status = status;
+    }
+  }
+  name_to_handle_.clear();
+  cv_.notify_all();
+}
+
+bool TensorQueue::Poll(int64_t handle) {
+  std::lock_guard<std::mutex> lk(mu_);
+  auto it = handles_.find(handle);
+  return it == handles_.end() || it->second.done;
+}
+
+Status TensorQueue::Wait(int64_t handle) {
+  std::unique_lock<std::mutex> lk(mu_);
+  auto it = handles_.find(handle);
+  if (it == handles_.end())
+    return Status::Error(StatusCode::INVALID, "unknown handle");
+  cv_.wait(lk, [&] { return handles_[handle].done; });
+  Status s = handles_[handle].status;
+  handles_.erase(handle);
+  return s;
+}
+
+size_t TensorQueue::PendingCount() {
+  std::lock_guard<std::mutex> lk(mu_);
+  return pending_.size();
+}
+
+}  // namespace hvd

@@ -1,0 +1,63 @@
+// Thread-safe pending-request queue + in-flight handle table.
+//
+// Mirrors the reference's TensorQueue (horovod/common/tensor_queue.{h,cc})
+// with one structural difference: the reference's table maps name ->
+// TensorTableEntry holding framework tensor pointers; here tensor payloads
+// stay in the host language (PJRT owns device buffers), so the table maps
+// name -> handle metadata and the duplicate-submission race check
+// (reference tensor_queue.cc:29-31, DUPLICATE_NAME_ERROR common.h:160-163)
+// is enforced on names alone.
+#ifndef HVD_NATIVE_TENSOR_QUEUE_H
+#define HVD_NATIVE_TENSOR_QUEUE_H
+
+#include <condition_variable>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "message.h"
+
+namespace hvd {
+
+struct HandleState {
+  int64_t handle = -1;
+  bool done = false;
+  Status status;
+};
+
+class TensorQueue {
+ public:
+  // Enqueue a request; returns the handle, or -1 on duplicate-name race.
+  int64_t Add(const Request& req);
+
+  // Pop all pending requests (one negotiation cycle's worth — reference
+  // PopMessagesFromQueue, controller.cc:71).
+  std::vector<Request> PopAll();
+
+  // Mark every tensor in `names` complete with `status` and wake waiters.
+  void Complete(const std::vector<std::string>& names, const Status& status);
+
+  // Fail everything (pending + in-flight) — shutdown path (reference
+  // operations.cc:515-521 SHUT_DOWN_ERROR delivery).
+  void AbortAll(const Status& status);
+
+  // Handle API.
+  bool Poll(int64_t handle);
+  Status Wait(int64_t handle);  // blocks; erases the handle when done
+  size_t PendingCount();
+
+ private:
+  std::mutex mu_;
+  std::condition_variable cv_;
+  int64_t next_handle_ = 0;
+  std::deque<Request> pending_;
+  std::unordered_map<std::string, int64_t> name_to_handle_;
+  std::unordered_map<int64_t, HandleState> handles_;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_NATIVE_TENSOR_QUEUE_H

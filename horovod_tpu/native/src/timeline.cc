@@ -1,0 +1,106 @@
+#include "timeline.h"
+
+#include <chrono>
+
+namespace hvd {
+
+bool Timeline::Initialize(const std::string& path) {
+  file_ = std::fopen(path.c_str(), "w");
+  if (!file_) return false;
+  std::fputs("[\n", file_);
+  stop_ = false;
+  writer_ = std::thread([this] { WriterLoop(); });
+  initialized_ = true;
+  return true;
+}
+
+void Timeline::Shutdown() {
+  if (!initialized_) return;
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    stop_ = true;
+  }
+  cv_.notify_all();
+  if (writer_.joinable()) writer_.join();
+  std::fputs("\n]\n", file_);
+  std::fclose(file_);
+  file_ = nullptr;
+  initialized_ = false;
+}
+
+int64_t Timeline::NowUs() const {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+void Timeline::Push(Event e) {
+  {
+    std::lock_guard<std::mutex> lk(mu_);
+    queue_.push_back(std::move(e));
+  }
+  cv_.notify_one();
+}
+
+void Timeline::Begin(const std::string& tensor, const char* activity) {
+  if (!initialized_) return;
+  Push(Event{'B', tensor, activity, NowUs()});
+}
+
+void Timeline::End(const std::string& tensor, const char* activity) {
+  if (!initialized_) return;
+  Push(Event{'E', tensor, activity, NowUs()});
+}
+
+void Timeline::MarkCycle() {
+  if (!initialized_) return;
+  Push(Event{'i', "", "CYCLE", NowUs()});
+}
+
+void Timeline::WriterLoop() {
+  for (;;) {
+    std::deque<Event> batch;
+    {
+      std::unique_lock<std::mutex> lk(mu_);
+      cv_.wait(lk, [&] { return stop_ || !queue_.empty(); });
+      batch.swap(queue_);
+      if (batch.empty() && stop_) return;
+    }
+    for (const auto& e : batch) {
+      // Lane per tensor (chrome tracing "tid"), named on first sight via a
+      // metadata record — the same layout the reference produces.
+      int tid = 0;
+      if (!e.tensor.empty()) {
+        auto it = tensor_tids_.find(e.tensor);
+        if (it == tensor_tids_.end()) {
+          tid = static_cast<int>(tensor_tids_.size()) + 1;
+          tensor_tids_[e.tensor] = tid;
+          std::fprintf(file_,
+                       "%s{\"name\": \"thread_name\", \"ph\": \"M\", \"pid\": 0,"
+                       " \"tid\": %d, \"args\": {\"name\": \"%s\"}}",
+                       first_record_ ? "" : ",\n", tid, e.tensor.c_str());
+          first_record_ = false;
+        } else {
+          tid = it->second;
+        }
+      }
+      if (e.ph == 'i') {
+        std::fprintf(file_,
+                     "%s{\"name\": \"%s\", \"ph\": \"i\", \"s\": \"g\","
+                     " \"ts\": %lld, \"pid\": 0, \"tid\": 0}",
+                     first_record_ ? "" : ",\n", e.activity.c_str(),
+                     static_cast<long long>(e.ts_us));
+      } else {
+        std::fprintf(file_,
+                     "%s{\"name\": \"%s\", \"ph\": \"%c\", \"ts\": %lld,"
+                     " \"pid\": 0, \"tid\": %d}",
+                     first_record_ ? "" : ",\n", e.activity.c_str(), e.ph,
+                     static_cast<long long>(e.ts_us), tid);
+      }
+      first_record_ = false;
+    }
+    std::fflush(file_);
+  }
+}
+
+}  // namespace hvd

@@ -1,0 +1,66 @@
+// Chrome-tracing timeline writer.
+//
+// Re-implements the reference's Timeline/TimelineWriter
+// (horovod/common/timeline.{h,cc}): per-tensor lifecycle events
+// (NEGOTIATE -> QUEUE -> EXECUTE) appended to a chrome://tracing JSON file
+// by a dedicated writer thread, fed through a queue so the negotiation hot
+// loop never blocks on file IO.  The reference uses a boost lock-free SPSC
+// ring; control-plane event rates (a few per tensor per step) don't justify
+// a vendored dependency, so this uses a mutex+condvar MPSC queue.
+#ifndef HVD_NATIVE_TIMELINE_H
+#define HVD_NATIVE_TIMELINE_H
+
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+
+namespace hvd {
+
+class Timeline {
+ public:
+  // Activity names follow the reference's constants (common/common.h:31-59),
+  // minus the phases SPMD compilation removed.
+  static constexpr const char* kNegotiate = "NEGOTIATE";
+  static constexpr const char* kQueue = "QUEUE";
+  static constexpr const char* kExecute = "EXECUTE";
+
+  Timeline() = default;
+  ~Timeline() { Shutdown(); }
+
+  bool Initialize(const std::string& path);
+  void Shutdown();
+  bool Initialized() const { return initialized_; }
+
+  void Begin(const std::string& tensor, const char* activity);
+  void End(const std::string& tensor, const char* activity);
+  void MarkCycle();  // optional cycle tick (HOROVOD_TIMELINE_MARK_CYCLES)
+
+ private:
+  struct Event {
+    char ph;  // 'B', 'E', or 'i' (instant)
+    std::string tensor;
+    std::string activity;
+    int64_t ts_us;
+  };
+  void Push(Event e);
+  void WriterLoop();
+  int64_t NowUs() const;
+
+  bool initialized_ = false;
+  FILE* file_ = nullptr;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::deque<Event> queue_;
+  bool stop_ = false;
+  std::thread writer_;
+  std::unordered_map<std::string, int> tensor_tids_;
+  bool first_record_ = true;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_NATIVE_TIMELINE_H

@@ -1,0 +1,83 @@
+// Compact little-endian binary (de)serializer — the wire format used both
+// between ranks (over TCP) and across the C boundary to the host language.
+//
+// Plays the role flatbuffers plays in the reference
+// (horovod/common/wire/message.fbs) with a deliberately simpler scheme:
+// fixed-width little-endian scalars, length-prefixed strings/vectors.  The
+// control-plane messages are tiny (tensor names + shapes), so zero-copy
+// access buys nothing here and a dependency-free format keeps the native
+// library self-contained.
+#ifndef HVD_NATIVE_WIRE_H
+#define HVD_NATIVE_WIRE_H
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace hvd {
+
+class Writer {
+ public:
+  std::vector<uint8_t> buf;
+
+  void u8(uint8_t v) { buf.push_back(v); }
+  void u32(uint32_t v) { raw(&v, 4); }
+  void i32(int32_t v) { raw(&v, 4); }
+  void i64(int64_t v) { raw(&v, 8); }
+  void f64(double v) { raw(&v, 8); }
+  void str(const std::string& s) {
+    u32(static_cast<uint32_t>(s.size()));
+    raw(s.data(), s.size());
+  }
+  void shape(const std::vector<int64_t>& dims) {
+    u32(static_cast<uint32_t>(dims.size()));
+    for (int64_t d : dims) i64(d);
+  }
+
+ private:
+  void raw(const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    buf.insert(buf.end(), b, b + n);
+  }
+};
+
+class Reader {
+ public:
+  Reader(const uint8_t* data, size_t len) : data_(data), len_(len) {}
+  explicit Reader(const std::vector<uint8_t>& v) : data_(v.data()), len_(v.size()) {}
+
+  uint8_t u8() { return *take(1); }
+  uint32_t u32() { uint32_t v; std::memcpy(&v, take(4), 4); return v; }
+  int32_t i32() { int32_t v; std::memcpy(&v, take(4), 4); return v; }
+  int64_t i64() { int64_t v; std::memcpy(&v, take(8), 8); return v; }
+  double f64() { double v; std::memcpy(&v, take(8), 8); return v; }
+  std::string str() {
+    uint32_t n = u32();
+    const uint8_t* p = take(n);
+    return std::string(reinterpret_cast<const char*>(p), n);
+  }
+  std::vector<int64_t> shape() {
+    uint32_t n = u32();
+    std::vector<int64_t> dims(n);
+    for (uint32_t i = 0; i < n; ++i) dims[i] = i64();
+    return dims;
+  }
+  bool done() const { return pos_ == len_; }
+
+ private:
+  const uint8_t* take(size_t n) {
+    if (pos_ + n > len_) throw std::runtime_error("wire: truncated message");
+    const uint8_t* p = data_ + pos_;
+    pos_ += n;
+    return p;
+  }
+  const uint8_t* data_;
+  size_t len_;
+  size_t pos_ = 0;
+};
+
+}  // namespace hvd
+
+#endif  // HVD_NATIVE_WIRE_H

@@ -318,6 +318,62 @@ def _eager_alltoall(x, splits) -> np.ndarray:
     return np.concatenate(pieces, axis=0)
 
 
+# --- native-runtime routing ---------------------------------------------------
+#
+# When the native control plane (horovod_tpu.native — the C++ re-design of
+# the reference's background thread/controller/fusion/cache) is running,
+# every eager op is enqueued as a named request and executed only once the
+# coordinator declares it globally ready; requests submitted in the same
+# cycle fuse into one collective.  Without it (library unavailable or
+# HOROVOD_NATIVE=0), ops run directly in program order.
+
+
+def _native_rt():
+    from horovod_tpu import eager_runtime
+
+    return eager_runtime.get()
+
+
+def _native_kind_and_args(kind: str):
+    from horovod_tpu import native
+
+    return {
+        "allreduce": native.ALLREDUCE,
+        "allgather": native.ALLGATHER,
+        "broadcast": native.BROADCAST,
+        "alltoall": native.ALLTOALL,
+    }[kind]
+
+
+def _native_submit_tree(rt, kind: str, tree, name, **kw):
+    """Submit every leaf as its own named request; returns (treedef,
+    [(handle, name)]).  All leaves go in before any wait, so one
+    negotiation cycle sees — and fuses — the whole pytree."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    op_type = _native_kind_and_args(kind)
+    pairs = []
+    for i, leaf in enumerate(leaves):
+        lname = rt.auto_name(kind, f"{name}.{i}" if name and len(leaves) > 1
+                             else name)
+        arr = np.asarray(leaf)
+        h = rt.submit(lname, op_type, arr, **kw)
+        pairs.append((h, lname))
+    return treedef, pairs
+
+
+def _native_wait_tree(rt, treedef, pairs):
+    return jax.tree_util.tree_unflatten(
+        treedef, [rt.wait(h, n) for h, n in pairs]
+    )
+
+
+def _native_reduce_op(op: str) -> int:
+    from horovod_tpu import eager_runtime
+
+    to_native, _ = eager_runtime._op_maps()
+    return to_native[op]
+
+
 # --- public API --------------------------------------------------------------
 
 
@@ -350,10 +406,22 @@ def allreduce(
             _reraise_unbound(e)
     else:
         basics._ctx()
-        out = jax.tree_util.tree_map(
-            lambda t: _eager_allreduce(t, op, prescale_factor, postscale_factor),
-            tensor,
-        )
+        rt = _native_rt()
+        if rt is not None:
+            treedef, pairs = _native_submit_tree(
+                rt, "allreduce", tensor, name,
+                reduce_op=_native_reduce_op(op),
+                prescale=1.0 if prescale_factor is None else prescale_factor,
+                postscale=1.0 if postscale_factor is None else postscale_factor,
+            )
+            out = _native_wait_tree(rt, treedef, pairs)
+        else:
+            out = jax.tree_util.tree_map(
+                lambda t: _eager_allreduce(
+                    t, op, prescale_factor, postscale_factor
+                ),
+                tensor,
+            )
     if compression is not None:
         out = compression.decompress(out, ctx)
     return out
@@ -367,6 +435,17 @@ def grouped_allreduce(tensors: Sequence, op: str = Average, *, axis_name=None, *
     tensors = list(tensors)
     if _is_traced(tensors):
         return [allreduce(t, op, axis_name=axis_name, **kw) for t in tensors]
+    basics._ctx()
+    rt = _native_rt()
+    if rt is not None:
+        # Submit the whole group before waiting: one negotiation cycle sees
+        # all of it and fuses (routing through the native queue also keeps
+        # collective launch order globally consistent with concurrent
+        # async ops).
+        treedef, pairs = _native_submit_tree(
+            rt, "allreduce", tensors, None, reduce_op=_native_reduce_op(op)
+        )
+        return _native_wait_tree(rt, treedef, pairs)
     from horovod_tpu.ops import fusion
 
     return fusion.fused_eager_allreduce(tensors, op)
@@ -381,6 +460,10 @@ def allgather(tensor, *, axis_name=None, name: Optional[str] = None):
         except NameError as e:
             _reraise_unbound(e)
     basics._ctx()
+    rt = _native_rt()
+    if rt is not None:
+        treedef, pairs = _native_submit_tree(rt, "allgather", tensor, name)
+        return _native_wait_tree(rt, treedef, pairs)
     return jax.tree_util.tree_map(_eager_allgather, tensor)
 
 
@@ -392,6 +475,12 @@ def broadcast(tensor, root_rank: int = 0, *, axis_name=None, name=None):
         except NameError as e:
             _reraise_unbound(e)
     basics._ctx()
+    rt = _native_rt()
+    if rt is not None:
+        treedef, pairs = _native_submit_tree(
+            rt, "broadcast", tensor, name, root_rank=root_rank
+        )
+        return _native_wait_tree(rt, treedef, pairs)
     return jax.tree_util.tree_map(lambda t: _eager_broadcast(t, root_rank), tensor)
 
 
@@ -407,6 +496,10 @@ def alltoall(tensor, splits=None, *, axis_name=None, name=None):
         except NameError as e:
             _reraise_unbound(e)
     basics._ctx()
+    rt = _native_rt()
+    if rt is not None and splits is None:
+        treedef, pairs = _native_submit_tree(rt, "alltoall", tensor, name)
+        return _native_wait_tree(rt, treedef, pairs)
     return jax.tree_util.tree_map(lambda t: _eager_alltoall(t, splits), tensor)
 
 
@@ -422,7 +515,14 @@ def reducescatter(tensor, op: str = Average, *, axis_name=None, name=None):
 
 
 def barrier() -> None:
-    """Block until all processes arrive (eager, process-level)."""
+    """Block until all processes arrive (eager, process-level).  With the
+    native runtime this is a true BARRIER request through the coordinator;
+    otherwise a zero-byte allreduce."""
+    basics._ctx()
+    rt = _native_rt()
+    if rt is not None:
+        rt.barrier()
+        return
     _eager_allreduce(np.zeros((), np.float32), Sum, None, None)
 
 
@@ -460,23 +560,69 @@ class _HandleManager:
 _handles = _HandleManager()
 
 
+class _NativeInFlight:
+    """An op pending in the native runtime's negotiation queue (the
+    reference's handle, ``torch/handle_manager.cc:21-55``)."""
+
+    def __init__(self, rt, treedef, pairs):
+        self.rt = rt
+        self.treedef = treedef
+        self.pairs = pairs
+
+    def done(self) -> bool:
+        return all(self.rt.poll(h) for h, _ in self.pairs)
+
+    def resolve(self):
+        return _native_wait_tree(self.rt, self.treedef, self.pairs)
+
+
 def _async(fn, *args, **kw) -> int:
     return _handles.allocate(fn(*args, **kw))
 
 
 def allreduce_async(tensor, op: str = Average, name=None, **kw) -> int:
+    _check_op(op)
+    rt = None if _is_traced(tensor) else _native_rt()
+    if rt is not None:
+        basics._ctx()
+        pre = kw.get("prescale_factor")
+        post = kw.get("postscale_factor")
+        treedef, pairs = _native_submit_tree(
+            rt, "allreduce", tensor, name,
+            reduce_op=_native_reduce_op(op),
+            prescale=1.0 if pre is None else pre,
+            postscale=1.0 if post is None else post,
+        )
+        return _handles.allocate(_NativeInFlight(rt, treedef, pairs))
     return _async(allreduce, tensor, op, name=name, **kw)
 
 
 def allgather_async(tensor, name=None, **kw) -> int:
+    rt = None if _is_traced(tensor) else _native_rt()
+    if rt is not None:
+        basics._ctx()
+        treedef, pairs = _native_submit_tree(rt, "allgather", tensor, name)
+        return _handles.allocate(_NativeInFlight(rt, treedef, pairs))
     return _async(allgather, tensor, name=name, **kw)
 
 
 def broadcast_async(tensor, root_rank: int = 0, name=None, **kw) -> int:
+    rt = None if _is_traced(tensor) else _native_rt()
+    if rt is not None:
+        basics._ctx()
+        treedef, pairs = _native_submit_tree(
+            rt, "broadcast", tensor, name, root_rank=root_rank
+        )
+        return _handles.allocate(_NativeInFlight(rt, treedef, pairs))
     return _async(broadcast, tensor, root_rank, name=name, **kw)
 
 
 def alltoall_async(tensor, splits=None, name=None, **kw) -> int:
+    rt = None if _is_traced(tensor) else _native_rt()
+    if rt is not None and splits is None:
+        basics._ctx()
+        treedef, pairs = _native_submit_tree(rt, "alltoall", tensor, name)
+        return _handles.allocate(_NativeInFlight(rt, treedef, pairs))
     return _async(alltoall, tensor, splits, name=name, **kw)
 
 
@@ -492,6 +638,8 @@ def poll(handle: int) -> bool:
     val = _handles.peek(handle)
     if val is None:
         return True
+    if isinstance(val, _NativeInFlight):
+        return val.done()
     done = True
     for leaf in jax.tree_util.tree_leaves(val):
         if isinstance(leaf, jax.Array):
@@ -506,6 +654,8 @@ def synchronize(handle: int):
     """Wait for and return the result of an async op
     (``torch/mpi_ops.py`` ``synchronize``)."""
     val = _handles.take(handle)
+    if isinstance(val, _NativeInFlight):
+        return val.resolve()
     return jax.tree_util.tree_map(
         lambda l: jax.block_until_ready(l) if isinstance(l, jax.Array) else l, val
     )

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import shlex
+import signal
 import sys
 import threading
 from typing import Dict, List, Optional
@@ -99,6 +100,31 @@ def launch_job(
         if rc != 0:
             failure.set()
 
+    # Terminating the launcher must terminate every rank (the reference's
+    # SIGTERM path, gloo_run.py:201): ranks run in their own sessions, so
+    # without this a killed launcher orphans them mid-collective.
+    prev_handlers = {}
+
+    def _on_signal(signum, frame):
+        import time
+
+        failure.set()
+        time.sleep(0.5)  # let the per-rank watchers deliver the group kills
+        prev = prev_handlers.get(signum)
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_DFL:
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+    in_main = threading.current_thread() is threading.main_thread()
+    if in_main:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev_handlers[sig] = signal.signal(sig, _on_signal)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+
     try:
         for i, slot in enumerate(slots):
             t = threading.Thread(target=_run, args=(i, slot), daemon=True)
@@ -108,5 +134,11 @@ def launch_job(
             t.join()
     finally:
         server.stop()
+        if in_main:
+            for sig, prev in prev_handlers.items():
+                try:
+                    signal.signal(sig, prev)
+                except (ValueError, OSError):  # pragma: no cover
+                    pass
     bad = [rc for rc in exit_codes if rc]
     return bad[0] if bad else 0

@@ -40,13 +40,32 @@ def execute(
     """Run command in its own process group; tee output with an optional
     rank prefix (the reference's ``--tag-output`` behavior); kill the group
     if any event in ``events`` fires."""
+    # Keep CPython on the posix_spawn fast path (forking a JAX-laden,
+    # heavily threaded parent via fork_exec can deadlock on snapshotted
+    # locks).  posix_spawn requires: no preexec_fn, no start_new_session,
+    # close_fds=False, and an absolute executable path — so the new session
+    # comes from a setsid(1) wrapper and the executable is resolved here.
+    import shutil
+
+    use_shell = isinstance(command, str)
+    setsid = shutil.which("setsid")
+    if not use_shell and setsid:
+        argv = list(command)
+        resolved = shutil.which(argv[0])
+        if resolved:
+            argv[0] = resolved
+        cmd = [setsid] + argv
+        popen_kw = dict(close_fds=False)
+    else:  # fallback: fork path with its own session
+        cmd = command
+        popen_kw = dict(start_new_session=True)
     proc = subprocess.Popen(
-        command,
+        cmd,
         env=env,
-        shell=isinstance(command, str),
+        shell=use_shell,
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
-        preexec_fn=os.setsid,
+        **popen_kw,
     )
 
     p = (prefix.encode() if prefix else b"")

@@ -1,0 +1,123 @@
+"""Multi-process worker exercising the native control plane end-to-end.
+
+Spawned by tests/test_native.py with HOROVOD_RANK/HOROVOD_NUM_PROC and
+coordinator env set; mirrors the reference's test strategy of running the
+same test body on every rank under a launcher (SURVEY.md §4).
+Scenario selected by argv[1]: "full" (default) or "stall".
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.environ["REPO"])
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import eager_runtime  # noqa: E402
+
+SCENARIO = sys.argv[1] if len(sys.argv) > 1 else "full"
+
+rank = int(os.environ["HOROVOD_RANK"])
+size = int(os.environ.get("HOROVOD_NUM_PROC", os.environ.get("HOROVOD_SIZE")))
+
+hvd.init()
+rt = eager_runtime.get()
+assert rt is not None, "native runtime must be active for this test"
+assert hvd.num_processes() == size, (hvd.num_processes(), size)
+
+
+def scenario_stall():
+    """Rank 0 submits a tensor rank 1 never does; the coordinator's stall
+    inspector must warn (on rank 0's stderr) within the configured bound."""
+    import time
+
+    if rank == 0:
+        hvd.allreduce_async(np.ones(3, np.float32), hvd.Sum, name="stalled.t")
+    time.sleep(3.0)
+    # Both ranks still healthy for matching traffic afterwards.
+    out = hvd.allreduce(np.ones(2, np.float32), hvd.Sum, name="ok.t")
+    np.testing.assert_allclose(out, np.full(2, float(size)))
+    hvd.shutdown()
+    print(f"NATIVE-WORKER-OK rank={rank}")
+
+
+def scenario_full():
+    x = np.full((4,), float(rank + 1), np.float32)
+    total = sum(r + 1 for r in range(size))
+
+    # sync allreduce: Sum and Average
+    np.testing.assert_allclose(
+        hvd.allreduce(x, hvd.Sum, name="t.sum"), np.full((4,), total))
+    np.testing.assert_allclose(
+        hvd.allreduce(x, hvd.Average, name="t.avg"),
+        np.full((4,), total / size))
+
+    # async group submitted together -> fused by the controller
+    hs = [
+        hvd.allreduce_async(
+            np.full((8,), float(i + rank), np.float32), hvd.Sum, name=f"g.{i}")
+        for i in range(6)
+    ]
+    for i, h in enumerate(hs):
+        expect = sum(i + r for r in range(size))
+        np.testing.assert_allclose(
+            hvd.synchronize(h), np.full((8,), float(expect)))
+
+    # broadcast from the last process's first worker
+    root_worker = hvd.local_size() * (size - 1)
+    val = np.array([float(rank * 10 + 5)], np.float32)
+    out = hvd.broadcast(val, root_rank=root_worker, name="b1")
+    np.testing.assert_allclose(out, [float((size - 1) * 10 + 5)])
+
+    # allgather with per-rank first dims
+    mine = np.full((rank + 1, 2), float(rank), np.float32)
+    out = hvd.allgather(mine, name="ag")
+    assert out.shape == (total, 2), out.shape
+
+    # response-cache steady state: repeats of the same name fast-path
+    for _ in range(5):
+        hvd.allreduce(x, hvd.Sum, name="cached.t")
+    assert rt.cache_hits() >= 3, rt.cache_hits()
+
+    # coordinator-detected shape mismatch -> error on every rank
+    if size > 1:
+        try:
+            hvd.allreduce(
+                np.zeros((2 + rank,), np.float32), hvd.Sum, name="bad.shape")
+            raise AssertionError("expected CollectiveError")
+        except eager_runtime.CollectiveError as e:
+            assert "Mismatched" in str(e), str(e)
+        # runtime stays healthy after an error response
+        np.testing.assert_allclose(
+            hvd.allreduce(x, hvd.Sum, name="after.err"), np.full((4,), total))
+
+    # Join: rank 0 leaves early; others keep reducing with rank 0
+    # contributing zeros, then join too.
+    if size > 1:
+        if rank == 0:
+            hvd.join()
+        else:
+            y = np.ones((3,), np.float32)
+            np.testing.assert_allclose(
+                hvd.allreduce(y, hvd.Sum, name="join.r"), y * (size - 1))
+            np.testing.assert_allclose(
+                hvd.allreduce(y, hvd.Average, name="join.r2"),
+                y * (size - 1) / size)
+            hvd.join()
+        np.testing.assert_allclose(
+            hvd.allreduce(x, hvd.Sum, name="post.join"), np.full((4,), total))
+
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"NATIVE-WORKER-OK rank={rank}")
+
+
+if SCENARIO == "stall":
+    scenario_stall()
+else:
+    scenario_full()

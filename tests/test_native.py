@@ -1,0 +1,190 @@
+"""Tests for the native (C++) control-plane runtime.
+
+Covers the subsystems the reference tests through its C++ core under
+mpirun (SURVEY.md §4): negotiation/ordering, tensor fusion, the response
+cache fast path, coordinator-detected mismatch errors, Join accounting,
+the stall inspector, the timeline writer, and clean shutdown.  Single
+process tests run against the session runtime (size=1 controller);
+multi-process tests spawn two real processes through the launcher.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import eager_runtime, native
+from horovod_tpu.runner import launch
+from horovod_tpu.runner.hosts import HostSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "native_worker.py")
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestNativeBuild:
+    def test_library_builds_and_loads(self):
+        assert native.native_built(), native.build_error()
+
+    def test_dtype_mapping(self):
+        assert native.dtype_enum(np.dtype("float32")) == 7
+        assert native.dtype_name(10) == "bfloat16"
+        with pytest.raises(TypeError):
+            native.dtype_enum("complex64")
+
+
+class TestSingleProcessRuntime:
+    """The session fixture starts the native runtime with size=1: the full
+    enqueue -> negotiate -> fuse -> execute pipeline minus sockets."""
+
+    def test_runtime_active(self, hvd):
+        rt = eager_runtime.get()
+        assert rt is not None, native.build_error()
+        assert rt.cycles() > 0
+
+    def test_sync_ops_through_native(self, hvd):
+        rt = eager_runtime.get()
+        before = rt.cycles()
+        out = hvd.allreduce(np.arange(6, dtype=np.float32), hvd.Sum,
+                            name="nat.t1")
+        np.testing.assert_allclose(out, np.arange(6, dtype=np.float32))
+        assert rt.cycles() > before
+
+    def test_fused_async_group(self, hvd):
+        hs = [
+            hvd.allreduce_async(np.full((5,), float(i)), hvd.Sum,
+                                name=f"nat.fuse.{i}")
+            for i in range(4)
+        ]
+        for i, h in enumerate(hs):
+            np.testing.assert_allclose(hvd.synchronize(h),
+                                       np.full((5,), float(i)))
+
+    def test_duplicate_name_rejected(self, hvd):
+        h = hvd.allreduce_async(np.ones(3), hvd.Sum, name="nat.dup")
+        with pytest.raises(eager_runtime.CollectiveError,
+                           match="duplicate|already"):
+            hvd.allreduce_async(np.ones(3), hvd.Sum, name="nat.dup")
+        hvd.synchronize(h)
+
+    def test_cache_populates_and_hits(self, hvd):
+        rt = eager_runtime.get()
+        entries_before = rt.cache_entries()
+        for _ in range(4):
+            hvd.allreduce(np.ones(2, np.float32), hvd.Sum, name="nat.cached")
+        assert rt.cache_entries() > entries_before or rt.cache_hits() > 0
+
+    def test_poll_eventually_true(self, hvd):
+        h = hvd.allreduce_async(np.ones(4), hvd.Average, name="nat.poll")
+        import time
+
+        deadline = time.time() + 10
+        while not hvd.poll(h):
+            assert time.time() < deadline
+            time.sleep(0.001)
+        np.testing.assert_allclose(hvd.synchronize(h), np.ones(4))
+
+    def test_barrier(self, hvd):
+        hvd.barrier()  # size=1: completes via the BARRIER response path
+
+    def test_mixed_dtypes_separate_buckets(self, hvd):
+        a = hvd.allreduce_async(np.ones(3, np.float32), hvd.Sum, name="nat.f32")
+        b = hvd.allreduce_async(np.ones(3, np.int32), hvd.Sum, name="nat.i32")
+        ra, rb = hvd.synchronize(a), hvd.synchronize(b)
+        assert ra.dtype == np.float32 and rb.dtype == np.int32
+
+
+class TestResponseWire:
+    def test_parse_roundtrip_via_executor(self, hvd):
+        """The executor's parsed Response must faithfully carry names,
+        shapes and scales — checked by a prescaled op end-to-end."""
+        out = hvd.allreduce(np.full((2, 3), 2.0, np.float32), hvd.Sum,
+                            name="nat.scaled", prescale_factor=0.5,
+                            postscale_factor=4.0)
+        np.testing.assert_allclose(out, np.full((2, 3), 4.0))
+
+
+def _spawn_workers(tmp_path, scenario, extra_env=None, nproc=2):
+    out = tmp_path / "out"
+    env = {
+        "PATH": os.environ.get("PATH", ""),
+        "REPO": REPO,
+        "PALLAS_AXON_POOL_IPS": "",  # keep subprocesses off the TPU
+        "HOROVOD_NUM_PROC": str(nproc),
+        "HOROVOD_JAX_PORT": str(_free_port()),
+        "HOROVOD_NATIVE_PORT": str(_free_port()),
+        "HOROVOD_CYCLE_TIME": "1",
+    }
+    env.update(extra_env or {})
+    rc = launch.launch_job(
+        [sys.executable, WORKER, scenario],
+        [HostSpec("localhost", 1)] * nproc,
+        env=env,
+        output_filename=str(out),
+    )
+    return rc, out
+
+
+@pytest.mark.skipif(not native.native_built(), reason="native lib unavailable")
+class TestMultiProcess:
+    def test_two_process_full_protocol(self, tmp_path):
+        rc, out = _spawn_workers(tmp_path, "full")
+        r0 = (out / "rank.0.stdout").read_text()
+        r1 = (out / "rank.1.stdout").read_text()
+        assert rc == 0, (out / "rank.0.stderr").read_text() + (
+            out / "rank.1.stderr").read_text()
+        assert "NATIVE-WORKER-OK rank=0" in r0
+        assert "NATIVE-WORKER-OK rank=1" in r1
+
+    def test_stall_inspector_warns(self, tmp_path):
+        rc, out = _spawn_workers(
+            tmp_path, "stall",
+            extra_env={"HOROVOD_STALL_CHECK_TIME_SECONDS": "1"})
+        assert rc == 0
+        stderr0 = (out / "rank.0.stderr").read_text()
+        assert "missing ranks [1]" in stderr0, stderr0
+        assert "stalled.t" in stderr0
+
+
+@pytest.mark.skipif(not native.native_built(), reason="native lib unavailable")
+class TestTimelineNative:
+    def test_timeline_json_written(self, tmp_path):
+        """Run a small single-process job with HOROVOD_TIMELINE set and
+        validate the chrome-tracing output (role of the reference's
+        test_timeline.py)."""
+        tl = tmp_path / "timeline.json"
+        script = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import numpy as np, horovod_tpu as hvd\n"
+            "hvd.init()\n"
+            "for i in range(3):\n"
+            "    hvd.allreduce(np.ones(4, np.float32), hvd.Sum, name='tl.t')\n"
+            "hvd.shutdown()\n"
+        )
+        env = dict(os.environ)
+        env.update({
+            "HOROVOD_TIMELINE": str(tl),
+            "HOROVOD_TIMELINE_MARK_CYCLES": "1",
+            "PALLAS_AXON_POOL_IPS": "",
+            "PYTHONPATH": REPO,
+        })
+        subprocess.run([sys.executable, "-c", script], cwd=REPO, env=env,
+                       check=True, timeout=180)
+        events = json.loads(tl.read_text())
+        names = {e.get("name") for e in events}
+        assert "NEGOTIATE" in names and "EXECUTE" in names
+        assert "CYCLE" in names
+        # thread metadata labels the tensor lane
+        assert any(e.get("ph") == "M" and
+                   e.get("args", {}).get("name") == "tl.t" for e in events)
