@@ -43,6 +43,11 @@ class TransformerConfig:
     n_experts: int = 0  # 0/1 = dense MLP
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
+    # "reference" = O(S^2) XLA softmax-attention; "flash" = the Pallas
+    # fused kernel (horovod_tpu.ops.attention); "ring" = sequence-parallel
+    # ring attention over the ``sp`` mesh axis (requires running under
+    # shard_map with sp bound and sequence sharded over it).
+    attention_impl: str = "reference"
 
     @property
     def head_dim(self) -> int:
@@ -146,13 +151,14 @@ def _rmsnorm(x, scale):
     return (out * scale).astype(x.dtype)
 
 
-def _rope(q, k, theta: float):
+def _rope(q, k, theta: float, pos_offset=0):
     """Rotary position embedding over the head dim (applied to q and k).
-    Shapes: (B, S, H, Dh)."""
+    Shapes: (B, S, H, Dh).  ``pos_offset`` shifts positions when the
+    sequence axis is sharded (ring attention: shard r starts at r*S_local)."""
     B, S, H, Dh = q.shape
     half = Dh // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    pos = jnp.arange(S, dtype=jnp.float32)
+    pos = pos_offset + jnp.arange(S, dtype=jnp.float32)
     ang = pos[:, None] * freqs[None, :]  # (S, half)
     cos = jnp.cos(ang)[None, :, None, :]
     sin = jnp.sin(ang)[None, :, None, :]
@@ -171,14 +177,24 @@ def _attention(x, p, cfg: TransformerConfig):
     q = jnp.einsum("bsd,dhk->bshk", x, p["wq"].astype(cfg.dtype))
     k = jnp.einsum("bsd,dhk->bshk", x, p["wk"].astype(cfg.dtype))
     v = jnp.einsum("bsd,dhk->bshk", x, p["wv"].astype(cfg.dtype))
-    q, k = _rope(q, k, cfg.rope_theta)
-    scores = jnp.einsum("bshk,bthk->bhst", q, k).astype(jnp.float32)
-    scores = scores / np.sqrt(cfg.head_dim)
-    rows = lax.broadcasted_iota(jnp.int32, (S, S), 0)
-    cols = lax.broadcasted_iota(jnp.int32, (S, S), 1)
-    scores = jnp.where(cols[None, None] <= rows[None, None], scores, -1e30)
-    w = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
-    o = jnp.einsum("bhst,bthk->bshk", w, v)
+    pos_offset = 0
+    if cfg.attention_impl == "ring":
+        # Sequence is sharded over sp: this shard's tokens start at
+        # sp_index * S_local in the global sequence.
+        pos_offset = lax.axis_index("sp") * S
+    q, k = _rope(q, k, cfg.rope_theta, pos_offset)
+    from horovod_tpu.ops import attention as attn
+
+    qh = jnp.moveaxis(q, 2, 1)  # (B, H, S, Dh)
+    kh = jnp.moveaxis(k, 2, 1)
+    vh = jnp.moveaxis(v, 2, 1)
+    if cfg.attention_impl == "ring":
+        oh = attn.ring_attention(qh, kh, vh, axis_name="sp", causal=True)
+    elif cfg.attention_impl == "flash":
+        oh = attn.flash_attention(qh, kh, vh, True)
+    else:
+        oh = attn.reference_attention(qh, kh, vh, causal=True)
+    o = jnp.moveaxis(oh, 1, 2).astype(cfg.dtype)  # (B, S, H, Dh)
     return jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(cfg.dtype))
 
 

@@ -77,6 +77,7 @@ bool Controller::ComputeResponseList(std::vector<Request> pending,
     for (auto& [bit, req] : cached) {
       if (GetBit(and_bits, kFlagBits + bit)) {
         cache_.CountHit();
+        cache_.Touch(bit);  // keep hot steady-state entries off the LRU tail
         single.push_back(cache_.Get(bit));
       } else {
         uncached.push_back(std::move(req));
@@ -137,9 +138,13 @@ bool Controller::ComputeResponseList(std::vector<Request> pending,
     // Insert fresh single-tensor responses into the cache — every rank does
     // this in identical bcast order, keeping bit positions aligned.
     for (auto& resp : constructed.responses) {
-      if (resp.type != RespType::ERROR && resp.type != RespType::JOIN &&
-          resp.type != RespType::BARRIER && resp.joined_ranks.empty() &&
-          resp.tensor_names.size() == 1) {
+      // Cache only ops whose metadata is identical on every rank:
+      // allgather/alltoall legitimately vary in dim 0 per rank, so a
+      // cached key built from the coordinator's shape would mismatch on
+      // every other rank and force a divergence round each cycle.
+      if ((resp.type == RespType::ALLREDUCE ||
+           resp.type == RespType::BROADCAST) &&
+          resp.joined_ranks.empty() && resp.tensor_names.size() == 1) {
         Request key;
         key.type = static_cast<ReqType>(resp.type);
         key.op = resp.op;

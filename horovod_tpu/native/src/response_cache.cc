@@ -49,6 +49,11 @@ void ResponseCache::Put(const Request& req, const Response& resp) {
   lru_.push_front(bit);
 }
 
+void ResponseCache::Touch(size_t bit) {
+  lru_.remove(bit);
+  lru_.push_front(bit);
+}
+
 void ResponseCache::Erase(const std::string& name) {
   auto it = by_name_.find(name);
   if (it == by_name_.end()) return;

@@ -37,6 +37,11 @@ class ResponseCache {
   const Response& Get(size_t bit) const { return entries_[bit].response; }
   const Request& GetRequest(size_t bit) const { return entries_[bit].request; }
 
+  // Refresh LRU recency for a fast-path hit.  Every rank must call this
+  // for the same bits in the same (globally agreed) order to keep
+  // eviction in lockstep.
+  void Touch(size_t bit);
+
   void Erase(const std::string& name);
   void Clear();
 

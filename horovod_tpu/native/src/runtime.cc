@@ -43,7 +43,10 @@ void Runtime::Shutdown() {
 }
 
 int64_t Runtime::Enqueue(const Request& req) {
-  if (!initialized_.load()) return -2;
+  // Reject after the background loop has exited (remote shutdown or
+  // coordination failure): nothing will ever pop the queue, so accepting
+  // the request would hang the caller forever.
+  if (!initialized_.load() || stop_.load()) return -2;
   int64_t h = queue_.Add(req);
   if (h >= 0) timeline_.Begin(req.name, Timeline::kNegotiate);
   return h;

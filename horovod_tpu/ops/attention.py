@@ -1,0 +1,319 @@
+"""Attention kernels: Pallas flash attention + ring attention (sequence
+parallelism over the mesh).
+
+The reference framework has no attention ops at all — sequence length is
+invisible to it (SURVEY.md §5.7: tensors are opaque byte buffers, and the
+op set is allreduce/allgather/broadcast/join).  These are the TPU-native
+extensions the rebuild is required to treat as first-class: long-context
+attention as a fused-VMEM Pallas kernel, and context parallelism as
+``lax.ppermute`` rotations of K/V shards over the ICI ring — the
+collective pattern the reference could only have expressed as NCCL
+point-to-points.
+
+Layout convention: ``(batch, heads, seq, head_dim)`` f32/bf16.
+
+* :func:`flash_attention` — online-softmax tiled attention, one Pallas
+  kernel; O(block) VMEM, saves the logsumexp for the backward.  Backward
+  is the standard analytic flash backward (dq/dk/dv from the saved LSE)
+  expressed blockwise in XLA — recomputation happens per K-block inside a
+  ``lax.scan`` so memory stays O(S·block).
+* :func:`ring_attention` — each device holds a contiguous sequence shard;
+  K/V shards rotate around the ring with ``lax.ppermute`` while the local
+  Q accumulates partial attention, merged by logsumexp weighting.  Causal
+  masking degrades gracefully: a fully-masked chunk contributes weight
+  exp(-1e30 - lse) == 0.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+NEG_INF = -1e30  # finite mask value: exp(NEG_INF - anything_real) == 0
+
+
+def _sm_scale(q, sm_scale):
+    return 1.0 / np.sqrt(q.shape[-1]) if sm_scale is None else sm_scale
+
+
+# --- reference (oracle) -------------------------------------------------------
+
+
+def _reference_attention_lse(q, k, v, causal, scale):
+    """One O(S^2) score computation -> (output, logsumexp)."""
+    scores = jnp.einsum("bhsd,bhtd->bhst", q, k).astype(jnp.float32) * scale
+    if causal:
+        S, T = scores.shape[-2], scores.shape[-1]
+        rows = lax.broadcasted_iota(jnp.int32, (S, T), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (S, T), 1)
+        scores = jnp.where(cols <= rows, scores, NEG_INF)
+    lse = jax.nn.logsumexp(scores, axis=-1)
+    w = jnp.exp(scores - lse[..., None])
+    o = jnp.einsum("bhst,bhtd->bhsd", w.astype(v.dtype), v)
+    return o, lse
+
+
+def reference_attention(q, k, v, *, causal: bool = False,
+                        sm_scale: Optional[float] = None):
+    """O(S^2)-memory oracle used by tests and as the small-shape fallback."""
+    o, _ = _reference_attention_lse(q, k, v, causal, _sm_scale(q, sm_scale))
+    return o
+
+
+# --- Pallas forward kernel ----------------------------------------------------
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                      acc_ref, m_ref, l_ref,
+                      *, block_q: int, block_k: int, causal: bool,
+                      scale: float, num_k: int):
+    """Grid: (batch*heads, num_q_blocks, num_k_blocks); K innermost, so the
+    (acc, m, l) scratch carries the online softmax across K steps."""
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: K blocks strictly above the diagonal contribute nothing.
+    run = True
+    if causal:
+        run = ik * block_k <= iq * block_q + block_q - 1
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)  # (block_q, d)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
+        if causal:
+            rows = iq * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ik * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        m_prev = m_ref[:, :1]                               # (block_q, 1)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)          # (block_q, 1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                              # (block_q, block_k)
+        alpha = jnp.exp(m_prev - m_new)                     # (block_q, 1)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ik == num_k - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l > 0, l, 1.0)
+        o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        # LSE layout (BH, 8, S): 8 replicated sublanes satisfy the TPU
+        # (÷8, ÷128) tile constraint; caller reads sublane 0.
+        lse = m_ref[:, 0] + jnp.log(l_safe[:, 0])  # (block_q,)
+        lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
+
+
+try:  # pallas is TPU/GPU-oriented; keep import failure non-fatal on CPU
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    _PALLAS = True
+except Exception:  # pragma: no cover
+    _PALLAS = False
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _flash_fwd(q, k, v, causal: bool, sm_scale, block_q: int, block_k: int):
+    B, H, S, D = q.shape
+    T = k.shape[2]
+    block_q = min(block_q, S)
+    block_k = min(block_k, T)
+    scale = _sm_scale(q, sm_scale)
+    if (not _PALLAS or S % block_q or T % block_k
+            or D % 8):  # fall back for shapes the kernel can't tile
+        return _reference_attention_lse(q, k, v, causal, scale)
+    nq, nk = S // block_q, T // block_k
+    kernel = functools.partial(
+        _flash_fwd_kernel, block_q=block_q, block_k=block_k,
+        causal=causal, scale=scale, num_k=nk)
+    qr = q.reshape(B * H, S, D)
+    kr = k.reshape(B * H, T, D)
+    vr = v.reshape(B * H, T, D)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B * H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 8, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(qr, kr, vr)
+    return o.reshape(B, H, S, D), lse[:, 0, :].reshape(B, H, S)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
+    """Analytic flash backward from the saved LSE, scanned over K blocks:
+
+        p_ij = exp(q_i k_j^T * scale - lse_i)
+        dv_j = p^T do ;  dp = do v^T ;  ds = p * (dp - rowsum(do * o))
+        dq_i += ds k_j * scale ;  dk_j = ds^T q_i * scale
+    """
+    q, k, v, o, lse = res
+    B, H, S, D = q.shape
+    T = k.shape[2]
+    scale = _sm_scale(q, sm_scale)
+    bk = min(block_k, T)
+    if T % bk:
+        bk = T
+    nk = T // bk
+
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (B,H,S)
+
+    rows = lax.broadcasted_iota(jnp.int32, (S, bk), 0)
+
+    def kblock(carry, jb):
+        dq = carry
+        ks = lax.dynamic_slice_in_dim(k, jb * bk, bk, axis=2).astype(jnp.float32)
+        vs = lax.dynamic_slice_in_dim(v, jb * bk, bk, axis=2).astype(jnp.float32)
+        s = jnp.einsum("bhsd,bhtd->bhst", qf, ks) * scale  # (B,H,S,bk)
+        if causal:
+            cols = jb * bk + lax.broadcasted_iota(jnp.int32, (S, bk), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])                     # (B,H,S,bk)
+        dv = jnp.einsum("bhst,bhsd->bhtd", p, dof)
+        dp = jnp.einsum("bhsd,bhtd->bhst", dof, vs)
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("bhst,bhtd->bhsd", ds, ks)
+        dk = jnp.einsum("bhst,bhsd->bhtd", ds, qf)
+        return dq, (dk, dv)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dks, dvs) = lax.scan(kblock, dq0, jnp.arange(nk))
+    dk = jnp.moveaxis(dks, 0, 2).reshape(B, H, T, D)
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(B, H, T, D)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 512, block_k: int = 512):
+    """Fused tiled attention.  ``(B, H, S, D) x (B, H, T, D) -> (B, H, S, D)``.
+
+    Forward runs as one Pallas TPU kernel (online softmax, O(block) VMEM);
+    on CPU it runs the same kernel under the Pallas interpreter.  Shapes
+    that can't tile (S % block, D % 8) silently use the XLA reference.
+    """
+    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o
+
+
+def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _fa_bwd(causal, sm_scale, block_q, block_k, res, do):
+    return _flash_bwd(causal, sm_scale, block_q, block_k, res, do)
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+
+
+# --- chunk attention with LSE (building block for ring) -----------------------
+
+
+def _chunk_attn(q, k, v, mask, scale):
+    """Attention of local q over one K/V chunk with an additive bool mask
+    (True = allowed); returns per-chunk normalized output + LSE."""
+    s = jnp.einsum("bhsd,bhtd->bhst", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.maximum(m, NEG_INF)  # fully-masked rows stay at NEG_INF
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l_safe = jnp.where(l > 0, l, 1.0)
+    o = jnp.einsum("bhst,bhtd->bhsd", p / l_safe, v.astype(jnp.float32))
+    lse = (m + jnp.log(l_safe))[..., 0]  # (B,H,S)
+    return o, lse
+
+
+def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                   sm_scale: Optional[float] = None):
+    """Sequence-parallel attention inside ``shard_map``: every device holds
+    a contiguous sequence shard of q/k/v ``(B, H, S_local, D)``; K/V rotate
+    around the mesh-axis ring via ``lax.ppermute`` (ICI neighbor exchange)
+    while partial attention accumulates with logsumexp merging.
+
+    With ``causal=True``, shard ``r`` attends fully to shards ``< r``,
+    causally to itself, and not at all to shards ``> r`` (those chunks are
+    masked to NEG_INF and vanish in the merge).  Differentiable end-to-end;
+    the VJP rides the transposed ``ppermute``s back around the ring.
+    """
+    P = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    scale = _sm_scale(q, sm_scale)
+    B, H, S, D = q.shape
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    rows = lax.broadcasted_iota(jnp.int32, (S, S), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (S, S), 1)
+
+    def step(carry, s_idx):
+        o, lse, ks, vs = carry
+        src = (me - s_idx) % P  # which shard's K/V we hold this step
+        if causal:
+            allowed = jnp.where(
+                src < me,
+                jnp.ones((S, S), bool),
+                jnp.where(src == me, cols <= rows, jnp.zeros((S, S), bool)),
+            )[None, None]
+        else:
+            allowed = None
+        o_c, lse_c = _chunk_attn(q, ks, vs, allowed, scale)
+        lse_new = jnp.logaddexp(lse, lse_c)
+        o = (o * jnp.exp(lse - lse_new)[..., None]
+             + o_c * jnp.exp(lse_c - lse_new)[..., None])
+        ks = lax.ppermute(ks, axis_name, perm)
+        vs = lax.ppermute(vs, axis_name, perm)
+        return (o, lse_new, ks, vs), None
+
+    # Derive the initial carry from q so it inherits q's varying-over-axis
+    # type under shard_map (a plain literal would mismatch the carry-out).
+    o0 = jnp.zeros_like(q, jnp.float32) * 0.0
+    lse0 = q[..., 0].astype(jnp.float32) * 0.0 + NEG_INF
+    (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(P))
+    return o.astype(q.dtype)

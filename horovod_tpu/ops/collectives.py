@@ -562,18 +562,25 @@ _handles = _HandleManager()
 
 class _NativeInFlight:
     """An op pending in the native runtime's negotiation queue (the
-    reference's handle, ``torch/handle_manager.cc:21-55``)."""
+    reference's handle, ``torch/mpi_ops_v2.cc`` + ``handle_manager.cc:
+    21-55``).  Carries the compression context so ``synchronize``
+    decompresses, matching the synchronous path."""
 
-    def __init__(self, rt, treedef, pairs):
+    def __init__(self, rt, treedef, pairs, compression=None, ctx=None):
         self.rt = rt
         self.treedef = treedef
         self.pairs = pairs
+        self.compression = compression
+        self.ctx = ctx
 
     def done(self) -> bool:
         return all(self.rt.poll(h) for h, _ in self.pairs)
 
     def resolve(self):
-        return _native_wait_tree(self.rt, self.treedef, self.pairs)
+        out = _native_wait_tree(self.rt, self.treedef, self.pairs)
+        if self.compression is not None:
+            out = self.compression.decompress(out, self.ctx)
+        return out
 
 
 def _async(fn, *args, **kw) -> int:
@@ -585,6 +592,10 @@ def allreduce_async(tensor, op: str = Average, name=None, **kw) -> int:
     rt = None if _is_traced(tensor) else _native_rt()
     if rt is not None:
         basics._ctx()
+        compression = kw.get("compression")
+        ctx = None
+        if compression is not None:
+            tensor, ctx = compression.compress(tensor)
         pre = kw.get("prescale_factor")
         post = kw.get("postscale_factor")
         treedef, pairs = _native_submit_tree(
@@ -593,7 +604,9 @@ def allreduce_async(tensor, op: str = Average, name=None, **kw) -> int:
             prescale=1.0 if pre is None else pre,
             postscale=1.0 if post is None else post,
         )
-        return _handles.allocate(_NativeInFlight(rt, treedef, pairs))
+        return _handles.allocate(
+            _NativeInFlight(rt, treedef, pairs, compression, ctx)
+        )
     return _async(allreduce, tensor, op, name=name, **kw)
 
 

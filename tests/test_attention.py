@@ -1,0 +1,194 @@
+"""Flash attention (Pallas, interpreted on CPU) and ring attention
+(sequence parallelism over the 8-device mesh) tests.
+
+The reference has no attention ops (SURVEY.md §5.7) — these cover the
+TPU-native long-context extensions.  Oracle: O(S^2) reference_attention.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import spmd
+from horovod_tpu.ops import attention as A
+
+N = 8
+
+
+def _qkv(b=2, h=2, s=128, d=32, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    shape = (b, h, s, d)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+class TestFlashForward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv()
+        out = A.flash_attention(q, k, v, causal, None, 64, 64)
+        ref = A.reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_single_block(self):
+        q, k, v = _qkv(s=64)
+        out = A.flash_attention(q, k, v, False, None, 64, 64)
+        ref = A.reference_attention(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_many_blocks_long_seq(self):
+        q, k, v = _qkv(b=1, h=1, s=512, d=32)
+        out = A.flash_attention(q, k, v, True, None, 64, 128)
+        ref = A.reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_scale_override(self):
+        q, k, v = _qkv(s=64)
+        out = A.flash_attention(q, k, v, False, 0.5, 64, 64)
+        ref = A.reference_attention(q, k, v, sm_scale=0.5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_fallback_untileable(self):
+        # S=100 doesn't tile by 64: silently uses the XLA reference path.
+        q, k, v = _qkv(s=100, d=20)
+        out = A.flash_attention(q, k, v, True, None, 64, 64)
+        ref = A.reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+
+class TestFlashBackward:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_reference(self, causal):
+        q, k, v = _qkv(s=128)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(A.flash_attention(q, k, v, causal, None, 64, 64) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(A.reference_attention(q, k, v, causal=causal) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=5e-4, rtol=5e-4, err_msg=name)
+
+    def test_grad_under_jit(self):
+        q, k, v = _qkv(s=64)
+        g = jax.jit(jax.grad(
+            lambda q: jnp.sum(A.flash_attention(q, k, v, True, None, 64, 64))
+        ))(q)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestRingAttention:
+    def _run_ring(self, q, k, v, causal):
+        """q/k/v are (B, H, S_total, D); shard the sequence over the mesh."""
+        B, H, S, D = q.shape
+
+        def inner(qs, ks, vs):
+            return A.ring_attention(
+                qs, ks, vs, axis_name=hvd.AXIS, causal=causal)
+
+        f = spmd.shard(
+            inner,
+            in_specs=(P(None, None, hvd.AXIS, None),) * 3,
+            out_specs=P(None, None, hvd.AXIS, None),
+        )
+        return jax.jit(f)(q, k, v)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, causal):
+        q, k, v = _qkv(b=1, h=2, s=N * 16, d=32)
+        out = self._run_ring(q, k, v, causal)
+        ref = A.reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_differentiable(self):
+        q, k, v = _qkv(b=1, h=1, s=N * 8, d=16)
+
+        def loss(q, k, v):
+            def inner(qs, ks, vs):
+                return A.ring_attention(qs, ks, vs, axis_name=hvd.AXIS,
+                                        causal=True)
+            f = spmd.shard(
+                inner,
+                in_specs=(P(None, None, hvd.AXIS, None),) * 3,
+                out_specs=P(None, None, hvd.AXIS, None),
+            )
+            return jnp.sum(f(q, k, v) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(A.reference_attention(q, k, v, causal=True) ** 2)
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-3, rtol=1e-3, err_msg=name)
+
+    def test_lse_merge_handles_masked_chunks(self):
+        """Causal ring: the first shard receives only future chunks from
+        others — their contributions must vanish, not NaN."""
+        q, k, v = _qkv(b=1, h=1, s=N * 4, d=16)
+        out = self._run_ring(q, k, v, True)
+        assert np.isfinite(np.asarray(out)).all()
+
+
+class TestTransformerIntegration:
+    """attention_impl config: flash and ring must match the reference
+    implementation through the full model forward."""
+
+    def _cfg(self, impl, dtype=jnp.float32):
+        from horovod_tpu.models import transformer as T
+
+        return T.TransformerConfig(
+            vocab_size=64, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+            max_seq=64, dtype=dtype, attention_impl=impl)
+
+    def test_flash_matches_reference_forward(self):
+        from horovod_tpu.models import transformer as T
+
+        cfg_ref = self._cfg("reference")
+        cfg_fl = self._cfg("flash")
+        params = T.init_params(jax.random.PRNGKey(0), cfg_ref)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 64)
+        ref = T.forward(params, tokens, cfg_ref)
+        fl = T.forward(params, tokens, cfg_fl)
+        np.testing.assert_allclose(np.asarray(fl), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_ring_matches_reference_forward(self):
+        """Sequence-parallel forward over the sp axis == full-sequence
+        reference forward."""
+        from horovod_tpu.models import transformer as T
+        from jax.sharding import Mesh
+
+        cfg_ref = self._cfg("reference")
+        cfg_ring = self._cfg("ring")
+        params = T.init_params(jax.random.PRNGKey(0), cfg_ref)
+        S = 64
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, S), 0, 64)
+        ref = T.forward(params, tokens, cfg_ref)
+
+        mesh = Mesh(np.array(jax.devices()[:N]), axis_names=("sp",))
+
+        def inner(params, tokens):
+            return T.forward(params, tokens, cfg_ring)
+
+        f = jax.jit(jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(), P(None, "sp")),
+            out_specs=P(None, "sp"),
+        ))
+        out = f(params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
