@@ -192,8 +192,12 @@ def _attention(x, p, cfg: TransformerConfig):
         oh = attn.ring_attention(qh, kh, vh, axis_name="sp", causal=True)
     elif cfg.attention_impl == "flash":
         oh = attn.flash_attention(qh, kh, vh, True)
-    else:
+    elif cfg.attention_impl == "reference":
         oh = attn.reference_attention(qh, kh, vh, causal=True)
+    else:
+        raise ValueError(
+            f"unknown attention_impl {cfg.attention_impl!r}; expected "
+            "'reference', 'flash' or 'ring'")
     o = jnp.moveaxis(oh, 1, 2).astype(cfg.dtype)  # (B, S, H, Dh)
     return jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(cfg.dtype))
 

@@ -30,38 +30,43 @@ void ResponseCache::Put(const Request& req, const Response& resp) {
   if (capacity_ == 0) return;
   auto it = by_name_.find(req.name);
   if (it != by_name_.end()) {
-    entries_[it->second] = Entry{req, resp};
-    lru_.remove(it->second);
-    lru_.push_front(it->second);
+    size_t bit = it->second;
+    entries_[bit].request = req;
+    entries_[bit].response = resp;
+    Touch(bit);
     return;
   }
   size_t bit;
   if (entries_.size() < capacity_) {
     bit = entries_.size();
-    entries_.push_back(Entry{req, resp});
+    lru_.push_front(bit);
+    entries_.push_back(Entry{req, resp, lru_.begin()});
   } else {
     bit = lru_.back();  // evict least-recently-executed
-    lru_.pop_back();
     by_name_.erase(entries_[bit].request.name);
-    entries_[bit] = Entry{req, resp};
+    entries_[bit].request = req;
+    entries_[bit].response = resp;
+    Touch(bit);
   }
   by_name_[req.name] = bit;
-  lru_.push_front(bit);
 }
 
 void ResponseCache::Touch(size_t bit) {
-  lru_.remove(bit);
-  lru_.push_front(bit);
+  // O(1): splice this entry's node to the front.
+  lru_.splice(lru_.begin(), lru_, entries_[bit].lru_it);
+  entries_[bit].lru_it = lru_.begin();
 }
 
 void ResponseCache::Erase(const std::string& name) {
   auto it = by_name_.find(name);
   if (it == by_name_.end()) return;
   // Keep the slot (bit positions of other entries must not shift); mark it
-  // unreachable by name so Lookup misses and Put may reuse it via LRU.
-  lru_.remove(it->second);
-  lru_.push_back(it->second);
-  entries_[it->second].request.name.clear();
+  // unreachable by name so Lookup misses, and park it at the LRU tail so
+  // Put reuses it first.
+  size_t bit = it->second;
+  lru_.splice(lru_.end(), lru_, entries_[bit].lru_it);
+  entries_[bit].lru_it = std::prev(lru_.end());
+  entries_[bit].request.name.clear();
   by_name_.erase(it);
 }
 

@@ -54,6 +54,7 @@ class ResponseCache {
   struct Entry {
     Request request;
     Response response;
+    std::list<size_t>::iterator lru_it;  // O(1) splice on Touch/Put
   };
   bool Matches(const Request& a, const Request& b) const;
 

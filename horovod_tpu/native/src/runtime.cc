@@ -21,6 +21,7 @@ bool Runtime::Init(const RuntimeOptions& opts, std::string* err) {
                                    opts.stall_shutdown_sec));
   if (!opts.timeline_path.empty() && opts.rank == 0)
     timeline_.Initialize(opts.timeline_path);
+  queue_.Reopen();
   stop_.store(false);
   shutdown_requested_.store(false);
   bg_thread_ = std::thread([this] { BackgroundLoop(); });

@@ -4,6 +4,7 @@ namespace hvd {
 
 int64_t TensorQueue::Add(const Request& req) {
   std::lock_guard<std::mutex> lk(mu_);
+  if (closed_) return -2;
   if (name_to_handle_.count(req.name)) return -1;  // duplicate-name race
   int64_t h = next_handle_++;
   name_to_handle_[req.name] = h;
@@ -37,6 +38,7 @@ void TensorQueue::Complete(const std::vector<std::string>& names,
 
 void TensorQueue::AbortAll(const Status& status) {
   std::lock_guard<std::mutex> lk(mu_);
+  closed_ = true;
   pending_.clear();
   for (auto& kv : handles_) {
     if (!kv.second.done) {
@@ -46,6 +48,11 @@ void TensorQueue::AbortAll(const Status& status) {
   }
   name_to_handle_.clear();
   cv_.notify_all();
+}
+
+void TensorQueue::Reopen() {
+  std::lock_guard<std::mutex> lk(mu_);
+  closed_ = false;
 }
 
 bool TensorQueue::Poll(int64_t handle) {

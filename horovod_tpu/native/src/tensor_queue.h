@@ -30,7 +30,9 @@ struct HandleState {
 
 class TensorQueue {
  public:
-  // Enqueue a request; returns the handle, or -1 on duplicate-name race.
+  // Enqueue a request; returns the handle, -1 on duplicate-name race, or
+  // -2 if the queue is closed (runtime aborted/shut down — accepting the
+  // request would hang the caller since nothing will ever pop it).
   int64_t Add(const Request& req);
 
   // Pop all pending requests (one negotiation cycle's worth — reference
@@ -40,9 +42,12 @@ class TensorQueue {
   // Mark every tensor in `names` complete with `status` and wake waiters.
   void Complete(const std::vector<std::string>& names, const Status& status);
 
-  // Fail everything (pending + in-flight) — shutdown path (reference
-  // operations.cc:515-521 SHUT_DOWN_ERROR delivery).
+  // Fail everything (pending + in-flight) and close the queue — shutdown
+  // path (reference operations.cc:515-521 SHUT_DOWN_ERROR delivery).
   void AbortAll(const Status& status);
+
+  // Re-open after a full runtime shutdown/re-init cycle.
+  void Reopen();
 
   // Handle API.
   bool Poll(int64_t handle);
@@ -52,6 +57,7 @@ class TensorQueue {
  private:
   std::mutex mu_;
   std::condition_variable cv_;
+  bool closed_ = false;  // set by AbortAll under mu_; rejects further Adds
   int64_t next_handle_ = 0;
   std::deque<Request> pending_;
   std::unordered_map<std::string, int64_t> name_to_handle_;

@@ -2,8 +2,13 @@
 parallelism (SURVEY.md §2.6: TP/PP/SP/EP are extensions, not ports).
 
 - :mod:`horovod_tpu.parallel.meshes` — multi-axis mesh construction
-- :mod:`horovod_tpu.parallel.ring_attention` — sequence parallelism
-- :mod:`horovod_tpu.parallel.pipeline` — pipeline parallelism
+- :func:`ring_attention` (re-export of
+  :func:`horovod_tpu.ops.attention.ring_attention`) — sequence/context
+  parallelism over a mesh axis
+- pipeline parallelism lives in the model sharding rules: the Transformer
+  stacks layers on a scanned axis sharded over ``pp``
+  (:func:`horovod_tpu.models.transformer.param_specs`)
 """
 
 from horovod_tpu.parallel.meshes import MeshSpec, make_mesh  # noqa: F401
+from horovod_tpu.ops.attention import ring_attention  # noqa: F401

@@ -1,0 +1,240 @@
+"""PyTorch frontend: the reference's ``horovod.torch`` API over the
+horovod_tpu runtime.
+
+Re-design of ``horovod/torch/__init__.py`` (v0.19): the same
+``DistributedOptimizer`` contract — per-parameter hooks fire an async
+allreduce the moment a gradient is accumulated, ``step()`` synchronizes
+them all — with the C++ binding layer (``mpi_ops_v2.cc`` + HandleManager)
+replaced by the native control-plane runtime shared with the JAX path.
+Torch here is the CPU host frontend; the collectives themselves execute
+as XLA programs.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from contextlib import contextmanager
+
+import torch
+
+from horovod_tpu.basics import (  # noqa: F401 — re-exports (basics.py:22-211)
+    cross_rank, cross_size, init, is_initialized, local_rank, local_size,
+    rank, shutdown, size,
+)
+from horovod_tpu.torch.compression import Compression  # noqa: F401
+from horovod_tpu.torch.mpi_ops import (  # noqa: F401
+    Adasum, Average, Max, Min, Product, Sum,
+    allgather, allgather_async, allreduce, allreduce_, allreduce_async,
+    allreduce_async_, alltoall, alltoall_async, broadcast, broadcast_,
+    broadcast_async, broadcast_async_, poll, synchronize,
+)
+
+
+def join() -> int:
+    from horovod_tpu.join import join as _join
+
+    return _join()
+
+
+class _DistributedOptimizer(torch.optim.Optimizer):
+    """Wraps a torch optimizer: gradients are allreduced asynchronously as
+    autograd accumulates them, and ``step`` waits for all handles.
+
+    Reference: ``torch/__init__.py:61-216`` — grad-accumulator hooks →
+    ``allreduce_async_``, ``synchronize()`` before ``super().step()``,
+    ``backward_passes_per_step`` local accumulation.
+    """
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step=1, op=Average):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self._op = op
+        self.backward_passes_per_step = backward_passes_per_step
+
+        if named_parameters is not None:
+            named_parameters = list(named_parameters)
+        else:
+            named_parameters = [
+                (f"allreduce.noname.{i}", v)
+                for i, group in enumerate(self.param_groups)
+                for v in group["params"]
+            ]
+        # Names must be unique and identical on every rank (the
+        # coordinator matches tensors by name).
+        if len({n for n, _ in named_parameters}) < len(named_parameters):
+            raise ValueError(
+                "named_parameters contains duplicate parameter names")
+        self._parameter_names = {v: n for n, v in named_parameters}
+        self._handles: dict = {}
+        self._grad_passes: dict = {}
+        self._synchronized = False
+        self._should_synchronize = True
+        self._hook_handles = []
+        # Hooks register unconditionally (reference behavior): with one
+        # worker the allreduce is an identity, so single-process runs
+        # exercise the same code path they'll run distributed.
+        self._register_hooks()
+
+    def _register_hooks(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                if p.requires_grad:
+                    h = p.register_post_accumulate_grad_hook(
+                        self._make_hook(p))
+                    self._hook_handles.append(h)
+
+    def _make_hook(self, p):
+        def hook(param):
+            # Local accumulation: only allreduce every
+            # backward_passes_per_step-th pass (reference
+            # torch/__init__.py:95-157).
+            passes = self._grad_passes.get(p, 0) + 1
+            self._grad_passes[p] = passes
+            if passes % self.backward_passes_per_step != 0:
+                return
+            if p in self._handles:
+                raise AssertionError(
+                    "Gradient for parameter was reduced twice before "
+                    "step(); call synchronize() or increase "
+                    "backward_passes_per_step")
+            name = self._parameter_names[p]
+            self._handles[p] = allreduce_async_(
+                p.grad, name=f"allreduce.{name}", op=self._op,
+                compression=self._compression,
+                prescale_factor=1.0 / self.backward_passes_per_step,
+            )
+
+        return hook
+
+    def synchronize(self):
+        """Wait for every outstanding gradient allreduce
+        (``torch/__init__.py:159-207``)."""
+        for p, h in list(self._handles.items()):
+            synchronize(h)
+        self._handles.clear()
+        self._grad_passes.clear()
+        self._synchronized = True
+
+    @contextmanager
+    def skip_synchronize(self):
+        """Use when ``synchronize()`` was called manually before
+        ``step()`` (e.g. for gradient clipping)."""
+        self._should_synchronize = False
+        try:
+            yield
+        finally:
+            self._should_synchronize = True
+
+    def step(self, closure=None):
+        if self._should_synchronize:
+            if self._synchronized:
+                pass  # user already synchronized explicitly
+            self.synchronize()
+        self._synchronized = False
+        return super(self.__class__, self).step(closure)
+
+    def zero_grad(self, *args, **kwargs):
+        if self._handles:
+            raise AssertionError(
+                "optimizer.zero_grad() was called after loss.backward() "
+                "but before optimizer.step() or optimizer.synchronize()")
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step=1, op=Average):
+    """Factory mirroring ``hvd.DistributedOptimizer``
+    (``torch/__init__.py`` factory): returns an instance of a dynamic
+    subclass of the wrapped optimizer's type."""
+    cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+               dict(_DistributedOptimizer.__dict__))
+    return cls(optimizer.param_groups, named_parameters, compression,
+               backward_passes_per_step, op)
+
+
+# --- state broadcast ----------------------------------------------------------
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast a state_dict or list of (name, tensor) pairs from
+    ``root_rank`` in place (``torch/__init__.py`` broadcast_parameters)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = list(params)
+    for name, p in items:
+        if torch.is_tensor(p):
+            broadcast_(p, root_rank, name=f"broadcast.{name}")
+
+
+def broadcast_object(obj, root_rank: int = 0, name: str = "broadcast.object"):
+    """Pickle-broadcast an arbitrary object (reference broadcast_object,
+    which uses cloudpickle; plain pickle covers optimizer state)."""
+    if rank() == root_rank:
+        buf = pickle.dumps(obj)
+        arr = torch.ByteTensor(bytearray(buf))
+        sz = torch.IntTensor([arr.numel()])
+    else:
+        arr = torch.ByteTensor()
+        sz = torch.IntTensor([0])
+    sz = broadcast(sz, root_rank, name=f"{name}.size")
+    if rank() != root_rank:
+        arr = torch.zeros(int(sz[0]), dtype=torch.uint8)
+    arr = broadcast(arr, root_rank, name=f"{name}.data")
+    return pickle.loads(bytes(arr.numpy().tobytes()))
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
+    """Broadcast optimizer state from root to all processes
+    (``torch/__init__.py`` broadcast_optimizer_state: tensor state is
+    broadcast as tensors, scalar state rides pickled)."""
+    if isinstance(optimizer, torch.optim.LBFGS):
+        raise ValueError("cannot broadcast torch.optim.LBFGS state")
+    state_dict = optimizer.state_dict()
+    # Rank 0's structure (param groups + which state keys exist) first.
+    meta = broadcast_object(
+        {
+            "param_groups": state_dict["param_groups"],
+            "state_keys": {
+                pid: sorted(
+                    (k, torch.is_tensor(v))
+                    for k, v in st.items()
+                )
+                for pid, st in state_dict["state"].items()
+            },
+        },
+        root_rank,
+        name="broadcast.opt.meta",
+    )
+    if rank() != root_rank:
+        state_dict["param_groups"] = meta["param_groups"]
+    scalars = {}
+    if rank() == root_rank:
+        scalars = {
+            (pid, k): v
+            for pid, st in state_dict["state"].items()
+            for k, v in st.items()
+            if not torch.is_tensor(v)
+        }
+    scalars = broadcast_object(scalars, root_rank, name="broadcast.opt.scalars")
+    new_state: dict = {}
+    for pid, keys in meta["state_keys"].items():
+        st = state_dict["state"].get(pid, {})
+        new_state[pid] = {}
+        for k, is_tensor in keys:
+            if is_tensor:
+                if k not in st or not torch.is_tensor(st[k]):
+                    raise ValueError(
+                        "broadcast_optimizer_state requires the optimizer "
+                        "to have state on all ranks — run one step on "
+                        "dummy gradients first (the reference initializes "
+                        "missing state the same way)")
+                new_state[pid][k] = broadcast(
+                    st[k], root_rank, name=f"broadcast.opt.{pid}.{k}")
+            else:
+                new_state[pid][k] = scalars[(pid, k)]
+    state_dict["state"] = new_state
+    optimizer.load_state_dict(state_dict)

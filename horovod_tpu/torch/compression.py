@@ -1,0 +1,42 @@
+"""Gradient compression for the torch frontend.
+
+Reference: ``horovod/torch/compression.py`` — ``Compression.none`` /
+``Compression.fp16`` compressor classes whose ``compress`` returns
+``(tensor, ctx)`` and ``decompress`` restores the original dtype.
+"""
+
+from __future__ import annotations
+
+import torch
+
+
+class NoneCompressor:
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class FP16Compressor:
+    """Cast float tensors to fp16 before the wire, back after
+    (``compression.py`` FP16Compressor)."""
+
+    @staticmethod
+    def compress(tensor):
+        if tensor.dtype.is_floating_point:
+            return tensor.to(torch.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is not None:
+            return tensor.to(ctx)
+        return tensor
+
+
+class Compression:
+    none = NoneCompressor
+    fp16 = FP16Compressor

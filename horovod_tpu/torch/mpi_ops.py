@@ -1,0 +1,182 @@
+"""Torch tensor collectives over the horovod_tpu runtime.
+
+Reference: ``horovod/torch/mpi_ops.py:72-508`` (handle-based async API
+backed by ``mpi_ops_v2.cc``'s HandleManager).  Here torch CPU tensors
+bridge zero-copy to numpy, ride the same eager path as JAX arrays —
+negotiated/fused/cached by the native control plane when it's running —
+and come back as torch tensors.  ``op=Average`` divides in the collective
+like the reference's completion callback (``mpi_ops_v2.cc:69-74``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import numpy as np
+import torch
+
+from horovod_tpu.ops import collectives as C
+
+# Reduce-op constants re-exported under the reference's names.
+Average = C.Average
+Sum = C.Sum
+Adasum = C.Adasum
+Min = C.Min
+Max = C.Max
+Product = C.Product
+
+
+_lock = threading.Lock()
+_next_handle = 0
+# handle -> (jax-level handle, postprocess(np.ndarray) -> torch.Tensor)
+_inflight: dict = {}
+
+
+def _to_numpy(t: torch.Tensor) -> np.ndarray:
+    if t.requires_grad:
+        t = t.detach()
+    if t.dtype == torch.bfloat16:
+        # numpy lacks native bf16; ml_dtypes provides it (jax dependency)
+        import ml_dtypes
+
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def _from_numpy(a: np.ndarray) -> torch.Tensor:
+    if a.dtype.name == "bfloat16":
+        return torch.from_numpy(np.ascontiguousarray(a).view(np.uint16)).view(
+            torch.bfloat16
+        )
+    return torch.from_numpy(np.ascontiguousarray(a))
+
+
+def _register(jax_handle: int, post) -> int:
+    global _next_handle
+    with _lock:
+        h = _next_handle
+        _next_handle += 1
+        _inflight[h] = (jax_handle, post)
+        return h
+
+
+def synchronize(handle: int) -> torch.Tensor:
+    """Wait for an async op and return its torch result
+    (``torch/mpi_ops.py`` ``synchronize``)."""
+    with _lock:
+        jax_handle, post = _inflight.pop(handle)
+    return post(C.synchronize(jax_handle))
+
+
+def poll(handle: int) -> bool:
+    with _lock:
+        entry = _inflight.get(handle)
+    if entry is None:
+        return True
+    return C.poll(entry[0])
+
+
+# --- allreduce ----------------------------------------------------------------
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    compression=None) -> int:
+    op = _resolve_op(average, op)
+    arr = _to_numpy(tensor)
+    ctx = None
+    if compression is not None:
+        tensor_c, ctx = compression.compress(_from_numpy(arr))
+        arr = _to_numpy(tensor_c)
+    jh = C.allreduce_async(arr, op, name=name,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor)
+
+    def post(a):
+        out = _from_numpy(np.asarray(a))
+        if compression is not None:
+            out = compression.decompress(out, ctx)
+        return out
+
+    return _register(jh, post)
+
+
+def allreduce(tensor, average=None, name=None, op=None, **kw) -> torch.Tensor:
+    return synchronize(allreduce_async(tensor, average, name, op, **kw))
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None, **kw) -> int:
+    """In-place variant: the result is copied back into ``tensor`` at
+    synchronize time (reference semantics of ``allreduce_async_``)."""
+    h = allreduce_async(tensor, average, name, op, **kw)
+    with _lock:
+        jh, post = _inflight[h]
+
+        def post_inplace(a, _post=post):
+            out = _post(a)
+            tensor.data.copy_(out.to(tensor.dtype))
+            return tensor
+
+        _inflight[h] = (jh, post_inplace)
+    return h
+
+
+def allreduce_(tensor, average=None, name=None, op=None, **kw) -> torch.Tensor:
+    return synchronize(allreduce_async_(tensor, average, name, op, **kw))
+
+
+def _resolve_op(average, op):
+    if op is not None:
+        return op
+    if average is None or average:
+        return Average
+    return Sum
+
+
+# --- allgather / broadcast / alltoall ----------------------------------------
+
+
+def allgather_async(tensor, name=None) -> int:
+    jh = C.allgather_async(_to_numpy(tensor), name=name)
+    return _register(jh, lambda a: _from_numpy(np.asarray(a)))
+
+
+def allgather(tensor, name=None) -> torch.Tensor:
+    return synchronize(allgather_async(tensor, name))
+
+
+def broadcast_async(tensor, root_rank, name=None) -> int:
+    jh = C.broadcast_async(_to_numpy(tensor), root_rank, name=name)
+    return _register(jh, lambda a: _from_numpy(np.asarray(a)))
+
+
+def broadcast(tensor, root_rank, name=None) -> torch.Tensor:
+    return synchronize(broadcast_async(tensor, root_rank, name))
+
+
+def broadcast_async_(tensor, root_rank, name=None) -> int:
+    h = broadcast_async(tensor, root_rank, name)
+    with _lock:
+        jh, post = _inflight[h]
+
+        def post_inplace(a, _post=post):
+            out = _post(a)
+            tensor.data.copy_(out.to(tensor.dtype))
+            return tensor
+
+        _inflight[h] = (jh, post_inplace)
+    return h
+
+
+def broadcast_(tensor, root_rank, name=None) -> torch.Tensor:
+    return synchronize(broadcast_async_(tensor, root_rank, name))
+
+
+def alltoall_async(tensor, splits=None, name=None) -> int:
+    jh = C.alltoall_async(_to_numpy(tensor), splits, name=name)
+    return _register(jh, lambda a: _from_numpy(np.asarray(a)))
+
+
+def alltoall(tensor, splits=None, name=None) -> torch.Tensor:
+    return synchronize(alltoall_async(tensor, splits, name))

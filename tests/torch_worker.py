@@ -1,0 +1,72 @@
+"""Two-process torch-frontend worker: distributed data-parallel training
+with DistributedOptimizer must keep replicas bit-identical (the
+reference's core contract), plus cross-rank op checks."""
+
+import os
+import sys
+
+sys.path.insert(0, os.environ["REPO"])
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import torch  # noqa: E402
+
+import horovod_tpu.torch as hvd  # noqa: E402
+
+hvd.init()
+rank = hvd.cross_rank()
+nproc = hvd.cross_size()
+assert nproc == 2
+
+# cross-rank allreduce value check
+x = torch.full((4,), float(rank + 1))
+out = hvd.allreduce(x, op=hvd.Sum)
+assert torch.allclose(out, torch.full((4,), 3.0)), out
+
+# broadcast from rank 1
+val = torch.full((2,), float(rank))
+out = hvd.broadcast(val, 1)
+assert torch.allclose(out, torch.full((2,), 1.0)), out
+
+# allgather with different first dims
+mine = torch.full((rank + 1, 2), float(rank))
+out = hvd.allgather(mine)
+assert out.shape == (3, 2), out.shape
+
+# DistributedOptimizer: different seeds per rank, broadcast aligns, then
+# each rank trains on DIFFERENT data; averaged gradients must keep the
+# replicas identical.
+torch.manual_seed(100 + rank)
+model = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.Tanh(),
+                            torch.nn.Linear(8, 1))
+hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+opt = hvd.DistributedOptimizer(
+    torch.optim.SGD(model.parameters(), lr=0.05),
+    named_parameters=model.named_parameters())
+
+torch.manual_seed(rank)  # different data per rank
+for step in range(5):
+    xb = torch.randn(16, 4)
+    yb = xb.sum(dim=1, keepdim=True)
+    opt.zero_grad()
+    loss = torch.nn.functional.mse_loss(model(xb), yb)
+    loss.backward()
+    opt.step()
+
+# replicas must agree exactly (same averaged grads from the same start)
+flat = torch.cat([p.detach().reshape(-1) for p in model.parameters()])
+gathered = hvd.allgather(flat.unsqueeze(0))
+assert torch.allclose(gathered[0], gathered[1], atol=1e-6), \
+    (gathered[0] - gathered[1]).abs().max()
+
+# optimizer state broadcast
+opt2 = torch.optim.Adam(model.parameters(), lr=1e-3)
+model(torch.randn(2, 4)).sum().backward()
+opt2.step()
+hvd.broadcast_optimizer_state(opt2, root_rank=0)
+
+hvd.shutdown()
+print(f"TORCH-WORKER-OK rank={rank}")
