@@ -1,0 +1,17 @@
+"""Estimator layer: Store + fit()/predict() estimators (the reference's
+Spark Estimator framework, ``horovod/spark/common/*`` — SURVEY.md §2.5 —
+re-designed over the run-func launcher instead of Spark)."""
+
+from horovod_tpu.estimator.estimator import (  # noqa: F401
+    EstimatorParams,
+    JaxEstimator,
+    JaxModel,
+    TorchEstimator,
+    TorchModel,
+)
+from horovod_tpu.estimator.store import (  # noqa: F401
+    HDFSStore,
+    LocalStore,
+    Store,
+    shard_arrays,
+)

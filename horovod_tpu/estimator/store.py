@@ -1,0 +1,163 @@
+"""Store: filesystem abstraction for training data shards and run
+artifacts (checkpoints, logs).
+
+Reference: ``horovod/spark/common/store.py:30-246`` — ``Store`` with
+``LocalStore``/``HDFSStore`` subclasses giving the estimator stable paths
+for intermediate data (``train_data_path``), checkpoints and logs, plus a
+serializable remote view.  The TPU re-design drops the Parquet/Petastorm
+machinery (numpy shards cover the estimator's data movement on a single
+host or shared filesystem) and keeps the path contract.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class Store:
+    """Abstract path provider (reference Store)."""
+
+    def __init__(self, prefix_path: str) -> None:
+        self.prefix_path = prefix_path
+
+    # -- path contract (reference store.py get_*_path methods) -----------
+    def get_train_data_path(self, idx: Optional[str] = None) -> str:
+        return self._join("intermediate_train_data" + (f".{idx}" if idx else ""))
+
+    def get_val_data_path(self, idx: Optional[str] = None) -> str:
+        return self._join("intermediate_val_data" + (f".{idx}" if idx else ""))
+
+    def get_runs_path(self) -> str:
+        return self._join("runs")
+
+    def get_run_path(self, run_id: str) -> str:
+        return os.path.join(self.get_runs_path(), run_id)
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "checkpoint.pkl")
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self.get_run_path(run_id), "logs")
+
+    def _join(self, *parts: str) -> str:
+        return os.path.join(self.prefix_path, *parts)
+
+    # -- IO (implemented by subclasses) ----------------------------------
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def read_bytes(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def to_remote(self) -> "Store":
+        """A picklable view usable inside workers (reference
+        ``to_remote``); Stores here are already plain-data objects."""
+        return self
+
+    # -- convenience on top of bytes IO ----------------------------------
+    def save_arrays(self, path: str, arrays: Dict[str, np.ndarray]) -> None:
+        import io
+
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        self.write_bytes(path, buf.getvalue())
+
+    def load_arrays(self, path: str) -> Dict[str, np.ndarray]:
+        import io
+
+        with np.load(io.BytesIO(self.read_bytes(path))) as z:
+            return {k: z[k] for k in z.files}
+
+    def save_obj(self, path: str, obj: Any) -> None:
+        self.write_bytes(path, pickle.dumps(obj))
+
+    def load_obj(self, path: str) -> Any:
+        return pickle.loads(self.read_bytes(path))
+
+    @staticmethod
+    def create(prefix_path: str) -> "Store":
+        """Pick a Store for the path (reference ``Store.create``:
+        hdfs:// -> HDFSStore, else LocalStore)."""
+        if prefix_path.startswith("hdfs://"):
+            return HDFSStore(prefix_path)
+        return LocalStore(prefix_path)
+
+
+class LocalStore(Store):
+    """Local-filesystem store (reference LocalStore)."""
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    def delete(self, path: str) -> None:
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+
+class HDFSStore(Store):
+    """HDFS store, gated on pyarrow (reference HDFSStore requires
+    pyarrow.hdfs); raises a clear error when unavailable."""
+
+    def __init__(self, prefix_path: str) -> None:
+        super().__init__(prefix_path)
+        try:
+            import pyarrow.fs as pafs  # noqa: F401
+
+            self._fs = pafs.HadoopFileSystem.from_uri(prefix_path)
+        except Exception as e:  # pyarrow missing, or no libhdfs/JVM
+            raise ImportError(
+                "HDFSStore requires pyarrow with a working libhdfs/JVM, "
+                "unavailable in this environment; use LocalStore instead "
+                f"({e})"
+            ) from e
+
+    def exists(self, path: str) -> bool:
+        import pyarrow.fs as pafs
+
+        info = self._fs.get_file_info([path])[0]
+        return info.type != pafs.FileType.NotFound
+
+    def read_bytes(self, path: str) -> bytes:
+        with self._fs.open_input_stream(path) as f:
+            return f.read()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        with self._fs.open_output_stream(path) as f:
+            f.write(data)
+
+
+def shard_arrays(arrays: Dict[str, np.ndarray], num_shards: int
+                 ) -> List[Dict[str, np.ndarray]]:
+    """Row-shard a dict of equal-length arrays into per-rank slices (the
+    estimator's stand-in for the reference's DataFrame repartition,
+    ``spark/common/util.py`` prepare_data)."""
+    n = len(next(iter(arrays.values())))
+    for k, v in arrays.items():
+        if len(v) != n:
+            raise ValueError(f"array {k!r} has length {len(v)} != {n}")
+    out = []
+    for r in range(num_shards):
+        sl = slice(r * n // num_shards, (r + 1) * n // num_shards)
+        out.append({k: v[sl] for k, v in arrays.items()})
+    return out

@@ -1,0 +1,39 @@
+"""Per-rank entry for run-func mode (reference: ``run/run_task.py`` —
+fetch the pickled fn, execute, post the result)."""
+
+import os
+import pickle
+import sys
+import traceback
+
+
+def main() -> int:
+    platform = os.environ.get("HVD_RUN_FUNC_PLATFORM", "cpu")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+    import cloudpickle
+
+    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    scratch = os.environ["HVD_RUN_FUNC_SCRATCH"]
+    with open(os.environ["HVD_RUN_FUNC_PAYLOAD"], "rb") as f:
+        fn, args, kwargs = cloudpickle.load(f)
+
+    out = os.path.join(scratch, f"result.{rank}.pkl")
+    try:
+        value = fn(*args, **kwargs)
+        payload = ("ok", value)
+        code = 0
+    except Exception:
+        payload = ("error", traceback.format_exc())
+        code = 1
+    with open(out + ".tmp", "wb") as f:
+        pickle.dump(payload, f)
+    os.replace(out + ".tmp", out)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

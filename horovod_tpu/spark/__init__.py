@@ -1,0 +1,111 @@
+"""Spark integration (reference: ``horovod/spark/__init__.py:39-239``).
+
+``horovod_tpu.spark.run(fn)`` mirrors ``horovod.spark.run``: execute
+``fn`` as ``num_proc`` tasks of a Spark job with full Horovod rank/
+rendezvous wiring.  PySpark is not part of this image, so the module
+degrades gracefully: with pyspark importable the Spark path runs; without
+it, ``run`` falls back to the local run-func launcher (same fn contract)
+and the Estimators are importable from :mod:`horovod_tpu.estimator`,
+which carries the Store/fit/transform API the reference implements over
+Spark DataFrames (SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from horovod_tpu.estimator import (  # noqa: F401 — estimator parity surface
+    EstimatorParams,
+    HDFSStore,
+    JaxEstimator,
+    JaxModel,
+    LocalStore,
+    Store,
+    TorchEstimator,
+    TorchModel,
+)
+
+
+def _pyspark_available() -> bool:
+    try:
+        import pyspark  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def run(fn: Callable, args: tuple = (), kwargs: Optional[Dict] = None,
+        num_proc: Optional[int] = None, env: Optional[Dict] = None,
+        verbose: int = 1) -> List[Any]:
+    """Run ``fn`` on ``num_proc`` parallel workers with Horovod wiring.
+
+    Reference contract (``spark/__init__.py:104-239``): returns the list
+    of each worker's return value.  On a machine with pyspark + an active
+    SparkContext the workers are Spark tasks; otherwise they are local
+    launcher processes (the capability the reference's Spark layer
+    ultimately provides — N coordinated fn executions).
+    """
+    from horovod_tpu.runner import run_func
+
+    nproc = num_proc or 2
+    if _pyspark_available():
+        from pyspark import SparkContext
+
+        sc = SparkContext._active_spark_context
+        if sc is not None:
+            return _spark_run(sc, fn, args, kwargs or {}, num_proc, env,
+                              verbose)
+    if verbose:
+        print(f"[horovod_tpu.spark] no active SparkContext; running "
+              f"{nproc} local launcher processes")
+    return run_func.run(fn, args, kwargs, num_proc=nproc, env=env)
+
+
+def _spark_run(sc, fn, args, kwargs, num_proc, env, verbose):
+    """Spark task path (reference ``spark/__init__.py:104-239``): the
+    driver hosts the rendezvous KV server; tasks register their host,
+    learn rank 0's address, export the coordinator env, then run fn."""
+    import socket
+
+    from horovod_tpu.runner.rendezvous import KVClient, RendezvousServer
+
+    num = num_proc or sc.defaultParallelism
+    server = RendezvousServer(0)
+    port = server.start()
+    driver_host = os.environ.get("HOROVOD_HOSTNAME") or socket.gethostbyname(
+        socket.gethostname())
+    jax_port = 9373
+    native_port = 9374
+    extra_env = dict(env or {})
+
+    def _task(index):
+        import os as _os
+        import socket as _socket
+
+        kv = KVClient(driver_host, port)
+        my_host = _socket.gethostbyname(_socket.gethostname())
+        kv.put("hosts", str(index), my_host.encode())
+        rank0_host = kv.wait("hosts", "0", timeout=120).decode()
+        _os.environ.update(extra_env)
+        _os.environ.update({
+            "HOROVOD_RANK": str(index),
+            "HOROVOD_NUM_PROC": str(num),
+            "HOROVOD_COORDINATOR_ADDR": rank0_host,
+            "HOROVOD_JAX_PORT": str(jax_port),
+            "HOROVOD_NATIVE_PORT": str(native_port),
+        })
+        return [fn(*(args or ()), **kwargs)]
+
+    if verbose:
+        print(f"[horovod_tpu.spark] running {num} Spark tasks; rendezvous "
+              f"at {driver_host}:{port}")
+    try:
+        return (
+            sc.parallelize(range(num), num)
+            .mapPartitionsWithIndex(lambda i, _: _task(i))
+            .collect()
+        )
+    finally:
+        server.stop()

@@ -1,0 +1,168 @@
+"""Estimator / Store / run-func tests (role of the reference's
+test/test_spark.py 23 tests + test_spark_keras/test_spark_torch
+estimator tests, minus the Spark session)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from horovod_tpu.estimator import (
+    EstimatorParams, JaxEstimator, LocalStore, Store, TorchEstimator,
+    shard_arrays,
+)
+
+
+class TestStore:
+    def test_create_picks_local(self, tmp_path):
+        s = Store.create(str(tmp_path))
+        assert isinstance(s, LocalStore)
+
+    def test_create_hdfs_gated(self):
+        with pytest.raises(ImportError, match="pyarrow"):
+            Store.create("hdfs://nn:9000/data")
+
+    def test_path_contract(self, tmp_path):
+        s = LocalStore(str(tmp_path))
+        assert s.get_train_data_path("3").endswith("intermediate_train_data.3")
+        assert s.get_checkpoint_path("r1").endswith("runs/r1/checkpoint.pkl")
+        assert "runs/r1/logs" in s.get_logs_path("r1")
+
+    def test_array_roundtrip(self, tmp_path):
+        s = LocalStore(str(tmp_path))
+        arrays = {"x": np.random.randn(10, 3), "y": np.arange(10)}
+        s.save_arrays(s.get_train_data_path("0"), arrays)
+        out = s.load_arrays(s.get_train_data_path("0"))
+        np.testing.assert_array_equal(out["x"], arrays["x"])
+        np.testing.assert_array_equal(out["y"], arrays["y"])
+
+    def test_obj_roundtrip(self, tmp_path):
+        s = LocalStore(str(tmp_path))
+        s.save_obj(s.get_checkpoint_path("r"), {"a": 1})
+        assert s.load_obj(s.get_checkpoint_path("r")) == {"a": 1}
+
+    def test_shard_arrays(self):
+        shards = shard_arrays({"x": np.arange(10)}, 3)
+        assert [len(s["x"]) for s in shards] == [3, 3, 4]
+        np.testing.assert_array_equal(
+            np.concatenate([s["x"] for s in shards]), np.arange(10))
+
+    def test_shard_length_mismatch(self):
+        with pytest.raises(ValueError, match="length"):
+            shard_arrays({"x": np.arange(4), "y": np.arange(5)}, 2)
+
+
+def _run_func_body(tag):
+    import os
+
+    return (tag, int(os.environ["HOROVOD_RANK"]))
+
+
+class TestRunFunc:
+    def test_returns_per_rank_results(self):
+        from horovod_tpu.runner import run_func
+
+        out = run_func.run(_run_func_body, ("hello",), num_proc=2)
+        assert out == [("hello", 0), ("hello", 1)]
+
+    def test_error_propagates(self):
+        from horovod_tpu.runner import run_func
+
+        def boom():
+            raise RuntimeError("worker exploded")
+
+        with pytest.raises(RuntimeError, match="failed|exploded"):
+            run_func.run(boom, num_proc=2)
+
+
+class TestSparkShim:
+    def test_run_falls_back_without_pyspark(self):
+        import horovod_tpu.spark as hvd_spark
+
+        out = hvd_spark.run(_run_func_body, ("s",), num_proc=2)
+        assert sorted(out) == [("s", 0), ("s", 1)]
+
+
+def _torch_model_factory():
+    import torch
+
+    torch.manual_seed(7)
+    return torch.nn.Sequential(
+        torch.nn.Linear(4, 16), torch.nn.ReLU(), torch.nn.Linear(16, 1))
+
+
+def _torch_opt_factory(params):
+    import torch
+
+    return torch.optim.SGD(params, lr=0.05)
+
+
+def _torch_loss(pred, target):
+    import torch
+
+    return torch.nn.functional.mse_loss(pred, target)
+
+
+class TestTorchEstimator:
+    def test_fit_predict_end_to_end(self, tmp_path):
+        rng = np.random.RandomState(0)
+        x = rng.randn(256, 4).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True).astype(np.float32)
+        est = TorchEstimator(
+            model_factory=_torch_model_factory,
+            optimizer_factory=_torch_opt_factory,
+            loss_fn=_torch_loss,
+            store=LocalStore(str(tmp_path)),
+            params=EstimatorParams(num_proc=2, epochs=8, batch_size=32),
+        )
+        model = est.fit(x, y)
+        assert len(model.history) == 8
+        assert model.history[-1] < model.history[0], model.history
+        pred = model.predict(x[:8])
+        assert pred.shape == (8, 1)
+        # trained: much better than predicting zeros
+        assert np.mean((pred - y[:8]) ** 2) < np.mean(y[:8] ** 2)
+
+
+def _jax_init_params(rng):
+    import jax
+
+    k1, k2 = jax.random.split(rng)
+    return {
+        "w1": jax.random.normal(k1, (4, 16)) * 0.5,
+        "w2": jax.random.normal(k2, (16, 1)) * 0.25,
+    }
+
+
+def _jax_model(params, x):
+    import jax.numpy as jnp
+
+    h = jnp.tanh(x @ params["w1"])
+    return h @ params["w2"]
+
+
+def _jax_loss(params, x, y):
+    import jax.numpy as jnp
+
+    return jnp.mean((_jax_model(params, x) - y) ** 2)
+
+
+class TestJaxEstimator:
+    def test_fit_predict_end_to_end(self, tmp_path):
+        import optax
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(256, 4).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True).astype(np.float32)
+        est = JaxEstimator(
+            model_fn=_jax_model,
+            loss_fn=_jax_loss,
+            init_params=_jax_init_params,
+            optimizer=optax.adam(1e-2),
+            store=LocalStore(str(tmp_path)),
+            params=EstimatorParams(num_proc=2, epochs=8, batch_size=32),
+        )
+        model = est.fit(x, y)
+        assert model.history[-1] < model.history[0], model.history
+        pred = model.predict(x[:8])
+        assert pred.shape == (8, 1)
