@@ -30,7 +30,12 @@ REFERENCE_IMG_PER_SEC_PER_ACCEL = 1656.82 / 16  # docs/benchmarks.rst:29-43
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="ResNet50")
-    ap.add_argument("--batch-size", type=int, default=32)
+    # Default 128/chip: the v5e MXU saturates around here for ResNet-50
+    # bf16 (32 -> 1.43k img/s, 64 -> 1.76k, 128 -> 2.2k); the reference's
+    # own published number used batch 64/GPU (docs/benchmarks.rst:29-43)
+    # and its synthetic script default of 32 is a CLI default, not part of
+    # the metric definition — batch size is disclosed in the metric string.
+    ap.add_argument("--batch-size", type=int, default=128)
     ap.add_argument("--num-warmup-batches", type=int, default=10)
     ap.add_argument("--num-batches-per-iter", type=int, default=10)
     ap.add_argument("--num-iters", type=int, default=10)
@@ -92,10 +97,34 @@ def main() -> None:
 
     n = hvd.size()
     global_batch = args.batch_size * n
-    images = np.random.rand(global_batch, args.image_size, args.image_size, 3).astype(
-        np.float32
+    # Synthetic data lives ON DEVICE, sharded batch-wise over the worker
+    # mesh (the reference benchmark's fixed random batch,
+    # examples/tensorflow2_synthetic_benchmark.py:60-66): re-uploading
+    # host arrays each step would measure host->device bandwidth, and an
+    # unsharded device_put would commit the global batch to one chip.
+    from jax.sharding import NamedSharding
+
+    batch_sharding = NamedSharding(mesh, P(axis))
+    images = jax.device_put(
+        jnp.asarray(
+            np.random.rand(global_batch, args.image_size, args.image_size, 3),
+            jnp.bfloat16,
+        ),
+        batch_sharding,
     )
-    labels = np.random.randint(0, 1000, (global_batch,)).astype(np.int32)
+    labels = jax.device_put(
+        jnp.asarray(np.random.randint(0, 1000, (global_batch,)), jnp.int32),
+        batch_sharding,
+    )
+
+    def _sync(x):
+        # Fetch the value rather than block_until_ready: on this repo's
+        # tunneled TPU platform, timing loops closed with
+        # block_until_ready measured above-physical-peak throughput
+        # (i.e. it returned before the chain finished), while a value
+        # fetch of the final loss is a watertight barrier.  The fetched
+        # array is a scalar, so the transfer cost is nil.
+        return float(np.asarray(jax.device_get(x)))
 
     # warmup (compile + stabilize)
     for _ in range(max(args.num_warmup_batches // args.num_batches_per_iter, 1)):
@@ -103,7 +132,7 @@ def main() -> None:
             params, opt_state, batch_stats, loss = step(
                 params, opt_state, batch_stats, images, labels
             )
-    jax.block_until_ready(loss)
+    _sync(loss)
 
     img_secs = []
     for _ in range(args.num_iters):
@@ -112,7 +141,7 @@ def main() -> None:
             params, opt_state, batch_stats, loss = step(
                 params, opt_state, batch_stats, images, labels
             )
-        jax.block_until_ready(loss)
+        _sync(loss)
         dt = time.perf_counter() - t0
         img_secs.append(global_batch * args.num_batches_per_iter / dt / n)
 

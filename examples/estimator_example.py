@@ -1,0 +1,52 @@
+"""Estimator API demo (reference: the Spark Estimator workflow,
+``examples/keras_spark_mnist.py`` shape — data in a Store, fit() runs
+distributed training, the returned Model predicts locally).
+
+    python examples/estimator_example.py
+"""
+
+import tempfile
+
+import numpy as np
+import torch
+
+from horovod_tpu.estimator import (EstimatorParams, LocalStore,
+                                   TorchEstimator)
+
+
+def model_factory():
+    torch.manual_seed(0)
+    return torch.nn.Sequential(
+        torch.nn.Linear(8, 32), torch.nn.ReLU(), torch.nn.Linear(32, 1))
+
+
+def optimizer_factory(params):
+    return torch.optim.Adam(params, lr=1e-2)
+
+
+def loss_fn(pred, target):
+    return torch.nn.functional.mse_loss(pred, target)
+
+
+def main():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2048, 8).astype(np.float32)
+    y = (x @ rng.randn(8, 1)).astype(np.float32)
+
+    store = LocalStore(tempfile.mkdtemp(prefix="hvd_store_"))
+    est = TorchEstimator(
+        model_factory=model_factory,
+        optimizer_factory=optimizer_factory,
+        loss_fn=loss_fn,
+        store=store,
+        params=EstimatorParams(num_proc=2, epochs=5, batch_size=64),
+    )
+    model = est.fit(x, y)
+    print("epoch losses:", [round(h, 4) for h in model.history])
+    pred = model.predict(x[:4])
+    print("predictions:", pred.ravel().round(3))
+    print("targets:    ", y[:4].ravel().round(3))
+
+
+if __name__ == "__main__":
+    main()

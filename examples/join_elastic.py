@@ -1,0 +1,44 @@
+"""Join: graceful early exit when ranks have unequal data (reference:
+``hvd.join()``, test_torch.py:1540's pattern as an example).
+
+Rank r gets 10*(r+1) batches; ranks that finish early call join() and
+keep contributing zeros to the stragglers' allreduces until everyone is
+done — no hang, no wasted barrier.
+
+    horovodrun -np 2 python examples/join_elastic.py
+"""
+
+import numpy as np
+import torch
+
+import horovod_tpu.torch as hvd
+
+
+def main():
+    hvd.init()
+    rank, size = hvd.rank(), hvd.size()
+
+    model = torch.nn.Linear(4, 1)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.05),
+        named_parameters=model.named_parameters())
+
+    torch.manual_seed(rank)
+    num_batches = 10 * (rank + 1)  # deliberately unequal
+    for step in range(num_batches):
+        x = torch.randn(16, 4)
+        y = x.sum(dim=1, keepdim=True)
+        opt.zero_grad()
+        loss = torch.nn.functional.mse_loss(model(x), y)
+        loss.backward()
+        opt.step()
+    print(f"rank {rank} finished {num_batches} batches; joining")
+    last = hvd.join()
+    if rank == 0:
+        print(f"all ranks joined (last worker rank: {last}); "
+              f"final loss {float(loss.detach()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Distributed PyTorch training (reference: ``examples/pytorch_mnist.py``):
+init -> shard data by rank -> DistributedOptimizer -> broadcast parameters
+and optimizer state -> metric averaging -> rank-0 checkpoint.
+
+    horovodrun -np 2 python examples/pytorch_mnist.py
+"""
+
+import argparse
+import os
+
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(torch.nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(1, 10, 5)
+        self.conv2 = torch.nn.Conv2d(10, 20, 5)
+        self.fc1 = torch.nn.Linear(320, 50)
+        self.fc2 = torch.nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2(x), 2))
+        x = x.flatten(1)
+        return F.log_softmax(self.fc2(F.relu(self.fc1(x))), dim=1)
+
+
+def synthetic_mnist(n=4096, seed=0):
+    g = torch.Generator().manual_seed(seed)
+    x = torch.rand(n, 1, 28, 28, generator=g)
+    w = torch.randn(28 * 28, 10, generator=g)
+    y = (x.flatten(1) @ w).argmax(dim=1)
+    return torch.utils.data.TensorDataset(x, y)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.01)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    dataset = synthetic_mnist()
+    # Shard by rank (the reference uses DistributedSampler; same effect).
+    sampler = torch.utils.data.distributed.DistributedSampler(
+        dataset, num_replicas=hvd.size(), rank=hvd.rank())
+    loader = torch.utils.data.DataLoader(
+        dataset, batch_size=args.batch_size, sampler=sampler)
+
+    model = Net()
+    optimizer = torch.optim.SGD(model.parameters(),
+                                lr=args.lr * hvd.size(), momentum=0.5)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+
+    for epoch in range(args.epochs):
+        sampler.set_epoch(epoch)
+        model.train()
+        for batch_idx, (data, target) in enumerate(loader):
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(data), target)
+            loss.backward()
+            optimizer.step()
+        # epoch metric averaged over workers (MetricAverageCallback role)
+        avg = hvd.allreduce(loss.detach(), op=hvd.Average, name=f"loss.{epoch}")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: avg loss {float(avg):.4f}")
+
+    if hvd.rank() == 0:
+        path = os.environ.get("CKPT", "/tmp/pytorch_mnist.pt")
+        torch.save(model.state_dict(), path)
+        print(f"checkpoint -> {path}")
+
+
+if __name__ == "__main__":
+    main()

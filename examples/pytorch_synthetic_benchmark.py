@@ -1,0 +1,90 @@
+"""PyTorch synthetic benchmark (reference:
+``examples/pytorch_synthetic_benchmark.py``): same protocol — synthetic
+data, N warmup batches, timed iterations, images/sec per worker with the
+10-batch x 10-iter mean +/- 1.96 sigma report.
+
+    horovodrun -np 2 python examples/pytorch_synthetic_benchmark.py
+"""
+
+import argparse
+import time
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class SmallResNetish(torch.nn.Module):
+    """Compact conv net standing in for torchvision's resnet50 (which
+    isn't in this image); same benchmark mechanics."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.features = torch.nn.Sequential(
+            torch.nn.Conv2d(3, 64, 7, stride=2, padding=3), torch.nn.ReLU(),
+            torch.nn.MaxPool2d(3, 2, 1),
+            torch.nn.Conv2d(64, 128, 3, stride=2, padding=1), torch.nn.ReLU(),
+            torch.nn.Conv2d(128, 256, 3, stride=2, padding=1), torch.nn.ReLU(),
+            torch.nn.AdaptiveAvgPool2d(1),
+        )
+        self.fc = torch.nn.Linear(256, num_classes)
+
+    def forward(self, x):
+        return self.fc(self.features(x).flatten(1))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-warmup-batches", type=int, default=10)
+    p.add_argument("--num-batches-per-iter", type=int, default=10)
+    p.add_argument("--num-iters", type=int, default=10)
+    p.add_argument("--fp16-allreduce", action="store_true")
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(42)
+
+    model = SmallResNetish()
+    lr_scaler = hvd.size()
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.01 * lr_scaler)
+    compression = (hvd.Compression.fp16 if args.fp16_allreduce
+                   else hvd.Compression.none)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters(),
+        compression=compression)
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, 224, 224)
+    target = torch.randint(0, 1000, (args.batch_size,))
+
+    def benchmark_step():
+        optimizer.zero_grad()
+        loss = F.cross_entropy(model(data), target)
+        loss.backward()
+        optimizer.step()
+
+    for _ in range(args.num_warmup_batches):
+        benchmark_step()
+
+    img_secs = []
+    for _ in range(args.num_iters):
+        t0 = time.time()
+        for _ in range(args.num_batches_per_iter):
+            benchmark_step()
+        dt = time.time() - t0
+        img_secs.append(args.batch_size * args.num_batches_per_iter / dt)
+
+    img_sec_mean = np.mean(img_secs)
+    img_sec_conf = 1.96 * np.std(img_secs)
+    if hvd.rank() == 0:
+        print(f"Img/sec per worker: {img_sec_mean:.1f} +-{img_sec_conf:.1f}")
+        print(f"Total img/sec on {hvd.size()} worker(s): "
+              f"{hvd.size() * img_sec_mean:.1f} "
+              f"+-{hvd.size() * img_sec_conf:.1f}")
+
+
+if __name__ == "__main__":
+    main()
