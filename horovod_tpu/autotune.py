@@ -220,6 +220,24 @@ class Autotuner:
             self._active = False
             if self._log:
                 self._log_file.close()
+        # NOTE: the new threshold is NOT applied to the native planner here.
+        # Per-rank scores (and therefore suggestions) differ, and fusion
+        # grouping must be identical on every rank or collectives mismatch;
+        # call synchronize() to broadcast rank 0's choice and apply it.
+
+    def _push_to_native(self) -> None:
+        """Apply the (synchronized) threshold to the native fusion planner
+        so the eager path buckets at the tuned size (the reference applies
+        ParameterManager output to TensorFusionThresholdBytes only after
+        Controller::SynchronizeParameters)."""
+        try:
+            from horovod_tpu import eager_runtime
+
+            rt = eager_runtime.get()
+            if rt is not None:
+                rt.set_fusion_bytes(self._current)
+        except Exception:  # pragma: no cover - defensive
+            pass
 
     def synchronize(self) -> None:
         """Broadcast the winning threshold from rank 0 so all processes
@@ -228,3 +246,4 @@ class Autotuner:
         from horovod_tpu import state as S
 
         self._current = int(S.broadcast_object(self._current, 0))
+        self._push_to_native()

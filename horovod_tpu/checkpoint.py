@@ -1,0 +1,122 @@
+"""Checkpoint/resume with the reference's consistency conventions.
+
+The reference has no core checkpoint system — it delegates storage to the
+framework and provides the *consistency* primitives (SURVEY.md §5.4):
+rank-0-only saving (``examples/keras_imagenet_resnet50.py``), broadcast of
+restored state (``BroadcastGlobalVariablesHook``,
+``broadcast_optimizer_state``), and Keras ``hvd.load_model`` that rewraps
+the optimizer on load.
+
+TPU-native storage: orbax (sharding-aware, async-capable).  This module
+packages the conventions over it:
+
+* :func:`save` — rank 0 writes (every process must still call it for
+  multi-host orbax arrays; single-controller runs write directly).
+* :func:`restore` — load then broadcast, so a checkpoint restored on one
+  host starts every worker identically.
+* :class:`CheckpointManager` — step-numbered checkpoints with retention,
+  the resume-from-latest contract (reference Spark estimator
+  ``_has_checkpoint`` behavior).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from horovod_tpu import basics
+from horovod_tpu import state as S
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+
+    return ocp
+
+
+def save(path: str, tree: Any, *, force: bool = True) -> None:
+    """Write a pytree checkpoint (rank-0 convention: in multi-process runs
+    only rank 0's data is authoritative — replicas are identical by the
+    DistributedOptimizer contract, so any single writer suffices)."""
+    if basics.num_processes() > 1 and basics.process_rank() != 0:
+        return
+    ocp = _ocp()
+    path = os.path.abspath(path)
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, jax.device_get(tree), force=force)
+
+
+def restore(path: str, template: Any, *, root_rank: int = 0,
+            broadcast: bool = True) -> Any:
+    """Load a checkpoint and (optionally) broadcast it so every process
+    resumes from identical state (the reference's restored-state
+    broadcast)."""
+    path = os.path.abspath(path)
+    if basics.num_processes() == 1:
+        ocp = _ocp()
+        with ocp.StandardCheckpointer() as ckptr:
+            return ckptr.restore(path, jax.device_get(template))
+    if basics.process_rank() == root_rank:
+        ocp = _ocp()
+        with ocp.StandardCheckpointer() as ckptr:
+            tree = ckptr.restore(path, jax.device_get(template))
+    else:
+        tree = template
+    if broadcast:
+        tree = S.broadcast_parameters(tree, root_rank)
+    return tree
+
+
+class CheckpointManager:
+    """Step-numbered checkpoints with retention + latest-resume.
+
+    ``save(step, tree)`` on a cadence; ``latest_step()`` / ``restore_latest
+    (template)`` on startup — the estimator/elastic resume contract."""
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3) -> None:
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, f"step_{step:010d}")
+
+    def all_steps(self):
+        steps = []
+        if os.path.isdir(self.directory):
+            for name in os.listdir(self.directory):
+                if name.startswith("step_"):
+                    try:
+                        steps.append(int(name[len("step_"):]))
+                    except ValueError:
+                        pass
+        return sorted(steps)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def save(self, step: int, tree: Any) -> None:
+        save(self._step_dir(step), tree)
+        if basics.num_processes() > 1 and basics.process_rank() != 0:
+            return
+        # retention (oldest beyond max_to_keep removed)
+        steps = self.all_steps()
+        while len(steps) > self.max_to_keep:
+            victim = steps.pop(0)
+            import shutil
+
+            shutil.rmtree(self._step_dir(victim), ignore_errors=True)
+
+    def restore(self, step: int, template: Any) -> Any:
+        return restore(self._step_dir(step), template)
+
+    def restore_latest(self, template: Any) -> tuple[Optional[int], Any]:
+        """(step, tree) from the newest checkpoint, or (None, template)."""
+        step = self.latest_step()
+        if step is None:
+            return None, template
+        return step, self.restore(step, template)

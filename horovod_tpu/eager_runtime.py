@@ -201,6 +201,11 @@ class EagerRuntime:
     def cache_entries(self) -> int:
         return self._rt.cache_entries()
 
+    def set_fusion_bytes(self, nbytes: int) -> None:
+        """Adjust the native fusion planner's threshold (autotuner knob —
+        reference ParameterManager -> TensorFusionThresholdBytes)."""
+        self._rt.set_fusion_bytes(int(nbytes))
+
     def shutdown(self) -> None:
         self._rt.shutdown()
 

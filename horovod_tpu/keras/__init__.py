@@ -1,0 +1,70 @@
+"""Keras frontend (reference: ``horovod/keras/__init__.py`` +
+``horovod/_keras/__init__.py``).  Import-gated on tensorflow like
+:mod:`horovod_tpu.tensorflow`; the framework-agnostic callback semantics
+(BroadcastGlobalVariables, MetricAverage, LR warmup/schedule) live in
+:mod:`horovod_tpu.callbacks` and work for JAX training loops too.
+"""
+
+from __future__ import annotations
+
+try:
+    import tensorflow as tf  # noqa: F401
+except ImportError as _e:  # pragma: no cover - TF absent in this image
+    raise ImportError(
+        "horovod_tpu.keras requires tensorflow; the callback semantics "
+        "are available framework-agnostically in horovod_tpu.callbacks."
+    ) from _e
+
+from horovod_tpu.basics import (  # noqa: F401
+    init, is_initialized, local_rank, local_size, rank, shutdown, size,
+)
+from horovod_tpu.tensorflow import (  # noqa: F401
+    DistributedOptimizer,
+    allgather,
+    allreduce,
+    broadcast,
+    broadcast_variables,
+)
+
+
+class BroadcastGlobalVariablesCallback(tf.keras.callbacks.Callback):
+    """Broadcast initial model/optimizer variables from root at train
+    start (reference _keras/callbacks.py:20-43)."""
+
+    def __init__(self, root_rank=0):
+        super().__init__()
+        self.root_rank = root_rank
+        self._done = False
+
+    def on_batch_end(self, batch, logs=None):
+        if not self._done:
+            broadcast_variables(self.model.variables, self.root_rank)
+            self._done = True
+
+
+class MetricAverageCallback(tf.keras.callbacks.Callback):
+    """Average epoch metrics over workers (reference
+    _keras/callbacks.py:46-84)."""
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs:
+            import numpy as np
+
+            for k in sorted(logs):
+                v = logs[k]
+                if isinstance(v, (int, float)):
+                    from horovod_tpu.ops import collectives as C
+
+                    logs[k] = float(C.allreduce(
+                        np.asarray(v, np.float32), C.Average,
+                        name=f"metric.{k}.{epoch}"))
+
+
+def load_model(filepath, custom_objects=None, compression=None):
+    """Load a keras model and re-wrap its optimizer (reference
+    keras/__init__.py:117-150)."""
+    model = tf.keras.models.load_model(
+        filepath, custom_objects=custom_objects)
+    if model.optimizer is not None:
+        model.optimizer = DistributedOptimizer(model.optimizer)
+    return model

@@ -1,0 +1,125 @@
+"""NIC / route discovery: find addresses every host can actually reach.
+
+Reference: the driver/task service handshake (``run/run.py:118-270``,
+``run/driver/driver_service.py``, ``run/task/task_service.py``): each task
+starts a server, registers its candidate addresses with the driver, then
+probes the *next* task's candidates in a ring; the driver intersects the
+working interfaces so ``mpirun``/gloo bind the right NICs.
+
+TPU re-design over the rendezvous KV instead of pickled-RPC services:
+
+1. every rank binds a throwaway TCP listener and publishes its candidate
+   ``(address, port)`` list under ``discovery/addrs.<rank>``;
+2. each rank dials rank ``(r+1) % n``'s candidates and publishes which
+   succeeded under ``discovery/reach.<rank>``;
+3. :func:`discover` intersects the reachable-address reports into one
+   routable address per rank (the launcher can pass rank 0's to
+   ``HOROVOD_COORDINATOR_ADDR``).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from horovod_tpu.runner.rendezvous import KVClient
+
+SCOPE = "discovery"
+
+
+def local_addresses() -> List[str]:
+    """Candidate non-loopback IPv4 addresses of this host (reference:
+    get_local_host_addresses / psutil net_if_addrs, without psutil)."""
+    addrs = set()
+    try:
+        hostname = socket.gethostname()
+        for info in socket.getaddrinfo(hostname, None, socket.AF_INET):
+            addrs.add(info[4][0])
+    except socket.gaierror:
+        pass
+    # The UDP-connect trick finds the default-route interface address
+    # without sending a packet.
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            addrs.add(s.getsockname()[0])
+    except OSError:
+        pass
+    addrs.discard("127.0.0.1")
+    return sorted(addrs) or ["127.0.0.1"]
+
+
+class _ProbeListener:
+    """Accept-and-close TCP listener used as the probe target."""
+
+    def __init__(self) -> None:
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+                conn.close()
+            except (socket.timeout, OSError):
+                continue
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2)
+        self._sock.close()
+
+
+def _probe(addr: str, port: int, timeout: float = 2.0) -> bool:
+    try:
+        with socket.create_connection((addr, port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def run_task_discovery(kv: KVClient, rank: int, size: int,
+                       timeout: float = 60.0) -> None:
+    """Per-rank side of the handshake (reference task_service role)."""
+    listener = _ProbeListener()
+    try:
+        kv.put(SCOPE, f"addrs.{rank}", json.dumps(
+            {"addrs": local_addresses(), "port": listener.port}).encode())
+        nxt = (rank + 1) % size
+        peer = json.loads(kv.wait(SCOPE, f"addrs.{nxt}", timeout=timeout))
+        reachable = [a for a in peer["addrs"] if _probe(a, peer["port"])]
+        kv.put(SCOPE, f"reach.{rank}", json.dumps(
+            {"peer": nxt, "reachable": reachable}).encode())
+        # hold the listener until every rank has reported, so probes from
+        # our predecessor don't race our teardown
+        for r in range(size):
+            kv.wait(SCOPE, f"reach.{r}", timeout=timeout)
+    finally:
+        listener.close()
+
+
+def discover(kv: KVClient, size: int, timeout: float = 60.0
+             ) -> Dict[int, str]:
+    """Driver side: one verified-routable address per rank (reference
+    driver_service intersection of common interfaces)."""
+    routable: Dict[int, str] = {}
+    for r in range(size):
+        report = json.loads(kv.wait(SCOPE, f"reach.{r}", timeout=timeout))
+        peer = report["peer"]
+        if report["reachable"]:
+            routable[peer] = report["reachable"][0]
+    missing = [r for r in range(size) if r not in routable]
+    if missing:
+        raise RuntimeError(
+            f"NIC discovery: no routable address found for ranks {missing} "
+            "(ring probes all failed — check firewalls/interfaces)")
+    return routable

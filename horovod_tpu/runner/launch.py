@@ -33,9 +33,12 @@ def build_command(
     env: Dict[str, str],
     coordinator_addr: str,
     coordinator_port: int,
-) -> (List[str], Dict[str, str]):
+) -> (List[str], Dict[str, str], Optional[bytes]):
     """The env contract every rank receives (reference
-    ``gloo_run.py:262-288``)."""
+    ``gloo_run.py:262-288``).  Returns (argv, env, stdin_bytes): for
+    remote slots the per-job HMAC secret travels over the ssh channel's
+    stdin, never on the command line where any local user could read it
+    from /proc/<pid>/cmdline."""
     slot_env = dict(env)
     slot_env.update(slot.to_env())
     slot_env["HOROVOD_COORDINATOR_ADDR"] = coordinator_addr
@@ -43,14 +46,31 @@ def build_command(
     slot_env["HOROVOD_GLOO_RENDEZVOUS_ADDR"] = coordinator_addr  # compat name
     slot_env["HOROVOD_GLOO_RENDEZVOUS_PORT"] = str(coordinator_port)
     if _is_local(slot.hostname):
-        return command, slot_env
+        # Local spawn: env travels through Popen(env=...), not argv — safe.
+        return command, slot_env, None
+    secret_val = slot_env.get("HOROVOD_SECRET_KEY")
     exports = " ".join(
         f"{k}={shlex.quote(v)}"
         for k, v in slot_env.items()
-        if k.startswith(("HOROVOD_", "PYTHON", "PATH", "JAX_", "XLA_"))
+        if k != "HOROVOD_SECRET_KEY"
+        and k.startswith(("HOROVOD_", "PYTHON", "PATH", "JAX_", "XLA_"))
     )
-    remote = f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1 ; env {exports} {' '.join(shlex.quote(c) for c in command)}"
-    return shlex.split(SSH_COMMAND_PREFIX) + [slot.hostname, remote], env
+    cmd_str = " ".join(shlex.quote(c) for c in command)
+    stdin_data = None
+    if secret_val:
+        remote = (
+            f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1 ; "
+            f"IFS= read -r HOROVOD_SECRET_KEY ; export HOROVOD_SECRET_KEY ; "
+            f"env {exports} HOROVOD_SECRET_KEY=\"$HOROVOD_SECRET_KEY\" {cmd_str}"
+        )
+        stdin_data = (secret_val + "\n").encode()
+    else:
+        remote = (
+            f"cd {shlex.quote(os.getcwd())} > /dev/null 2>&1 ; "
+            f"env {exports} {cmd_str}"
+        )
+    return (shlex.split(SSH_COMMAND_PREFIX) + [slot.hostname, remote], env,
+            stdin_data)
 
 
 def launch_job(
@@ -66,8 +86,15 @@ def launch_job(
     (and terminates all other ranks when any rank fails — the reference's
     any-failure-kills-all policy, ``gloo_run.py:162-259``)."""
     env = dict(env if env is not None else os.environ)
+    # Per-job HMAC secret so only this job's ranks can write rendezvous
+    # state (reference run/common/util/secret.py usage in gloo_run).
+    if "HOROVOD_SECRET_KEY" not in env:
+        from horovod_tpu.runner import secret
+
+        env["HOROVOD_SECRET_KEY"] = secret.make_secret_key()
     slots = allocate(host_specs)
-    server = RendezvousServer(coordinator_port)
+    server = RendezvousServer(
+        coordinator_port, secret_key=env["HOROVOD_SECRET_KEY"].encode())
     port = server.start()
     addr = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
 
@@ -76,7 +103,8 @@ def launch_job(
     threads = []
 
     def _run(i: int, slot: SlotInfo) -> None:
-        cmd, slot_env = build_command(slot, command, env, addr, port)
+        cmd, slot_env, stdin_data = build_command(slot, command, env, addr,
+                                                  port)
         out = err = None
         if output_filename:
             os.makedirs(output_filename, exist_ok=True)
@@ -91,6 +119,7 @@ def launch_job(
                 stderr=err or sys.stderr,
                 prefix=prefix,
                 events=[failure],
+                stdin_data=stdin_data,
             )
         finally:
             for f in (out, err):

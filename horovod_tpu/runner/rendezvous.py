@@ -36,6 +36,20 @@ class _KVHandler(BaseHTTPRequestHandler):
         scope, key = self._key()
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
+        # Enforce write auth at the server (reference RendezvousHandler):
+        # with a job secret configured, unsigned or mis-signed PUTs are
+        # rejected here, so a stray writer can neither inject state nor
+        # crash readers with garbage.
+        if self.server._secret_key:
+            from horovod_tpu.runner import secret
+
+            try:
+                secret.verify(value, self.server._secret_key)
+            except ValueError:
+                self.send_response(403)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
         with self.server._lock:
             self.server._store.setdefault(scope, {})[key] = value
         self.send_response(200)
@@ -58,6 +72,18 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_DELETE(self):  # scope finalization (RendezvousHandler:105)
         scope, _ = self._key()
+        if self.server._secret_key:
+            from horovod_tpu.runner import secret
+
+            length = int(self.headers.get("Content-Length", 0))
+            token = self.rfile.read(length)
+            try:
+                secret.verify(token, self.server._secret_key)
+            except ValueError:
+                self.send_response(403)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
         with self.server._lock:
             self.server._store.pop(scope, None)
         self.send_response(200)
@@ -66,12 +92,21 @@ class _KVHandler(BaseHTTPRequestHandler):
 
 
 class RendezvousServer:
-    """Threaded HTTP KV store (``KVStoreServer`` / ``RendezvousServer``)."""
+    """Threaded HTTP KV store (``KVStoreServer`` / ``RendezvousServer``).
 
-    def __init__(self, port: int = 0) -> None:
+    With ``secret_key`` set (or ``HOROVOD_SECRET_KEY`` in the
+    environment), writes must carry a valid HMAC."""
+
+    def __init__(self, port: int = 0,
+                 secret_key: Optional[bytes] = None) -> None:
         self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
         self._httpd._store: Dict[str, Dict[str, bytes]] = {}
         self._httpd._lock = threading.Lock()
+        if secret_key is None:
+            from horovod_tpu.runner import secret
+
+            secret_key = secret.get_key()
+        self._httpd._secret_key = secret_key
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -100,16 +135,24 @@ class KVClient:
         self._timeout = timeout
 
     def put(self, scope: str, key: str, value: bytes) -> None:
+        # Per-job HMAC signing when HOROVOD_SECRET_KEY is set (reference
+        # secret.py/codec.py: signed control-plane payloads).
+        from horovod_tpu.runner import secret
+
         req = urlrequest.Request(
-            f"{self._base}/{scope}/{key}", data=value, method="PUT"
+            f"{self._base}/{scope}/{key}", data=secret.sign(value),
+            method="PUT"
         )
         urlrequest.urlopen(req, timeout=self._timeout).read()
 
     def get(self, scope: str, key: str) -> Optional[bytes]:
+        from horovod_tpu.runner import secret
+
         try:
-            return urlrequest.urlopen(
+            payload = urlrequest.urlopen(
                 f"{self._base}/{scope}/{key}", timeout=self._timeout
             ).read()
+            return secret.verify(payload)
         except urlerror.HTTPError as e:
             if e.code == 404:
                 return None
@@ -127,5 +170,10 @@ class KVClient:
         raise TimeoutError(f"rendezvous key {scope}/{key} not published")
 
     def delete_scope(self, scope: str) -> None:
-        req = urlrequest.Request(f"{self._base}/{scope}/", method="DELETE")
+        from horovod_tpu.runner import secret
+
+        req = urlrequest.Request(
+            f"{self._base}/{scope}/", data=secret.sign(b"delete"),
+            method="DELETE"
+        )
         urlrequest.urlopen(req, timeout=self._timeout).read()

@@ -36,6 +36,7 @@ def execute(
     stderr: Optional[IO] = None,
     prefix: Optional[str] = None,
     events: Optional[List[threading.Event]] = None,
+    stdin_data: Optional[bytes] = None,
 ) -> int:
     """Run command in its own process group; tee output with an optional
     rank prefix (the reference's ``--tag-output`` behavior); kill the group
@@ -63,10 +64,19 @@ def execute(
         cmd,
         env=env,
         shell=use_shell,
+        stdin=subprocess.PIPE if stdin_data is not None else None,
         stdout=subprocess.PIPE,
         stderr=subprocess.PIPE,
         **popen_kw,
     )
+    if stdin_data is not None:
+        try:
+            proc.stdin.write(stdin_data)
+            proc.stdin.flush()
+        except (BrokenPipeError, OSError):
+            pass
+        finally:
+            proc.stdin.close()
 
     p = (prefix.encode() if prefix else b"")
     threads = [

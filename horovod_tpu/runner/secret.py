@@ -1,0 +1,63 @@
+"""HMAC signing for launcher control-plane payloads.
+
+Reference: ``run/common/util/secret.py`` + ``codec.py`` — every
+driver/task RPC and KV payload is HMAC-signed with a per-job secret so a
+stray process on the network can't inject rendezvous state.  Same scheme:
+a random per-job key exported as ``HOROVOD_SECRET_KEY``, payloads carried
+as ``hmac_digest || body``.
+"""
+
+from __future__ import annotations
+
+import hmac
+import hashlib
+import os
+import secrets as _secrets
+
+ENV_KEY = "HOROVOD_SECRET_KEY"
+DIGEST_BYTES = 32  # sha256
+# Signed payloads are self-describing so a keyless reader can tell a
+# signed blob from raw bytes (and fail loudly instead of handing back
+# digest||body garbage).
+MAGIC = b"HVDSIG1\x00"
+
+
+def make_secret_key() -> str:
+    return _secrets.token_hex(16)
+
+
+def get_key() -> bytes | None:
+    v = os.environ.get(ENV_KEY)
+    return v.encode() if v else None
+
+
+def sign(body: bytes, key: bytes | None = None) -> bytes:
+    key = key if key is not None else get_key()
+    if not key:
+        return body  # signing disabled (no per-job secret exported)
+    digest = hmac.new(key, body, hashlib.sha256).digest()
+    return MAGIC + digest + body
+
+
+def verify(payload: bytes, key: bytes | None = None) -> bytes:
+    """Return the body; raises ValueError on a bad or missing signature."""
+    key = key if key is not None else get_key()
+    is_signed = payload.startswith(MAGIC)
+    if not key:
+        if is_signed:
+            raise ValueError(
+                "payload is HMAC-signed but this process has no "
+                f"{ENV_KEY}; export the job's secret to read it")
+        return payload
+    if not is_signed:
+        raise ValueError(
+            "HMAC verification failed: payload is unsigned but this job "
+            "requires signed control-plane messages")
+    rest = payload[len(MAGIC):]
+    if len(rest) < DIGEST_BYTES:
+        raise ValueError("payload shorter than HMAC digest")
+    digest, body = rest[:DIGEST_BYTES], rest[DIGEST_BYTES:]
+    expect = hmac.new(key, body, hashlib.sha256).digest()
+    if not hmac.compare_digest(digest, expect):
+        raise ValueError("HMAC verification failed: payload rejected")
+    return body

@@ -1,0 +1,132 @@
+"""TensorFlow frontend (reference: ``horovod/tensorflow/__init__.py``).
+
+TensorFlow is not part of this image, so the module import-gates: with TF
+installed the API below works (eager/tf.function TF2 style — TF tensors
+bridge through numpy into the shared eager path, exactly like the torch
+frontend); without TF, importing this module raises with a pointer to the
+JAX-native API.
+
+Provided (reference parity, tensorflow/__init__.py):
+``allreduce`` (43-118), ``broadcast_variables`` (139-148),
+``DistributedGradientTape`` (474-531), ``DistributedOptimizer`` factory
+for keras optimizers (410-471), ``broadcast_global_variables``.
+"""
+
+from __future__ import annotations
+
+try:
+    import tensorflow as tf  # noqa: F401
+except ImportError as _e:  # pragma: no cover - TF absent in this image
+    raise ImportError(
+        "horovod_tpu.tensorflow requires tensorflow, which is not "
+        "installed in this environment.  The JAX-native API "
+        "(horovod_tpu.DistributedOptimizer / DistributedGradientTape) and "
+        "the torch frontend (horovod_tpu.torch) provide the same "
+        "capabilities."
+    ) from _e
+
+import numpy as np
+
+from horovod_tpu.basics import (  # noqa: F401
+    cross_rank, cross_size, init, is_initialized, local_rank, local_size,
+    rank, shutdown, size,
+)
+from horovod_tpu.ops import collectives as C
+
+Average, Sum, Adasum = C.Average, C.Sum, C.Adasum
+
+
+def _to_np(t):
+    return t.numpy() if hasattr(t, "numpy") else np.asarray(t)
+
+
+def allreduce(tensor, average=None, op=None, name=None,
+              prescale_factor=1.0, postscale_factor=1.0):
+    """Eager TF allreduce through the shared runtime (reference
+    tensorflow/__init__.py:43-118; IndexedSlices fall back to dense)."""
+    if op is None:
+        op = Average if (average is None or average) else Sum
+    if isinstance(tensor, tf.IndexedSlices):
+        tensor = tf.convert_to_tensor(tensor)
+    out = C.allreduce(_to_np(tensor), op, name=name,
+                      prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor)
+    return tf.convert_to_tensor(out)
+
+
+def allgather(tensor, name=None):
+    return tf.convert_to_tensor(C.allgather(_to_np(tensor), name=name))
+
+
+def broadcast(tensor, root_rank=0, name=None):
+    return tf.convert_to_tensor(
+        C.broadcast(_to_np(tensor), root_rank, name=name))
+
+
+def broadcast_variables(variables, root_rank=0):
+    """Assign every variable rank ``root_rank``'s value (reference
+    broadcast_variables, tensorflow/__init__.py:139-148)."""
+    for i, v in enumerate(variables):
+        v.assign(broadcast(v, root_rank, name=f"broadcast.var.{i}"))
+
+
+def broadcast_global_variables(root_rank=0):
+    raise NotImplementedError(
+        "TF1 graph-mode broadcast_global_variables is not supported; use "
+        "broadcast_variables(model.variables, root_rank) in TF2.")
+
+
+class DistributedGradientTape(object):
+    """Wrap tf.GradientTape so gradient() allreduces the grads
+    (reference tensorflow/__init__.py:474-531)."""
+
+    def __init__(self, tape, compression=None, op=Average):
+        self._tape = tape
+        self._compression = compression
+        self._op = op
+
+    def __getattr__(self, item):
+        return getattr(self._tape, item)
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        arrs = [None if g is None else _to_np(
+            tf.convert_to_tensor(g) if isinstance(g, tf.IndexedSlices) else g)
+            for g in grads]
+        present = [i for i, a in enumerate(arrs) if a is not None]
+        reduced = C.grouped_allreduce([arrs[i] for i in present], self._op)
+        out = list(grads)
+        for i, r in zip(present, reduced):
+            out[i] = tf.convert_to_tensor(r)
+        return out
+
+
+def DistributedOptimizer(optimizer, compression=None, op=Average,
+                         backward_passes_per_step=1):
+    """Wrap a keras optimizer so apply_gradients averages gradients
+    across workers first (reference factory, 410-471)."""
+
+    base_cls = optimizer.__class__
+
+    class _Wrapped(base_cls):
+        def apply_gradients(self, grads_and_vars, **kwargs):
+            gv = list(grads_and_vars)
+            arrs = [None if g is None else _to_np(
+                tf.convert_to_tensor(g) if isinstance(g, tf.IndexedSlices)
+                else g) for g, _ in gv]
+            present = [i for i, a in enumerate(arrs) if a is not None]
+            reduced = C.grouped_allreduce([arrs[i] for i in present], op)
+            for i, r in zip(present, reduced):
+                gv[i] = (tf.convert_to_tensor(r), gv[i][1])
+            return super().apply_gradients(gv, **kwargs)
+
+    _Wrapped.__name__ = base_cls.__name__
+    new = _Wrapped.from_config(optimizer.get_config())
+    return new

@@ -1,0 +1,215 @@
+"""Auxiliary-subsystem tests: checkpoint/resume (orbax), HMAC secret,
+NIC discovery handshake, TF/keras shim gating (roles of the reference's
+test_timeline.py / secret usage / driver-task service tests)."""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import checkpoint
+from horovod_tpu.runner import secret
+from horovod_tpu.runner.rendezvous import KVClient, RendezvousServer
+
+
+class TestCheckpoint:
+    def _tree(self):
+        return {
+            "w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.ones(4, np.float32),
+            "inner": {"step": np.asarray(7)},
+        }
+
+    def test_save_restore_roundtrip(self, hvd, tmp_path):
+        tree = self._tree()
+        checkpoint.save(str(tmp_path / "ck"), tree)
+        out = checkpoint.restore(str(tmp_path / "ck"),
+                                 jax.tree_util.tree_map(np.zeros_like, tree))
+        for a, b in zip(jax.tree_util.tree_leaves(out),
+                        jax.tree_util.tree_leaves(tree)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_manager_retention_and_latest(self, hvd, tmp_path):
+        mgr = checkpoint.CheckpointManager(str(tmp_path / "runs"),
+                                           max_to_keep=2)
+        assert mgr.latest_step() is None
+        for s in (10, 20, 30):
+            mgr.save(s, {"x": np.full(3, float(s))})
+        assert mgr.all_steps() == [20, 30]  # 10 evicted
+        step, tree = mgr.restore_latest({"x": np.zeros(3)})
+        assert step == 30
+        np.testing.assert_array_equal(tree["x"], np.full(3, 30.0))
+
+    def test_restore_latest_empty(self, hvd, tmp_path):
+        mgr = checkpoint.CheckpointManager(str(tmp_path / "empty"))
+        step, tree = mgr.restore_latest({"x": np.ones(2)})
+        assert step is None
+        np.testing.assert_array_equal(tree["x"], np.ones(2))
+
+
+class TestSecret:
+    def test_sign_verify_roundtrip(self, monkeypatch):
+        monkeypatch.setenv(secret.ENV_KEY, secret.make_secret_key())
+        payload = secret.sign(b"hello")
+        assert payload != b"hello"
+        assert secret.verify(payload) == b"hello"
+
+    def test_tamper_rejected(self, monkeypatch):
+        monkeypatch.setenv(secret.ENV_KEY, secret.make_secret_key())
+        payload = bytearray(secret.sign(b"hello"))
+        payload[-1] ^= 0xFF
+        with pytest.raises(ValueError, match="HMAC"):
+            secret.verify(bytes(payload))
+
+    def test_disabled_without_key(self, monkeypatch):
+        monkeypatch.delenv(secret.ENV_KEY, raising=False)
+        assert secret.sign(b"x") == b"x"
+        assert secret.verify(b"x") == b"x"
+
+    def test_kv_signed_end_to_end(self, monkeypatch):
+        key = secret.make_secret_key()
+        monkeypatch.setenv(secret.ENV_KEY, key)
+        server = RendezvousServer(0)  # picks up the env key
+        port = server.start()
+        try:
+            kv = KVClient("127.0.0.1", port)
+            kv.put("s", "k", b"payload")
+            assert kv.get("s", "k") == b"payload"
+            # unsigned writer (no key) is rejected AT THE SERVER (403), so
+            # a stray process can neither inject state nor DoS readers
+            monkeypatch.delenv(secret.ENV_KEY, raising=False)
+            from urllib import error as urlerror
+
+            with pytest.raises(urlerror.HTTPError) as ei:
+                kv.put("s", "raw", b"unsigned")
+            assert ei.value.code == 403
+            # keyless reader of a signed value fails loudly, not garbage
+            with pytest.raises(ValueError, match="no HOROVOD_SECRET_KEY"):
+                kv.get("s", "k")
+        finally:
+            server.stop()
+
+
+class TestDiscovery:
+    def test_ring_discovery_localhost(self):
+        from horovod_tpu.runner import discovery
+
+        server = RendezvousServer(0)
+        port = server.start()
+        try:
+            import threading
+
+            size = 3
+            threads = [
+                threading.Thread(
+                    target=discovery.run_task_discovery,
+                    args=(KVClient("127.0.0.1", port), r, size),
+                    kwargs={"timeout": 30},
+                )
+                for r in range(size)
+            ]
+            for t in threads:
+                t.start()
+            routable = discovery.discover(
+                KVClient("127.0.0.1", port), size, timeout=30)
+            for t in threads:
+                t.join(timeout=30)
+            assert sorted(routable) == [0, 1, 2]
+            for addr in routable.values():
+                assert addr  # a concrete address string
+        finally:
+            server.stop()
+
+    def test_local_addresses_nonempty(self):
+        from horovod_tpu.runner import discovery
+
+        assert discovery.local_addresses()
+
+
+tf = pytest.importorskip("tensorflow")
+
+
+class TestTensorFlowShim:
+    """Role of the reference's test_tensorflow.py op/tape/optimizer tests
+    at single-worker scope (multi-rank covered by the launcher workers)."""
+
+    def test_allreduce(self, hvd):
+        import horovod_tpu.tensorflow as hvd_tf
+
+        x = tf.constant([1.0, 2.0, 3.0])
+        out = hvd_tf.allreduce(x, op=hvd_tf.Sum)
+        np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])
+        out = hvd_tf.allreduce(x)  # default Average
+        np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])
+
+    def test_allgather_broadcast(self, hvd):
+        import horovod_tpu.tensorflow as hvd_tf
+
+        x = tf.constant([[1.0, 2.0]])
+        assert hvd_tf.allgather(x).shape == (1, 2)
+        np.testing.assert_allclose(
+            hvd_tf.broadcast(x, 0).numpy(), x.numpy())
+
+    def test_broadcast_variables(self, hvd):
+        import horovod_tpu.tensorflow as hvd_tf
+
+        v = tf.Variable([5.0, 6.0])
+        hvd_tf.broadcast_variables([v], 0)
+        np.testing.assert_allclose(v.numpy(), [5.0, 6.0])
+
+    def test_distributed_gradient_tape(self, hvd):
+        import horovod_tpu.tensorflow as hvd_tf
+
+        w = tf.Variable([2.0, 3.0])
+        with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = tf.reduce_sum(w * w)
+        (g,) = tape.gradient(loss, [w])
+        np.testing.assert_allclose(g.numpy(), [4.0, 6.0])
+
+    def test_distributed_optimizer_trains(self, hvd):
+        import horovod_tpu.tensorflow as hvd_tf
+
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(1, input_shape=(4,))])
+        opt = hvd_tf.DistributedOptimizer(tf.keras.optimizers.SGD(0.05))
+        x = tf.random.normal((64, 4), seed=0)
+        y = tf.reduce_sum(x, axis=1, keepdims=True)
+        losses = []
+        for _ in range(20):
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_mean((model(x) - y) ** 2)
+            grads = tape.gradient(loss, model.trainable_variables)
+            opt.apply_gradients(zip(grads, model.trainable_variables))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, losses[::5]
+
+
+class TestKerasShim:
+    def test_callbacks_in_fit(self, hvd):
+        import horovod_tpu.keras as hvd_keras
+
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(1, input_shape=(3,))])
+        model.compile(optimizer=tf.keras.optimizers.SGD(0.05), loss="mse")
+        x = np.random.randn(64, 3).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True)
+        hist = model.fit(
+            x, y, epochs=2, batch_size=16, verbose=0,
+            callbacks=[
+                hvd_keras.BroadcastGlobalVariablesCallback(0),
+                hvd_keras.MetricAverageCallback(),
+            ])
+        assert len(hist.history["loss"]) == 2
+
+    def test_load_model_rewraps(self, hvd, tmp_path):
+        import horovod_tpu.keras as hvd_keras
+
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(1, input_shape=(2,))])
+        model.compile(optimizer=tf.keras.optimizers.Adam(1e-3), loss="mse")
+        path = str(tmp_path / "model.keras")
+        model.save(path)
+        loaded = hvd_keras.load_model(path)
+        assert loaded.optimizer is not None

@@ -162,9 +162,10 @@ class TestRendezvous:
 class TestLaunch:
     def test_command_construction_local(self):
         slot = SlotInfo("localhost", 0, 2, 4, 8)
-        cmd, env = launch.build_command(
+        cmd, env, stdin = launch.build_command(
             slot, ["python", "t.py"], {"PATH": "/bin"}, "127.0.0.1", 5000
         )
+        assert stdin is None
         assert cmd == ["python", "t.py"]
         assert env["HOROVOD_RANK"] == "0"
         assert env["HOROVOD_COORDINATOR_ADDR"] == "127.0.0.1"
@@ -173,7 +174,7 @@ class TestLaunch:
 
     def test_command_construction_ssh(self):
         slot = SlotInfo("remotehost", 1, 2, 4, 8)
-        cmd, _ = launch.build_command(
+        cmd, _, _ = launch.build_command(
             slot, ["python", "t.py"], {}, "10.0.0.1", 5000
         )
         assert cmd[0] == "ssh"
@@ -251,3 +252,16 @@ class TestLaunch:
         assert rc == 0
         assert "ok" in (out / "rank.0.stdout").read_text()
         assert "ok" in (out / "rank.1.stdout").read_text()
+
+
+    def test_ssh_secret_rides_stdin_not_argv(self):
+        """The per-job HMAC key must never appear on a remote command line
+        (visible via /proc/<pid>/cmdline to any local user)."""
+        slot = SlotInfo("remotehost", 1, 2, 4, 8)
+        cmd, _, stdin = launch.build_command(
+            slot, ["python", "t.py"], {"HOROVOD_SECRET_KEY": "deadbeef"},
+            "10.0.0.1", 5000
+        )
+        assert "deadbeef" not in " ".join(cmd)
+        assert stdin == b"deadbeef\n"
+        assert "read -r HOROVOD_SECRET_KEY" in cmd[-1]
