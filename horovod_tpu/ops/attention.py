@@ -90,9 +90,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32)  # (block_q, d)
-        k = k_ref[0].astype(jnp.float32)  # (block_k, d)
-        v = v_ref[0].astype(jnp.float32)
+        # Keep inputs in their native dtype (bf16 rides the MXU at full
+        # rate) and accumulate in f32 via preferred_element_type.
+        q = q_ref[0]  # (block_q, d)
+        k = k_ref[0]  # (block_k, d)
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
@@ -108,8 +110,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.exp(s - m_new)                              # (block_q, block_k)
         alpha = jnp.exp(m_prev - m_new)                     # (block_q, 1)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        # P·V in the value dtype (bf16 MXU) with f32 accumulation; exact
+        # for f32 inputs, standard flash practice for bf16.
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -228,7 +232,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal: bool = False,
                     sm_scale: Optional[float] = None,
-                    block_q: int = 512, block_k: int = 512):
+                    block_q: int = 1024, block_k: int = 512):
     """Fused tiled attention.  ``(B, H, S, D) x (B, H, T, D) -> (B, H, S, D)``.
 
     Forward runs as one Pallas TPU kernel (online softmax, O(block) VMEM);
