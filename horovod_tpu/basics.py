@@ -35,6 +35,34 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 logger = logging.getLogger("horovod_tpu")
 
+# HOROVOD_LOG_LEVEL values, matching the reference's leveled logger
+# (common/logging.{h,cc}; exported by the launcher's --log-level flag).
+_LOG_LEVELS = {
+    "trace": logging.DEBUG,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "fatal": logging.CRITICAL,
+}
+
+
+def _configure_logging() -> None:
+    """Apply HOROVOD_LOG_LEVEL to the ``horovod_tpu`` logger.  The native
+    runtime reads the same variable itself (native/src/logging.h)."""
+    raw = os.environ.get("HOROVOD_LOG_LEVEL", "").lower()
+    if not raw:
+        return
+    if raw not in _LOG_LEVELS:
+        logger.warning("HOROVOD_LOG_LEVEL=%r not recognized; using warning", raw)
+    logger.setLevel(_LOG_LEVELS.get(raw, logging.WARNING))
+    if not logger.handlers and not logging.getLogger().handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(
+            logging.Formatter("[%(asctime)s %(name)s %(levelname)s] %(message)s")
+        )
+        logger.addHandler(h)
+
 # Default mesh axis name for the flat worker axis (the reference's GLOBAL
 # communicator).  All collective ops default to this axis.
 AXIS: str = "hvd"
@@ -181,6 +209,7 @@ def init(
     global _context
     if _context is not None:
         return
+    _configure_logging()
     _bootstrap_distributed()
     if devices is None:
         devices = jax.devices()

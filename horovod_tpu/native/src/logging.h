@@ -1,0 +1,80 @@
+// Leveled stderr logging for the native runtime, controlled by
+// HOROVOD_LOG_LEVEL (trace|debug|info|warning|error|fatal) — the TPU
+// re-design of the reference's logger (horovod/common/logging.{h,cc}):
+// same env contract and rank-tagged lines, implemented as a single
+// header with an iostream-style macro.
+#ifndef HVD_LOGGING_H_
+#define HVD_LOGGING_H_
+
+#include <cctype>
+#include <cstdlib>
+#include <cstring>
+#include <iostream>
+#include <sstream>
+#include <string>
+
+namespace hvd {
+
+enum class LogLevel : int {
+  kTrace = 0,
+  kDebug = 1,
+  kInfo = 2,
+  kWarning = 3,
+  kError = 4,
+  kFatal = 5,
+};
+
+inline LogLevel ParseLogLevelEnv() {
+  const char* raw = std::getenv("HOROVOD_LOG_LEVEL");
+  if (raw == nullptr || raw[0] == '\0') return LogLevel::kWarning;
+  std::string v(raw);
+  for (auto& c : v) c = static_cast<char>(std::tolower(c));
+  if (v == "trace") return LogLevel::kTrace;
+  if (v == "debug") return LogLevel::kDebug;
+  if (v == "info") return LogLevel::kInfo;
+  if (v == "warning" || v == "warn") return LogLevel::kWarning;
+  if (v == "error") return LogLevel::kError;
+  if (v == "fatal") return LogLevel::kFatal;
+  return LogLevel::kWarning;
+}
+
+inline LogLevel MinLogLevel() {
+  static LogLevel lvl = ParseLogLevelEnv();
+  return lvl;
+}
+
+// Rank tag for log lines; set once at runtime init.
+inline int& LogRank() {
+  static int rank = -1;
+  return rank;
+}
+
+class LogMessage {
+ public:
+  LogMessage(LogLevel level, const char* name) : level_(level) {
+    stream_ << "[hvd_native";
+    if (LogRank() >= 0) stream_ << " rank " << LogRank();
+    stream_ << " " << name << "] ";
+  }
+  ~LogMessage() {
+    stream_ << "\n";
+    std::cerr << stream_.str();
+    std::cerr.flush();
+    if (level_ == LogLevel::kFatal) std::abort();
+  }
+  std::ostringstream& stream() { return stream_; }
+
+ private:
+  LogLevel level_;
+  std::ostringstream stream_;
+};
+
+}  // namespace hvd
+
+#define HVD_LOG_IS_ON(lvl) (::hvd::LogLevel::lvl >= ::hvd::MinLogLevel())
+#define HVD_LOG(lvl)                         \
+  if (!HVD_LOG_IS_ON(k##lvl)) {              \
+  } else                                     \
+    ::hvd::LogMessage(::hvd::LogLevel::k##lvl, #lvl).stream()
+
+#endif  // HVD_LOGGING_H_

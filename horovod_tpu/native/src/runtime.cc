@@ -2,6 +2,8 @@
 
 #include <chrono>
 
+#include "logging.h"
+
 namespace hvd {
 
 Runtime& Runtime::Get() {
@@ -12,6 +14,12 @@ Runtime& Runtime::Get() {
 bool Runtime::Init(const RuntimeOptions& opts, std::string* err) {
   if (initialized_.load()) return true;
   opts_ = opts;
+  LogRank() = opts.rank;
+  HVD_LOG(Info) << "init: size=" << opts.size << " coordinator="
+                << opts.coordinator_addr << ":" << opts.coordinator_port
+                << " cycle_ms=" << opts.cycle_time_ms
+                << " fusion_bytes=" << opts.fusion_threshold_bytes
+                << " cache=" << opts.cache_capacity;
   if (!comm_.Init(opts.rank, opts.size, opts.coordinator_addr,
                   opts.coordinator_port, opts.connect_timeout_sec, err))
     return false;
@@ -36,6 +44,7 @@ void Runtime::Shutdown() {
   // controller.cc:247-250), then join the background thread.
   shutdown_requested_.store(true);
   if (bg_thread_.joinable()) bg_thread_.join();
+  HVD_LOG(Info) << "shutdown after " << cycles_.load() << " cycles";
   queue_.AbortAll(Status::Error(StatusCode::SHUTDOWN, "horovod_tpu shut down"));
   timeline_.Shutdown();
   comm_.Shutdown();
@@ -85,11 +94,18 @@ bool Runtime::RunLoopOnce() {
   std::string err;
   if (!controller_->ComputeResponseList(std::move(pending), local_join_,
                                         want_shutdown, &out, &err)) {
+    HVD_LOG(Error) << "coordination failed: " << err;
     queue_.AbortAll(Status::Error(StatusCode::ABORTED,
                                   "coordination failed: " + err));
     return false;
   }
-  for (const auto& resp : out.responses) Dispatch(resp);
+  for (const auto& resp : out.responses) {
+    if (HVD_LOG_IS_ON(kDebug) && !resp.tensor_names.empty()) {
+      HVD_LOG(Debug) << "dispatch " << resp.tensor_names.size()
+                     << " tensor(s), first=" << resp.tensor_names[0];
+    }
+    Dispatch(resp);
+  }
   if (out.shutdown) {
     queue_.AbortAll(
         Status::Error(StatusCode::SHUTDOWN, "shutdown requested"));

@@ -21,8 +21,8 @@ TPU.  Two execution paths replace the reference's seven backends:
 
 All processes must issue eager collectives in the same order — the same
 contract the reference enforces dynamically via its coordinator; here it is a
-documented SPMD requirement, with the stall inspector
-(:mod:`horovod_tpu.stall`) flagging violations.
+documented SPMD requirement, with the native runtime's stall inspector
+(``native/src/stall_inspector.cc``) flagging violations when it is active.
 """
 
 from __future__ import annotations
@@ -65,9 +65,47 @@ def _is_traced(tree: Any) -> bool:
 def _axis_names(axis_name) -> tuple:
     if axis_name is None:
         axis_name = basics.axis_name() if basics.is_initialized() else basics.AXIS
+        if isinstance(axis_name, str):
+            # Probe the trace's axis environment: a step built over the
+            # hierarchical (cross, local) mesh binds those axes instead of
+            # the flat worker axis, and collectives called with
+            # axis_name=None should resolve to whichever is live.
+            try:
+                lax.axis_size(axis_name)
+            except NameError:
+                try:
+                    lax.axis_size(basics.CROSS_AXIS)
+                    lax.axis_size(basics.LOCAL_AXIS)
+                    return (basics.CROSS_AXIS, basics.LOCAL_AXIS)
+                except NameError:
+                    pass
     if isinstance(axis_name, (tuple, list)):
         return tuple(axis_name)
     return (axis_name,)
+
+
+# --- hierarchical-collective config (reference knobs: common/common.h:76-77,
+# HOROVOD_HIERARCHICAL_ALLREDUCE / HOROVOD_HIERARCHICAL_ALLGATHER; exported
+# by the launcher's --hierarchical-* flags via runner/config_parser.py) ------
+
+import os as _os
+
+
+def _env_flag(name: str) -> bool:
+    return _os.environ.get(name, "0").lower() not in ("", "0", "false")
+
+
+def hierarchical_allreduce_enabled() -> bool:
+    """True when HOROVOD_HIERARCHICAL_ALLREDUCE requests the two-level
+    reduce (psum_scatter over `local`/ICI → psum over `cross`/DCN →
+    all_gather over `local`) instead of a flat psum over both axes."""
+    return _env_flag("HOROVOD_HIERARCHICAL_ALLREDUCE")
+
+
+def hierarchical_allgather_enabled() -> bool:
+    """True when HOROVOD_HIERARCHICAL_ALLGATHER requests staged gathers
+    (local axis first, then cross) instead of one joint-axis all_gather."""
+    return _env_flag("HOROVOD_HIERARCHICAL_ALLGATHER")
 
 
 def _axis_size(axes: tuple) -> int:
@@ -92,6 +130,27 @@ def _reraise_unbound(err: NameError) -> None:
 # --- in-graph implementations ----------------------------------------------
 
 
+def _hier_psum(t, axes: tuple):
+    """Two-level allreduce over the (cross, local) mesh — the compiled
+    re-design of ``NCCLHierarchicalAllreduce``
+    (``ops/nccl_operations.cc:162-354``): reduce-scatter within the node,
+    allreduce of the scattered shard across nodes, allgather within the
+    node.  Here `local` rides ICI and `cross` rides DCN, so the cross-host
+    hop moves 1/local_size of the tensor per chip."""
+    cross, local = axes
+    n_local = lax.axis_size(local)
+    flat = t.reshape(-1)
+    pad = (-flat.shape[0]) % n_local
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    shard = lax.psum_scatter(flat, local, scatter_dimension=0, tiled=True)
+    shard = lax.psum(shard, cross)
+    full = lax.all_gather(shard, local, axis=0, tiled=True)
+    if pad:
+        full = full[: t.size]
+    return full.reshape(t.shape)
+
+
 def _injit_allreduce(tensor, op: str, axes: tuple, prescale, postscale):
     if op == Adasum:
         from horovod_tpu.ops import adasum as _adasum
@@ -100,7 +159,10 @@ def _injit_allreduce(tensor, op: str, axes: tuple, prescale, postscale):
     if prescale is not None and prescale != 1.0:
         tensor = jax.tree_util.tree_map(lambda t: t * prescale, tensor)
     if op in (Average, Sum):
-        out = jax.tree_util.tree_map(lambda t: lax.psum(t, axes), tensor)
+        if len(axes) == 2 and hierarchical_allreduce_enabled():
+            out = jax.tree_util.tree_map(lambda t: _hier_psum(t, axes), tensor)
+        else:
+            out = jax.tree_util.tree_map(lambda t: lax.psum(t, axes), tensor)
         if op == Average:
             n = _axis_size(axes)
             out = jax.tree_util.tree_map(lambda t: t / jnp.asarray(n, t.dtype), out)
@@ -148,10 +210,17 @@ def _injit_broadcast(tensor, root_rank: int, axes: tuple):
 
 def _injit_allgather(tensor, axes: tuple):
     def _ag(t):
-        g = t
-        for a in reversed(axes):
-            g = lax.all_gather(g, a, axis=0, tiled=True)
-        return g
+        if len(axes) == 2 and hierarchical_allgather_enabled():
+            # MPIHierarchicalAllgather analogue (ops/mpi_operations.cc):
+            # gather within the node first (ICI), then gather node blocks
+            # across hosts (DCN).  Worker order is (cross, local)-major on
+            # both paths.
+            g = lax.all_gather(t, axes[1], axis=0, tiled=True)
+            return lax.all_gather(g, axes[0], axis=0, tiled=True)
+        # Flat path: ONE gather over the (possibly joint) axis — XLA emits a
+        # single all-gather over the full device set.
+        return lax.all_gather(t, axes if len(axes) > 1 else axes[0],
+                              axis=0, tiled=True)
 
     return jax.tree_util.tree_map(_ag, tensor)
 

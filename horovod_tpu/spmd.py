@@ -62,6 +62,7 @@ def make_train_step(
     axis: Optional[str] = None,
     donate: bool = True,
     has_aux: bool = False,
+    hierarchical: Optional[bool] = None,
 ):
     """Build the canonical data-parallel train step.
 
@@ -76,7 +77,25 @@ def make_train_step(
     ``DistributedGradientTape`` + ``apply_gradients`` hot path
     (SURVEY.md §3.2) with negotiation/fusion/cache made unnecessary by
     SPMD compilation.
+
+    ``hierarchical=True`` (default: the ``HOROVOD_HIERARCHICAL_ALLREDUCE``/
+    ``ALLGATHER`` env flags, i.e. the launcher's ``--hierarchical-*``)
+    builds the step over the 2-D ``(cross, local)`` mesh so collectives can
+    use the two-level algorithms — the wiring for the reference's
+    ``NCCLHierarchicalAllreduce`` configuration knob (``common.h:76-77``).
     """
+    from horovod_tpu.ops import collectives as _C
+
+    if hierarchical is None:
+        hierarchical = (
+            _C.hierarchical_allreduce_enabled()
+            or _C.hierarchical_allgather_enabled()
+        )
+    if hierarchical and mesh is None and axis is None:
+        hier = basics.hierarchical_mesh()
+        if hier is not None:
+            mesh = hier
+            axis = (basics.CROSS_AXIS, basics.LOCAL_AXIS)
     mesh = mesh or basics.mesh()
     axis = axis or basics.axis_name()
 

@@ -71,9 +71,56 @@ def broadcast_variables(variables, root_rank=0):
 
 
 def broadcast_global_variables(root_rank=0):
-    raise NotImplementedError(
-        "TF1 graph-mode broadcast_global_variables is not supported; use "
-        "broadcast_variables(model.variables, root_rank) in TF2.")
+    """Broadcast every variable tracked by the v1-compat global collection
+    (reference ``broadcast_global_variables``,
+    ``tensorflow/__init__.py:150-175``).  Works under
+    ``tf.compat.v1`` graph building; in pure TF2 eager code — where no
+    global collection exists — pass your variables to
+    :func:`broadcast_variables` instead."""
+    if not tf.executing_eagerly():
+        raise NotImplementedError(
+            "TF1 graph-mode sessions are not supported by the TPU eager "
+            "shim; use BroadcastGlobalVariablesCallback (the "
+            "BroadcastGlobalVariablesHook equivalent) or TF2 eager mode.")
+    gvars = tf.compat.v1.global_variables()
+    if not gvars:
+        raise ValueError(
+            "No global variables are tracked (pure TF2 eager mode has no "
+            "global collection); call "
+            "broadcast_variables(model.variables, root_rank) instead.")
+    broadcast_variables(gvars, root_rank)
+
+
+class BroadcastGlobalVariablesCallback(object):
+    """Keras-style callback that broadcasts all model variables from
+    ``root_rank`` at the start of training — the TF2 equivalent of the
+    reference's ``BroadcastGlobalVariablesHook``
+    (``tensorflow/__init__.py:194-227``), which wrapped a TF1
+    SessionRunHook.  Duck-types ``tf.keras.callbacks.Callback``.
+    """
+
+    def __init__(self, root_rank=0):
+        self.root_rank = root_rank
+        self.model = None
+        self._done = False
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        pass
+
+    def on_train_begin(self, logs=None):
+        if not self._done and self.model is not None:
+            broadcast_variables(self.model.variables, self.root_rank)
+            self._done = True
+
+    def __getattr__(self, item):
+        # Remaining callback hooks (on_epoch_begin, on_batch_end, ...) are
+        # no-ops.
+        if item.startswith("on_") or item.startswith("set_"):
+            return lambda *a, **k: None
+        raise AttributeError(item)
 
 
 class DistributedGradientTape(object):

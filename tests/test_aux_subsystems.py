@@ -213,3 +213,77 @@ class TestKerasShim:
         model.save(path)
         loaded = hvd_keras.load_model(path)
         assert loaded.optimizer is not None
+
+
+class TestTFBroadcastGlobalVariables:
+    def test_graph_mode_points_to_callback(self, hvd):
+        import horovod_tpu.tensorflow as hvd_tf
+
+        with tf.Graph().as_default():
+            with pytest.raises(
+                NotImplementedError,
+                match="BroadcastGlobalVariablesCallback",
+            ):
+                hvd_tf.broadcast_global_variables(0)
+
+    def test_eager_raises_with_pointer(self, hvd):
+        import horovod_tpu.tensorflow as hvd_tf
+
+        with pytest.raises(ValueError, match="broadcast_variables"):
+            hvd_tf.broadcast_global_variables(0)
+
+    def test_broadcast_callback_in_fit(self, hvd):
+        import horovod_tpu.tensorflow as hvd_tf
+
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(1, input_shape=(3,))])
+        model.compile(optimizer=tf.keras.optimizers.SGD(0.05), loss="mse")
+        x = np.random.randn(32, 3).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True)
+        cb = hvd_tf.BroadcastGlobalVariablesCallback(0)
+        hist = model.fit(x, y, epochs=1, batch_size=16, verbose=0,
+                         callbacks=[cb])
+        assert cb._done
+        assert len(hist.history["loss"]) == 1
+
+
+class TestLogLevel:
+    def test_env_configures_logger(self, monkeypatch):
+        import logging
+
+        from horovod_tpu import basics
+
+        logger = logging.getLogger("horovod_tpu")
+        old = logger.level
+        try:
+            monkeypatch.setenv("HOROVOD_LOG_LEVEL", "debug")
+            basics._configure_logging()
+            assert logger.level == logging.DEBUG
+            monkeypatch.setenv("HOROVOD_LOG_LEVEL", "error")
+            basics._configure_logging()
+            assert logger.level == logging.ERROR
+        finally:
+            logger.setLevel(old)
+
+    def test_native_logging_emits(self, tmp_path):
+        """HOROVOD_LOG_LEVEL=info makes the native runtime log its init
+        line (native/src/logging.h reads the same env the reference's
+        logger did)."""
+        import subprocess
+        import sys
+
+        code = (
+            "import os\n"
+            "os.environ['HOROVOD_LOG_LEVEL'] = 'info'\n"
+            "os.environ.setdefault('HOROVOD_NUM_PROC', '1')\n"
+            "from horovod_tpu import native\n"
+            "rt = native.NativeRuntime()\n"
+            "rt.init(0, 1, '127.0.0.1', 19393)\n"
+            "rt.shutdown()\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=120,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "[hvd_native rank 0 Info] init:" in r.stderr
