@@ -1,0 +1,159 @@
+"""One-off profiling harness for the ResNet-50 bench step (Task: chase MFU).
+
+Times the same compiled step as bench.py across configurations and prints
+XLA cost-analysis FLOPs so MFU is measured, not estimated.
+
+Usage: python benchmarks/profile_resnet.py [--batch 128 256] [--scan 0 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+TPU_PEAK_BF16 = {
+    # chip -> peak bf16 TFLOP/s (public spec sheets)
+    "TPU v2": 46e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def peak_flops() -> float:
+    kind = jax.devices()[0].device_kind
+    for k, v in TPU_PEAK_BF16.items():
+        if kind.startswith(k):
+            return v
+    return float("nan")
+
+
+def build(batch_size: int, scan_len: int, image_size: int = 224):
+    import horovod_tpu as hvd
+    from horovod_tpu import spmd
+    from horovod_tpu.models import resnet
+    from jax.sharding import PartitionSpec as P, NamedSharding
+
+    hvd.init()
+    model = resnet.create("ResNet50", num_classes=1000)
+    rng = jax.random.PRNGKey(42)
+    variables = resnet.init_variables(model, rng, image_size, batch=2)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    opt = hvd.DistributedOptimizer(optax.sgd(0.01, momentum=0.9))
+    opt_state = opt.init(params)
+
+    def loss_fn(p, batch):
+        images, labels, stats = batch["images"], batch["labels"], batch["stats"]
+        logits, new_model_state = model.apply(
+            {"params": p, "batch_stats": stats}, images, train=True,
+            mutable=["batch_stats"],
+        )
+        one_hot = jax.nn.one_hot(labels, 1000)
+        loss = optax.softmax_cross_entropy(logits, one_hot).mean()
+        return loss, new_model_state["batch_stats"]
+
+    axis = hvd.AXIS
+    mesh = hvd.mesh()
+
+    def _one(params, opt_state, stats, images, labels):
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, {"images": images, "labels": labels, "stats": stats}
+        )
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, new_stats, jax.lax.pmean(loss, axis)
+
+    if scan_len:
+        def _step(params, opt_state, stats, images, labels):
+            def body(carry, _):
+                p, o, s = carry
+                p, o, s, loss = _one(p, o, s, images, labels)
+                return (p, o, s), loss
+            (params, opt_state, stats), losses = jax.lax.scan(
+                body, (params, opt_state, stats), None, length=scan_len
+            )
+            return params, opt_state, stats, losses[-1]
+    else:
+        _step = _one
+
+    step = jax.jit(
+        spmd.shard(
+            _step,
+            in_specs=(P(), P(), P(), P(axis), P(axis)),
+            out_specs=(P(), P(), P(), P()),
+            mesh=mesh,
+        ),
+        donate_argnums=(0, 1, 2),
+    )
+
+    n = hvd.size()
+    global_batch = batch_size * n
+    sh = NamedSharding(mesh, P(axis))
+    images = jax.device_put(
+        jnp.asarray(np.random.rand(global_batch, image_size, image_size, 3),
+                    jnp.bfloat16), sh)
+    labels = jax.device_put(
+        jnp.asarray(np.random.randint(0, 1000, (global_batch,)), jnp.int32), sh)
+    return step, (params, opt_state, batch_stats, images, labels), global_batch
+
+
+def run(batch_size: int, scan_len: int, iters: int = 5, inner: int = 10):
+    step, args, global_batch = build(batch_size, scan_len)
+    params, opt_state, stats, images, labels = args
+
+    lowered = step.lower(params, opt_state, stats, images, labels)
+    compiled = lowered.compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    flops = float(ca.get("flops", float("nan"))) if ca else float("nan")
+
+    # warmup
+    for _ in range(2):
+        params, opt_state, stats, loss = step(params, opt_state, stats, images, labels)
+    float(np.asarray(jax.device_get(loss)))
+
+    steps_per_call = scan_len or 1
+    rates = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            params, opt_state, stats, loss = step(
+                params, opt_state, stats, images, labels)
+        float(np.asarray(jax.device_get(loss)))
+        dt = time.perf_counter() - t0
+        rates.append(global_batch * inner * steps_per_call / dt)
+
+    med = float(np.median(rates))
+    step_flops = flops  # for the whole jitted call
+    flops_per_img = step_flops / (global_batch * steps_per_call)
+    tflops = med * flops_per_img / 1e12
+    mfu = med * flops_per_img / peak_flops()
+    print(f"batch={batch_size} scan={scan_len}: {med:.1f} img/s  "
+          f"flops/img={flops_per_img/1e9:.2f}G  {tflops:.1f} TF/s  MFU={mfu*100:.1f}%")
+    return med
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, nargs="+", default=[128])
+    ap.add_argument("--scan", type=int, nargs="+", default=[0])
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--inner", type=int, default=10)
+    args = ap.parse_args()
+    for b in args.batch:
+        for s in args.scan:
+            run(b, s, args.iters, args.inner)
+
+
+if __name__ == "__main__":
+    main()

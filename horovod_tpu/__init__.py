@@ -64,6 +64,7 @@ from horovod_tpu.ops.collectives import (
     grouped_allreduce,
     poll,
     reducescatter,
+    reducescatter_async,
     synchronize,
 )
 from horovod_tpu.ops.compression import Compression

@@ -137,32 +137,111 @@ def _erf(x: np.ndarray) -> np.ndarray:
 
 
 @dataclass
+class CategoricalParam:
+    """A discretely-valued knob tuned by exhaustive sweep
+    (``parameter_manager.h`` ``CategoricalParameter``)."""
+
+    name: str
+    values: List
+
+    def __post_init__(self) -> None:
+        self.best_idx = 0
+
+
+def _default_categoricals() -> List[CategoricalParam]:
+    """Default categorical knob set: response-cache capacity (0 disables
+    the bit-vector fast path, ``HOROVOD_CACHE_CAPACITY``).
+
+    The reference also sweeps its hierarchical-collective toggles
+    (``common.h:76-77``); here those are compile-time choices — toggling
+    the env flag cannot change an already-traced step, so sweeping them by
+    default would score identical executables and fix a winner from noise.
+    They remain supported as explicit ``CategoricalParam``s for callers
+    that rebuild/select step variants per window (consult ``.settings``
+    each window, e.g. two precompiled steps).
+
+    ``values[0]`` must be what the runtime is ACTUALLY running when the
+    sweep reaches the param (the first window's score is attributed to it
+    without an apply), so it is seeded from the env configuration."""
+    cap = int(os.environ.get("HOROVOD_CACHE_CAPACITY", 1024))
+    return [
+        CategoricalParam("cache_capacity", [cap, 0 if cap != 0 else 1024]),
+    ]
+
+
+@dataclass
 class Autotuner:
     """Parameter manager (``parameter_manager.h:42-246``): scores each
-    sample window by bytes/sec, proposes the next knob setting, converges to
-    the best seen, and can synchronize the winner across processes."""
+    sample window by bytes/sec and tunes, in reference order,
+
+    1. warmup samples (discarded);
+    2. categorical knobs by chained sweep — each value of each param gets
+       one sample window while the others are held, best value is fixed
+       before moving on (``CategoricalParameterChain``);
+    3. the joint (fusion threshold MB, cycle time ms) box by Bayesian
+       optimization (``BayesianParameter``), then freezes at the best
+       seen.
+
+    Cross-rank agreement: at every sample boundary the score is averaged
+    across processes through the eager data plane, so each rank's tuner
+    registers IDENTICAL scores and (with the shared RNG seed) proposes
+    IDENTICAL next settings — the decentralized equivalent of the
+    reference's rank-0 ``Controller::SynchronizeParameters`` broadcast.
+    """
 
     warmup_samples: int = 3       # HOROVOD_AUTOTUNE_WARMUP_SAMPLES (common.h:67)
     steps_per_sample: int = 10    # HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE
+    bo_samples: int = 12          # joint-BO budget before freezing
     log_path: Optional[str] = None  # HOROVOD_AUTOTUNE_LOG
-    # knob: log2 of fusion threshold MB in [0, 7] → 1 MB .. 128 MB
+    sync_scores: bool = True
+    categoricals: List[CategoricalParam] = field(
+        default_factory=_default_categoricals
+    )
+    # joint knobs: (log2 fusion threshold MB in [0,7] → 1..128 MB,
+    #               cycle time ms in [0.5, 10])
     bo: BayesianOptimization = field(
-        default_factory=lambda: BayesianOptimization(bounds=[(0.0, 7.0)])
+        default_factory=lambda: BayesianOptimization(
+            bounds=[(0.0, 7.0), (0.5, 10.0)]
+        )
     )
 
     def __post_init__(self) -> None:
-        self._samples_seen = 0
         self._bytes = 0.0
         self._seconds = 0.0
         self._steps = 0
-        self._current = self._threshold_from_knob(6.0)  # 64 MB default
-        self._current_knob = 6.0
-        self._best: Tuple[float, int] = (-1.0, self._current)
+        self._samples_seen = 0
+        # Seed the joint knobs from the user's env settings when present
+        # (the reference ParameterManager starts from the configured
+        # values): HOROVOD_FUSION_THRESHOLD bytes / HOROVOD_CYCLE_TIME ms.
+        thr = int(os.environ.get("HOROVOD_FUSION_THRESHOLD")
+                  or self._threshold_from_knob(6.0))
+        cyc = float(os.environ.get("HOROVOD_CYCLE_TIME") or 1.0)
+        lo, hi = self.bo.bounds[0]
+        knob0 = float(np.clip(np.log2(max(thr, 1) / (1024 * 1024)), lo, hi))
+        lo1, hi1 = self.bo.bounds[1]
+        self._knobs = (knob0, float(np.clip(cyc, lo1, hi1)))
+        self._current = {
+            "fusion_threshold": thr,
+            "cycle_time_ms": cyc,
+        }
+        for p in self.categoricals:
+            self._current[p.name] = p.values[0]
+        self._best: Tuple[float, Dict] = (-1.0, dict(self._current))
         self._active = True
+        # phase machine: warmup → cat(i, j) sweeps → bo → frozen.
+        # warmup_samples=0 starts directly in the first tuning phase so the
+        # first window's score is credited instead of discarded.
+        if self.warmup_samples > 0:
+            self._phase = "warmup"
+        else:
+            self._phase = "cat" if self.categoricals else "bo"
+        self._cat_i = 0
+        self._cat_j = 0
+        self._cat_scores: List[float] = []
         if self.log_path:
             self._log_file = open(self.log_path, "w", newline="")
             self._log = csv.writer(self._log_file)
-            self._log.writerow(["sample", "fusion_threshold", "score_bytes_per_sec"])
+            self._log.writerow(["sample", "phase", "settings", "score_bytes_per_sec"])
         else:
             self._log = None
 
@@ -180,14 +259,27 @@ class Autotuner:
     def _threshold_from_knob(knob: float) -> int:
         return int(2 ** float(knob) * 1024 * 1024)
 
+    # ---- current settings -------------------------------------------------
+
     @property
     def fusion_threshold(self) -> int:
         """Current fusion threshold to use for the next step."""
-        return self._current
+        return self._current["fusion_threshold"]
+
+    @property
+    def cycle_time_ms(self) -> float:
+        return self._current["cycle_time_ms"]
+
+    @property
+    def settings(self) -> Dict:
+        """All current knob settings (reference ParameterManager state)."""
+        return dict(self._current)
 
     @property
     def active(self) -> bool:
         return self._active
+
+    # ---- scoring ----------------------------------------------------------
 
     def record(self, nbytes: float, seconds: float) -> None:
         """Report one step's reduced-byte volume and duration
@@ -200,50 +292,135 @@ class Autotuner:
         if self._steps < self.steps_per_sample:
             return
         score = self._bytes / max(self._seconds, 1e-9)
-        self._samples_seen += 1
-        if self._log:
-            self._log.writerow([self._samples_seen, self._current, score])
-            self._log_file.flush()
-        if self._samples_seen > self.warmup_samples:
-            self.bo.register([self._current_knob], score)
-            if score > self._best[0]:
-                self._best = (score, self._current)
-            knob = float(self.bo.suggest()[0])
-        else:
-            knob = self._current_knob  # warmup: keep defaults, discard score
-        self._current_knob = knob
-        self._current = self._threshold_from_knob(knob)
         self._bytes = self._seconds = 0.0
         self._steps = 0
-        if len(self.bo.ys) >= 12:  # converge: freeze at best
-            self._current = self._best[1]
+        score = self._sync_score(score)
+        self._samples_seen += 1
+        if self._log:
+            self._log.writerow(
+                [self._samples_seen, self._phase, repr(self._current), score]
+            )
+            self._log_file.flush()
+        self._advance(score)
+
+    def _sync_score(self, score: float) -> float:
+        """Average the window score across processes so every rank's tuner
+        sees the same value and the per-rank state machines stay in
+        lockstep (all ranks reach the boundary at the same step count)."""
+        if not self.sync_scores:
+            return score
+        from horovod_tpu import basics
+
+        if not basics.is_initialized() or basics.num_processes() <= 1:
+            return score
+        from horovod_tpu.ops import collectives as C
+
+        out = C.allreduce(
+            np.asarray([score], np.float64),
+            C.Average,
+            name=f"autotune.score.{self._samples_seen}",
+        )
+        return float(np.asarray(out)[0])
+
+    # ---- phase machine ------------------------------------------------------
+
+    def _advance(self, score: float) -> None:
+        if self._phase == "warmup":
+            if self._samples_seen >= self.warmup_samples:
+                self._phase = "cat" if self.categoricals else "bo"
+                if self._phase == "cat":
+                    self._apply({self.categoricals[0].name:
+                                 self.categoricals[0].values[0]})
+            return
+        if self._phase == "cat":
+            self._advance_categorical(score)
+            return
+        if self._phase == "bo":
+            self._advance_bo(score)
+
+    def _advance_categorical(self, score: float) -> None:
+        param = self.categoricals[self._cat_i]
+        self._cat_scores.append(score)
+        if score > self._best[0]:
+            self._best = (score, dict(self._current))
+        if self._cat_j + 1 < len(param.values):
+            # next value of the same param
+            self._cat_j += 1
+            self._apply({param.name: param.values[self._cat_j]})
+            return
+        # sweep of this param done: fix the best value
+        param.best_idx = int(np.argmax(self._cat_scores))
+        self._apply({param.name: param.values[param.best_idx]})
+        self._cat_scores = []
+        self._cat_j = 0
+        self._cat_i += 1
+        if self._cat_i >= len(self.categoricals):
+            self._phase = "bo"
+
+    def _advance_bo(self, score: float) -> None:
+        self.bo.register(list(self._knobs), score)
+        if score > self._best[0]:
+            self._best = (score, dict(self._current))
+        if len(self.bo.ys) >= self.bo_samples:  # converge: freeze at best
+            self._apply(self._best[1])
             self._active = False
             if self._log:
                 self._log_file.close()
-        # NOTE: the new threshold is NOT applied to the native planner here.
-        # Per-rank scores (and therefore suggestions) differ, and fusion
-        # grouping must be identical on every rank or collectives mismatch;
-        # call synchronize() to broadcast rank 0's choice and apply it.
+                self._log = None
+            return
+        knobs = self.bo.suggest()
+        self._knobs = (float(knobs[0]), float(knobs[1]))
+        self._apply(
+            {
+                "fusion_threshold": self._threshold_from_knob(self._knobs[0]),
+                "cycle_time_ms": self._knobs[1],
+            }
+        )
 
-    def _push_to_native(self) -> None:
-        """Apply the (synchronized) threshold to the native fusion planner
-        so the eager path buckets at the tuned size (the reference applies
-        ParameterManager output to TensorFusionThresholdBytes only after
-        Controller::SynchronizeParameters)."""
+    # ---- application ---------------------------------------------------------
+
+    def _apply(self, settings: Dict) -> None:
+        """Apply knob settings to the live runtime.  Safe to call on every
+        rank: settings are identical by construction (synced scores +
+        shared seed).  Cycle time is per-rank local and the bit-vector
+        protocol pads cache-capacity races, but the FUSION threshold must
+        never differ across ranks for the same response stream (ranks
+        would group allreduces differently → mismatched global
+        collectives), so threshold changes are applied behind a native
+        BARRIER flush: after the barrier, no op negotiated under the old
+        threshold is outstanding anywhere, and ops submitted later can
+        only become ready once every rank has also passed its _apply at
+        the same step boundary."""
+        self._current.update(settings)
         try:
             from horovod_tpu import eager_runtime
 
             rt = eager_runtime.get()
-            if rt is not None:
-                rt.set_fusion_bytes(self._current)
         except Exception:  # pragma: no cover - defensive
-            pass
+            rt = None
+        if rt is not None and "fusion_threshold" in settings:
+            rt.barrier()
+        for k, v in settings.items():
+            if k == "fusion_threshold" and rt is not None:
+                rt.set_fusion_bytes(int(v))
+            elif k == "cycle_time_ms" and rt is not None:
+                rt.set_cycle_ms(float(v))
+            elif k == "cache_capacity" and rt is not None:
+                rt.set_cache_capacity(int(v))
+            elif k in ("hierarchical_allreduce", "hierarchical_allgather"):
+                # Read at trace/build time by the in-graph ops
+                # (ops/collectives.py hierarchical_*_enabled) — affects
+                # steps built after this point; running compiled steps are
+                # immutable, so callers doing variant selection should
+                # consult .settings each window.
+                os.environ["HOROVOD_" + k.upper()] = "1" if v else "0"
 
     def synchronize(self) -> None:
-        """Broadcast the winning threshold from rank 0 so all processes
-        fuse identically (``Controller::SynchronizeParameters``,
-        ``controller.cc:33-47``)."""
+        """Broadcast the current settings from rank 0 and apply them — the
+        explicit analogue of ``Controller::SynchronizeParameters``
+        (``controller.cc:33-47``).  With ``sync_scores`` the per-rank
+        tuners already agree; this is the belt-and-braces path for callers
+        that disabled score syncing."""
         from horovod_tpu import state as S
 
-        self._current = int(S.broadcast_object(self._current, 0))
-        self._push_to_native()
+        self._apply(dict(S.broadcast_object(self._current, 0)))

@@ -71,7 +71,7 @@ class EagerRuntime:
         self._results: Dict[str, Any] = {}
         self._counters = {k: itertools.count() for k in
                           ("allreduce", "allgather", "broadcast", "alltoall",
-                           "barrier")}
+                           "reducescatter", "barrier")}
         rt.set_executor(self._execute)
 
     # ---- naming (reference: "allreduce.noname.N" convention in the torch
@@ -176,6 +176,8 @@ class EagerRuntime:
                 outs = [C._eager_broadcast(inputs[0], resp.root_rank)]
             elif resp.type == native.ALLTOALL:
                 outs = [C._eager_alltoall(inputs[0], None)]
+            elif resp.type == native.RESP_REDUCESCATTER:
+                outs = [C._eager_reducescatter(inputs[0], to_op[resp.op])]
             else:
                 return native.STATUS_INVALID
 
@@ -205,6 +207,18 @@ class EagerRuntime:
         """Adjust the native fusion planner's threshold (autotuner knob —
         reference ParameterManager -> TensorFusionThresholdBytes)."""
         self._rt.set_fusion_bytes(int(nbytes))
+
+    def set_cycle_ms(self, ms: float) -> None:
+        """Adjust the background negotiation cycle time (autotuner knob —
+        reference HOROVOD_CYCLE_TIME / ParameterManager joint BO)."""
+        self._rt.set_cycle_us(int(ms * 1000))
+
+    def set_cache_capacity(self, n: int) -> None:
+        """Resize (and clear) the response cache; applied by the
+        background thread between cycles.  The bit-vector protocol pads
+        length mismatches during propagation, so ranks may apply this at
+        slightly different cycles without error."""
+        self._rt.set_cache_capacity(int(n))
 
     def shutdown(self) -> None:
         self._rt.shutdown()

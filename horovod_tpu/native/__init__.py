@@ -28,8 +28,11 @@ _LIB_PATH = os.path.join(_DIR, "libhvd_native.so")
 
 # --- enums, mirroring src/common.h -------------------------------------------
 
-ALLREDUCE, ALLGATHER, BROADCAST, JOIN, ALLTOALL, BARRIER = range(6)
+ALLREDUCE, ALLGATHER, BROADCAST, JOIN, ALLTOALL, BARRIER, REDUCESCATTER = range(7)
 RESP_ERROR = 6
+# RespType diverges from ReqType past ERROR (common.h): reducescatter
+# responses arrive as 7 while requests enqueue as REDUCESCATTER (6).
+RESP_REDUCESCATTER = 7
 
 OP_AVERAGE, OP_SUM, OP_ADASUM, OP_MIN, OP_MAX, OP_PRODUCT = range(6)
 
@@ -145,6 +148,10 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.hvd_cache_entries.restype = ctypes.c_longlong
     lib.hvd_set_fusion_bytes.restype = None
     lib.hvd_set_fusion_bytes.argtypes = [ctypes.c_longlong]
+    lib.hvd_set_cycle_us.restype = None
+    lib.hvd_set_cycle_us.argtypes = [ctypes.c_longlong]
+    lib.hvd_set_cache_capacity.restype = None
+    lib.hvd_set_cache_capacity.argtypes = [ctypes.c_int]
 
 
 def native_built() -> bool:
@@ -382,6 +389,12 @@ class NativeRuntime:
 
     def set_fusion_bytes(self, b: int) -> None:
         self._lib.hvd_set_fusion_bytes(b)
+
+    def set_cycle_us(self, us: int) -> None:
+        self._lib.hvd_set_cycle_us(int(us))
+
+    def set_cache_capacity(self, n: int) -> None:
+        self._lib.hvd_set_cache_capacity(int(n))
 
     def shutdown(self) -> None:
         if self._initialized:

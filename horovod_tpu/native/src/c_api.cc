@@ -96,5 +96,7 @@ long long hvd_cycles() { return Runtime::Get().cycles(); }
 long long hvd_cache_hits() { return Runtime::Get().cache_hits(); }
 long long hvd_cache_entries() { return Runtime::Get().cache_entries(); }
 void hvd_set_fusion_bytes(long long b) { Runtime::Get().set_fusion_bytes(b); }
+void hvd_set_cycle_us(long long us) { Runtime::Get().set_cycle_us(us); }
+void hvd_set_cache_capacity(int n) { Runtime::Get().set_cache_capacity(n); }
 
 }  // extern "C"

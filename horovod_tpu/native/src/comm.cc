@@ -9,6 +9,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cstring>
 #include <thread>
@@ -238,25 +239,43 @@ bool SocketComm::AllreduceBitsAndOr(const std::vector<uint64_t>& bits,
   std::memcpy(payload.data(), bits.data(), nbytes);
   std::vector<std::vector<uint8_t>> gathered;
   if (!Gather(payload, &gathered, err)) return false;
-  std::vector<uint8_t> wire(2 * nbytes);
+  std::vector<uint8_t> wire;
   if (rank_ == 0) {
+    // Ranks may briefly disagree on bit-vector length while a cache
+    // capacity change (autotuner) propagates.  Treat missing words as 0:
+    // AND clears those cache slots, which re-enter negotiation via the
+    // divergence slow path — self-healing instead of a hard error.
+    size_t max_words = bits.size();
+    for (int r = 1; r < size_; ++r)
+      max_words = std::max(max_words, gathered[r].size() / 8);
+    std::vector<uint64_t> all_and(max_words, 0), all_or(max_words, 0);
+    std::memcpy(all_and.data(), bits.data(), nbytes);
+    std::memcpy(all_or.data(), bits.data(), nbytes);
     for (int r = 1; r < size_; ++r) {
-      if (gathered[r].size() != nbytes) {
-        *err = "bit-vector size mismatch across ranks";
-        return false;
-      }
-      const uint64_t* peer = reinterpret_cast<const uint64_t*>(gathered[r].data());
-      for (size_t i = 0; i < bits.size(); ++i) {
-        (*bits_and)[i] &= peer[i];
-        (*bits_or)[i] |= peer[i];
+      const uint64_t* peer =
+          reinterpret_cast<const uint64_t*>(gathered[r].data());
+      size_t peer_words = gathered[r].size() / 8;
+      for (size_t i = 0; i < max_words; ++i) {
+        uint64_t w = i < peer_words ? peer[i] : 0;
+        all_and[i] &= w;
+        all_or[i] |= w;
       }
     }
-    std::memcpy(wire.data(), bits_and->data(), nbytes);
-    std::memcpy(wire.data() + nbytes, bits_or->data(), nbytes);
+    wire.resize(2 * max_words * 8);
+    std::memcpy(wire.data(), all_and.data(), max_words * 8);
+    std::memcpy(wire.data() + max_words * 8, all_or.data(), max_words * 8);
   }
   if (!Bcast(&wire, err)) return false;
-  std::memcpy(bits_and->data(), wire.data(), nbytes);
-  std::memcpy(bits_or->data(), wire.data() + nbytes, nbytes);
+  // Adopt the coordinator's (max) length rather than truncating to the
+  // local one: divergence beyond this rank's current capacity must still
+  // force the slow-path round on EVERY rank, or ranks disagree on whether
+  // a Gather/Bcast round happens and the stream desynchronizes.  The
+  // controller's divergence scan iterates whatever length arrives here.
+  size_t wire_words = wire.size() / 16;
+  bits_and->assign(wire_words, 0);
+  bits_or->assign(wire_words, 0);
+  std::memcpy(bits_and->data(), wire.data(), wire_words * 8);
+  std::memcpy(bits_or->data(), wire.data() + wire_words * 8, wire_words * 8);
   return true;
 }
 

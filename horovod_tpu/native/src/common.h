@@ -24,6 +24,7 @@ enum class ReqType : uint8_t {
   JOIN = 3,
   ALLTOALL = 4,
   BARRIER = 5,
+  REDUCESCATTER = 6,
 };
 
 enum class RespType : uint8_t {
@@ -34,6 +35,7 @@ enum class RespType : uint8_t {
   ALLTOALL = 4,
   BARRIER = 5,
   ERROR = 6,
+  REDUCESCATTER = 7,
 };
 
 // Reduce ops (reference exposes Average/Sum/Adasum; Min/Max/Product are

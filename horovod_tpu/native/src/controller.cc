@@ -191,6 +191,19 @@ void Controller::CoordinatorIngest(const std::vector<RequestList>& lists,
     if (static_cast<int>(kv.second.ranks.size()) >= needed)
       ready.push_back(kv.first);
   }
+  // Barriers dispatch LAST within their cycle: a rank returning from a
+  // barrier wait may immediately run a direct-path (un-negotiated)
+  // collective, which is only safe once every co-ready response has been
+  // dispatched on every rank (dispatch is sequential per rank and the
+  // response order is common, so barrier-last makes the flush total).
+  std::stable_sort(ready.begin(), ready.end(),
+                   [this](const std::string& a, const std::string& b) {
+                     bool ab = message_table_.at(a).requests.front().type ==
+                               ReqType::BARRIER;
+                     bool bb = message_table_.at(b).requests.front().type ==
+                               ReqType::BARRIER;
+                     return !ab && bb;
+                   });
   for (const auto& name : ready) {
     out->responses.push_back(ConstructResponse(name));
     message_table_.erase(name);
@@ -224,7 +237,7 @@ bool Controller::CheckConsistency(const std::vector<Request>& reqs,
       *error = "Mismatched data types for tensor '" + first.name + "'";
       return false;
     }
-    if (r.type == ReqType::ALLREDUCE &&
+    if ((r.type == ReqType::ALLREDUCE || r.type == ReqType::REDUCESCATTER) &&
         (r.op != first.op || r.shape != first.shape ||
          r.prescale != first.prescale || r.postscale != first.postscale)) {
       *error = "Mismatched allreduce shape/op for tensor '" + first.name + "'";
@@ -278,8 +291,8 @@ Response Controller::ConstructResponse(const std::string& name) {
   if (!joined_ranks_.empty() && first.type != ReqType::ALLREDUCE &&
       first.type != ReqType::BARRIER) {
     resp.type = RespType::ERROR;
-    resp.error = "Allgather/broadcast/alltoall are not supported while a "
-                 "rank has joined; tensor '" + name + "'";
+    resp.error = "Allgather/broadcast/alltoall/reducescatter are not "
+                 "supported while a rank has joined; tensor '" + name + "'";
     return resp;
   }
   switch (first.type) {
@@ -289,6 +302,7 @@ Response Controller::ConstructResponse(const std::string& name) {
     case ReqType::ALLTOALL: resp.type = RespType::ALLTOALL; break;
     case ReqType::BARRIER: resp.type = RespType::BARRIER; break;
     case ReqType::JOIN: resp.type = RespType::JOIN; break;
+    case ReqType::REDUCESCATTER: resp.type = RespType::REDUCESCATTER; break;
   }
   resp.joined_ranks.assign(joined_ranks_.begin(), joined_ranks_.end());
   return resp;

@@ -16,6 +16,7 @@
 #ifndef HVD_NATIVE_CONTROLLER_H
 #define HVD_NATIVE_CONTROLLER_H
 
+#include <atomic>
 #include <map>
 #include <set>
 #include <string>
@@ -55,8 +56,14 @@ class Controller {
 
   int64_t cache_hits() const { return cache_.hits(); }
   size_t cache_entries() const { return cache_.NumEntries(); }
-  void set_fusion_bytes(int64_t b) { fusion_bytes_ = b; }
-  int64_t fusion_bytes() const { return fusion_bytes_; }
+  // Written from the application thread (autotuner), read by the
+  // background thread's Fuse() — atomic for data-race freedom.  Cross-rank
+  // consistency is the caller's contract: apply only behind a barrier
+  // flush so no two ranks fuse the same response stream with different
+  // thresholds (see Autotuner._apply).
+  void set_fusion_bytes(int64_t b) { fusion_bytes_.store(b); }
+  void set_cache_capacity(size_t n) { cache_.set_capacity(n); }
+  int64_t fusion_bytes() const { return fusion_bytes_.load(); }
 
  private:
   // Coordinator-only (rank 0) slow path: ingest gathered request lists,
@@ -70,7 +77,7 @@ class Controller {
 
   SocketComm* comm_;
   ResponseCache cache_;
-  int64_t fusion_bytes_;
+  std::atomic<int64_t> fusion_bytes_;
   StallInspector stall_;
 
   // Coordinator state (rank 0 only), reference MessageTable.

@@ -47,6 +47,16 @@ class ResponseCache {
 
   size_t NumEntries() const { return entries_.size(); }
   size_t capacity() const { return capacity_; }
+  // Resize drops all entries: bit positions are only meaningful while every
+  // rank's cache evolves in lockstep, so a capacity change restarts from
+  // empty (entries renegotiate through the slow path once).  Unchanged
+  // capacity is a no-op — the autotuner re-sends its winning settings at
+  // freeze time, which must not wipe the warm cache.
+  void set_capacity(size_t n) {
+    if (n == capacity_) return;
+    Clear();
+    capacity_ = n;
+  }
   int64_t hits() const { return hits_; }
   void CountHit() { ++hits_; }
 

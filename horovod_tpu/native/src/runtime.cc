@@ -29,6 +29,7 @@ bool Runtime::Init(const RuntimeOptions& opts, std::string* err) {
                                    opts.stall_shutdown_sec));
   if (!opts.timeline_path.empty() && opts.rank == 0)
     timeline_.Initialize(opts.timeline_path);
+  cycle_us_.store(static_cast<int64_t>(opts.cycle_time_ms * 1000.0));
   queue_.Reopen();
   stop_.store(false);
   shutdown_requested_.store(false);
@@ -72,9 +73,9 @@ int64_t Runtime::EnqueueJoin() {
 
 void Runtime::BackgroundLoop() {
   using clock = std::chrono::steady_clock;
-  auto cycle = std::chrono::duration<double, std::milli>(opts_.cycle_time_ms);
   while (!stop_.load()) {
     auto start = clock::now();
+    auto cycle = std::chrono::microseconds(cycle_us_.load());
     if (!RunLoopOnce()) break;
     cycles_.fetch_add(1);
     if (opts_.timeline_mark_cycles) timeline_.MarkCycle();
@@ -85,6 +86,9 @@ void Runtime::BackgroundLoop() {
 }
 
 bool Runtime::RunLoopOnce() {
+  int new_cap = pending_cache_capacity_.exchange(-1);
+  if (new_cap >= 0) controller_->set_cache_capacity(new_cap);
+
   std::vector<Request> pending = queue_.PopAll();
   for (const auto& r : pending)
     if (r.type == ReqType::JOIN) local_join_ = true;

@@ -66,6 +66,12 @@ class Runtime {
   void set_fusion_bytes(int64_t b) {
     if (controller_) controller_->set_fusion_bytes(b);
   }
+  // Autotuner knobs (reference ParameterManager application points).
+  // cycle time takes effect on the next loop iteration; the cache
+  // capacity change is applied by the background thread between cycles
+  // (the controller is bg-thread-owned).
+  void set_cycle_us(int64_t us) { cycle_us_.store(us); }
+  void set_cache_capacity(int n) { pending_cache_capacity_.store(n); }
 
  private:
   Runtime() = default;
@@ -85,6 +91,8 @@ class Runtime {
   std::atomic<bool> stop_{false};
   std::atomic<bool> shutdown_requested_{false};
   std::atomic<int64_t> cycles_{0};
+  std::atomic<int64_t> cycle_us_{1000};
+  std::atomic<int> pending_cache_capacity_{-1};
   bool local_join_ = false;  // background-thread-only state
 };
 

@@ -312,8 +312,86 @@ def _compiled_identity_replicated():
     return jax.jit(lambda a: a, out_shardings=repl)
 
 
+# --- traffic-shaped eager programs -------------------------------------------
+#
+# Builders are parameterized by (mesh, axis) so tests can compile them over a
+# virtual multi-device mesh and assert on the emitted collectives (the
+# "bytes proportional to tensor, not P x tensor" contract).  The eager path
+# instantiates them over the process mesh via the cached wrappers below.
+
+
+def _pick_program(mesh, axis: str, src: int):
+    """Rooted broadcast: replicate ONE shard of a dim-0-sharded array.
+
+    The owner's block is statically sliced out, so the partitioner moves only
+    that tensor (select + all-reduce or collective-broadcast) — never an
+    all-gather of every rank's buffer.  Replaces the reference's
+    ``MPIBroadcast``/``NCCLBroadcast`` on the eager path."""
+    repl = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+    return jax.jit(
+        lambda a: lax.index_in_dim(a, src, axis=0, keepdims=False),
+        out_shardings=repl,
+    )
+
+
+def _reducescatter_program(mesh, axis: str, op: str):
+    """Eager reduce-scatter as a true ``lax.psum_scatter`` (each process
+    receives only its reduced 1/P slice and each link carries (P-1)/P of
+    one tensor — half the all-reduce cost; reference
+    ``ops/nccl_operations.cc:162-354`` intra-node phase)."""
+    from horovod_tpu import spmd
+
+    spec = jax.sharding.PartitionSpec(axis)
+
+    def fn(block):  # per-shard: (1, d0, ...)
+        t = jnp.squeeze(block, 0)
+        out = lax.psum_scatter(t, axis, scatter_dimension=0, tiled=True)
+        if op == Average:
+            out = out / jnp.asarray(lax.axis_size(axis), out.dtype)
+        return out[None]
+
+    return jax.jit(spmd.shard(fn, in_specs=spec, out_specs=spec, mesh=mesh))
+
+
+def _alltoall_program(mesh, axis: str):
+    """Eager all-to-all as a true ``lax.all_to_all`` over the process axis
+    (traffic: each link carries one peer-slice, not the whole tensor)."""
+    from horovod_tpu import spmd
+
+    spec = jax.sharding.PartitionSpec(axis)
+
+    def fn(block):  # per-shard: (1, rows, ...)
+        t = jnp.squeeze(block, 0)
+        t = lax.all_to_all(t, axis, split_axis=0, concat_axis=0, tiled=True)
+        return t[None]
+
+    return jax.jit(spmd.shard(fn, in_specs=spec, out_specs=spec, mesh=mesh))
+
+
+@functools.lru_cache(maxsize=4096)
+def _compiled_pick(src: int):
+    return _pick_program(_process_mesh(), "proc", src)
+
+
+@functools.lru_cache(maxsize=16)
+def _compiled_reducescatter(op: str):
+    return _reducescatter_program(_process_mesh(), "proc", op)
+
+
+@functools.lru_cache(maxsize=1)
+def _compiled_alltoall():
+    return _alltoall_program(_process_mesh(), "proc")
+
+
 def _replicated_to_host(arr) -> np.ndarray:
     return np.asarray(jax.device_get(arr))
+
+
+def _local_shard_to_host(arr) -> np.ndarray:
+    """Fetch this process's (single) addressable shard of a global array."""
+    shards = arr.addressable_shards
+    assert len(shards) == 1, len(shards)
+    return np.asarray(shards[0].data)
 
 
 def _eager_allreduce(x, op: str, prescale, postscale) -> np.ndarray:
@@ -359,32 +437,58 @@ def _eager_broadcast(x, root_rank: int) -> np.ndarray:
         return xh.copy()
     # root_rank is a worker rank; owning process = root // local_size.
     proc = root_rank // max(basics.local_size(), 1)
-    gathered = _replicated_to_host(_compiled_identity_replicated()(_to_global(xh)))
-    return gathered[proc]
+    return _replicated_to_host(_compiled_pick(proc)(_to_global(xh)))
+
+
+def _eager_reducescatter(x, op: str) -> np.ndarray:
+    if op not in (Average, Sum):
+        raise ValueError("reducescatter supports Sum/Average")
+    xh = np.asarray(x)
+    P = basics.cross_size()
+    if xh.shape[0] % P != 0:
+        raise ValueError(
+            f"reducescatter requires dim0 ({xh.shape[0]}) divisible by the "
+            f"worker count ({P})"
+        )
+    if P == 1:
+        return xh.copy()
+    return _local_shard_to_host(_compiled_reducescatter(op)(_to_global(xh)))[0]
 
 
 def _eager_alltoall(x, splits) -> np.ndarray:
     xh = np.asarray(x)
     P = basics.cross_size()
-    if splits is None:
-        if xh.shape[0] % P != 0:
-            raise ValueError("alltoall without splits requires dim0 % size == 0")
-        splits = [xh.shape[0] // P] * P
+    if splits is None and xh.shape[0] % P != 0:
+        raise ValueError("alltoall without splits requires dim0 % size == 0")
+    if splits is not None:
+        splits = np.asarray(splits, np.int64)
+        if splits.shape != (P,) or splits.sum() != xh.shape[0]:
+            raise ValueError(f"splits must be ({P},) summing to dim0")
     if P == 1:
         return xh.copy()
+    if splits is None:
+        # Even splits: one true all_to_all — each link carries one
+        # tensor/P slice.
+        out = _local_shard_to_host(_compiled_alltoall()(_to_global(xh)))
+        return out[0]
+    # Uneven splits: pad each destination piece to the global max split and
+    # run the same all_to_all over (P, max_split) blocks — traffic is
+    # P x max_split (~ tensor size), not P x whole-tensor (reference covers
+    # uneven recvcounts via MPI_Alltoallv; XLA all_to_all is regular, so
+    # padding buys regularity).
     gathered_splits = _replicated_to_host(
-        _compiled_identity_replicated()(_to_global(np.asarray(splits, np.int64)))
+        _compiled_identity_replicated()(_to_global(splits))
     ).astype(int)
-    m = int(np.max(np.sum(gathered_splits, axis=1)))
-    pad = np.zeros((m,) + xh.shape[1:], xh.dtype)
-    pad[: xh.shape[0]] = xh
-    gathered = _replicated_to_host(_compiled_identity_replicated()(_to_global(pad)))
-    me = jax.process_index()
-    pieces = []
+    m = int(gathered_splits.max())
+    send = np.zeros((P, m) + xh.shape[1:], xh.dtype)
+    offs = np.concatenate([[0], np.cumsum(splits)])
     for p in range(P):
-        offs = np.concatenate([[0], np.cumsum(gathered_splits[p])])
-        pieces.append(gathered[p, offs[me] : offs[me + 1]])
-    return np.concatenate(pieces, axis=0)
+        send[p, : splits[p]] = xh[offs[p] : offs[p + 1]]
+    out = _local_shard_to_host(_compiled_alltoall()(_to_global(send)))[0]
+    me = jax.process_index()
+    return np.concatenate(
+        [out[p, : gathered_splits[p, me]] for p in range(P)], axis=0
+    )
 
 
 # --- native-runtime routing ---------------------------------------------------
@@ -411,6 +515,7 @@ def _native_kind_and_args(kind: str):
         "allgather": native.ALLGATHER,
         "broadcast": native.BROADCAST,
         "alltoall": native.ALLTOALL,
+        "reducescatter": native.REDUCESCATTER,
     }[kind]
 
 
@@ -566,21 +671,60 @@ def alltoall(tensor, splits=None, *, axis_name=None, name=None):
             _reraise_unbound(e)
     basics._ctx()
     rt = _native_rt()
-    if rt is not None and splits is None:
-        treedef, pairs = _native_submit_tree(rt, "alltoall", tensor, name)
-        return _native_wait_tree(rt, treedef, pairs)
+    if rt is not None:
+        if splits is None:
+            treedef, pairs = _native_submit_tree(rt, "alltoall", tensor, name)
+            return _native_wait_tree(rt, treedef, pairs)
+        # Uneven splits can't ride the native queue (the wire Request has no
+        # splits field, matching the reference v0.19 op set which predates
+        # alltoallv), so they run on the direct path.  Flush with a native
+        # BARRIER first: under the SPMD ordering contract every op submitted
+        # before this point (on any rank) completes before the barrier does,
+        # so no negotiated launch can interleave with the direct collective
+        # (protocol invariant #4).  A local pending check would NOT work —
+        # ranks can disagree on local pending state and then only some of
+        # them would enter the global collective.
+        rt.barrier()
     return jax.tree_util.tree_map(lambda t: _eager_alltoall(t, splits), tensor)
 
 
 def reducescatter(tensor, op: str = Average, *, axis_name=None, name=None):
-    """Reduce-scatter along dim 0 (in-graph only; the primitive underlying
-    hierarchical allreduce, ``ops/nccl_operations.cc:162-354``)."""
+    """Reduce-scatter along dim 0 (the primitive underlying hierarchical
+    allreduce, ``ops/nccl_operations.cc:162-354``).  In-graph it lowers to
+    ``lax.psum_scatter``; eagerly each worker receives its reduced 1/P
+    slice through the same negotiated runtime as the other ops."""
     if _is_traced(tensor):
         try:
             return _injit_reducescatter(tensor, op, _axis_names(axis_name))
         except NameError as e:
             _reraise_unbound(e)
-    raise NotImplementedError("reducescatter is an in-graph (shard_map) op")
+    _validate_reducescatter(tensor, op)
+    basics._ctx()
+    rt = _native_rt()
+    if rt is not None:
+        treedef, pairs = _native_submit_tree(
+            rt, "reducescatter", tensor, name, reduce_op=_native_reduce_op(op)
+        )
+        return _native_wait_tree(rt, treedef, pairs)
+    return jax.tree_util.tree_map(lambda t: _eager_reducescatter(t, op), tensor)
+
+
+def _validate_reducescatter(tensor, op: str) -> None:
+    """Fail fast with a local ValueError (identically on every rank, since
+    shapes match by contract) instead of letting the background executor
+    surface an opaque cross-rank NativeError after a negotiation round."""
+    if op not in (Average, Sum):
+        raise ValueError("reducescatter supports Sum/Average")
+    P = basics.cross_size() if basics.is_initialized() else 1
+    for leaf in jax.tree_util.tree_leaves(tensor):
+        a = np.asarray(leaf)
+        if a.ndim == 0:
+            raise ValueError("reducescatter requires tensors with >= 1 dim")
+        if a.shape[0] % max(P, 1) != 0:
+            raise ValueError(
+                f"reducescatter requires dim0 ({a.shape[0]}) divisible by "
+                f"the worker count ({P})"
+            )
 
 
 def barrier() -> None:
@@ -697,6 +841,18 @@ def broadcast_async(tensor, root_rank: int = 0, name=None, **kw) -> int:
         )
         return _handles.allocate(_NativeInFlight(rt, treedef, pairs))
     return _async(broadcast, tensor, root_rank, name=name, **kw)
+
+
+def reducescatter_async(tensor, op: str = Average, name=None, **kw) -> int:
+    rt = None if _is_traced(tensor) else _native_rt()
+    if rt is not None:
+        _validate_reducescatter(tensor, op)
+        basics._ctx()
+        treedef, pairs = _native_submit_tree(
+            rt, "reducescatter", tensor, name, reduce_op=_native_reduce_op(op)
+        )
+        return _handles.allocate(_NativeInFlight(rt, treedef, pairs))
+    return _async(reducescatter, tensor, op, name=name, **kw)
 
 
 def alltoall_async(tensor, splits=None, name=None, **kw) -> int:

@@ -29,6 +29,17 @@ DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024  # bytes; operations.cc:408
 
 
 def fusion_threshold_bytes() -> int:
+    """Threshold resolution order: live autotuner (the tuned value, applied
+    each sample window) → HOROVOD_FUSION_THRESHOLD env → 64 MB default.
+    In-graph callers bucket with this value at TRACE time, so the tuned
+    threshold affects steps built after tuning; the eager path consults it
+    on every call."""
+    from horovod_tpu import basics
+
+    if basics.is_initialized():
+        at = getattr(basics._ctx(), "autotuner", None)
+        if at is not None:
+            return int(at.fusion_threshold)
     v = os.environ.get("HOROVOD_FUSION_THRESHOLD")
     if v:
         return int(v)

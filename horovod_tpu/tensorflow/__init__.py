@@ -73,10 +73,15 @@ def broadcast_variables(variables, root_rank=0):
 def broadcast_global_variables(root_rank=0):
     """Broadcast every variable tracked by the v1-compat global collection
     (reference ``broadcast_global_variables``,
-    ``tensorflow/__init__.py:150-175``).  Works under
-    ``tf.compat.v1`` graph building; in pure TF2 eager code — where no
-    global collection exists — pass your variables to
-    :func:`broadcast_variables` instead."""
+    ``tensorflow/__init__.py:150-175``).
+
+    Only meaningful for v1-style code running with eager execution whose
+    variables landed in the v1 global collection (e.g. ``tf.compat.v1``
+    layers under an eager-enabled compat setup).  TF1 graph-session mode is
+    not supported by this shim (raises ``NotImplementedError``), and pure
+    TF2 eager code has an empty global collection (raises ``ValueError``)
+    — in both cases pass your variables to :func:`broadcast_variables` or
+    use :class:`BroadcastGlobalVariablesCallback` instead."""
     if not tf.executing_eagerly():
         raise NotImplementedError(
             "TF1 graph-mode sessions are not supported by the TPU eager "

@@ -79,10 +79,63 @@ def scenario_full():
     out = hvd.allgather(mine, name="ag")
     assert out.shape == (total, 2), out.shape
 
+    # reducescatter through the negotiated runtime: each rank gets its
+    # reduced 1/P slice
+    rs_in = np.arange(size * 3, dtype=np.float32) + rank
+    out = hvd.reducescatter(rs_in, hvd.Sum, name="rs1")
+    expect_full = sum(np.arange(size * 3, dtype=np.float32) + r
+                      for r in range(size))
+    np.testing.assert_allclose(out, expect_full[rank * 3:(rank + 1) * 3])
+
+    # alltoall (even splits) through the native queue
+    a2a_in = np.repeat(np.arange(size, dtype=np.float32), 2) + 100 * rank
+    out = hvd.alltoall(a2a_in, name="a2a1")
+    expect = np.repeat(np.full(size, float(rank)), 2) + 100 * np.repeat(
+        np.arange(size, dtype=np.float32), 2)
+    np.testing.assert_allclose(out, expect)
+
+    # alltoall with uneven splits runs on the direct path behind a native
+    # BARRIER flush, so it is safe even with async native ops in flight
+    # (invariant #4): the barrier is dispatched after every co-negotiated
+    # response, so no fused launch can interleave with the direct
+    # collective.
+    mine = np.arange(rank + size, dtype=np.float32)
+    splits = [rank + 1] + [1] * (size - 1)
+    h = hvd.allreduce_async(np.ones(4, np.float32), hvd.Sum, name="pend.t")
+    out = hvd.alltoall(mine, splits=splits)
+    assert out.shape[0] == sum(
+        ([r + 1] + [1] * (size - 1))[rank] for r in range(size))
+    np.testing.assert_allclose(
+        hvd.synchronize(h), np.full(4, float(size)))
+
+    # eager Adasum: distributed VHDD (2 procs = 1 ppermute round) vs oracle
+    from horovod_tpu.ops import adasum as adasum_mod
+    ada_in = (np.arange(6, dtype=np.float32) + 1) * (rank + 1)
+    out = hvd.allreduce(ada_in, hvd.Adasum, name="ada.e")
+    stacked = np.stack([(np.arange(6, dtype=np.float32) + 1) * (r + 1)
+                        for r in range(size)])
+    np.testing.assert_allclose(
+        out, np.asarray(adasum_mod.adasum_reduce_stack(stacked)), rtol=1e-6)
+
     # response-cache steady state: repeats of the same name fast-path
     for _ in range(5):
         hvd.allreduce(x, hvd.Sum, name="cached.t")
     assert rt.cache_hits() >= 3, rt.cache_hits()
+
+    # autotuner knob application: cycle time + cache capacity.  Resize on
+    # rank 0 FIRST so the ranks' bit-vector lengths disagree for a few
+    # cycles — the padded AllreduceBitsAndOr must self-heal via the
+    # divergence slow path instead of erroring.
+    rt.set_cycle_ms(0.5)
+    if rank == 0:
+        rt.set_cache_capacity(64)
+    np.testing.assert_allclose(
+        hvd.allreduce(x, hvd.Sum, name="skew.t"), np.full((4,), total))
+    if rank != 0:
+        rt.set_cache_capacity(64)
+    for _ in range(3):
+        np.testing.assert_allclose(
+            hvd.allreduce(x, hvd.Sum, name="skew.t2"), np.full((4,), total))
 
     # coordinator-detected shape mismatch -> error on every rank
     if size > 1:

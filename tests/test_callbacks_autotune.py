@@ -155,27 +155,98 @@ class TestBayesianOptimization:
 
 
 class TestAutotuner:
-    def test_converges_and_freezes(self, tmp_path):
+    def test_joint_bo_converges_and_freezes(self, tmp_path):
         log = str(tmp_path / "autotune.csv")
-        at = autotune.Autotuner(warmup_samples=1, steps_per_sample=2, log_path=log)
-        # Synthetic world: throughput peaks at 16MB threshold (knob=4)
-        def world(threshold):
+        at = autotune.Autotuner(
+            warmup_samples=1, steps_per_sample=2, log_path=log, categoricals=[]
+        )
+        # Synthetic world: throughput peaks at 16MB threshold (knob=4) AND
+        # cycle time 2ms — a separable joint optimum the 2-D BO must find.
+        def world(threshold, cycle_ms):
             knob = np.log2(threshold / (1024 * 1024))
-            return 1e9 * np.exp(-((knob - 4.0) ** 2) / 2)
+            return 1e9 * np.exp(-((knob - 4.0) ** 2) / 2) * np.exp(
+                -((cycle_ms - 2.0) ** 2) / 8
+            )
 
         for _ in range(100):
             if not at.active:
                 break
-            thr = at.fusion_threshold
-            score = world(thr)
+            score = world(at.fusion_threshold, at.cycle_time_ms)
             # record() wants bytes and seconds; steps_per_sample=2
             at.record(score, 1.0)
             at.record(score, 1.0)
         assert not at.active
         final_knob = np.log2(at.fusion_threshold / (1024 * 1024))
         assert abs(final_knob - 4.0) < 2.0
+        assert 0.5 <= at.cycle_time_ms <= 10.0
         with open(log) as f:
             assert len(f.readlines()) > 3
+
+    def test_categorical_chain_picks_best(self):
+        at = autotune.Autotuner(
+            warmup_samples=0,
+            steps_per_sample=1,
+            sync_scores=False,
+            categoricals=[
+                autotune.CategoricalParam("cache_capacity", [1024, 0]),
+                autotune.CategoricalParam("hierarchical_allreduce",
+                                          [False, True]),
+            ],
+        )
+        # World: cache off is 2x better; hierarchical on is 1.5x better.
+        def world(s):
+            v = 1e9
+            if s["cache_capacity"] == 0:
+                v *= 2
+            if s["hierarchical_allreduce"]:
+                v *= 1.5
+            return v
+
+        for _ in range(50):
+            if at._phase == "bo" or not at.active:
+                break
+            at.record(world(at.settings), 1.0)
+        assert at.settings["cache_capacity"] == 0
+        assert at.settings["hierarchical_allreduce"] is True
+
+    def test_hierarchical_flags_applied_to_env(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLREDUCE", raising=False)
+        at = autotune.Autotuner(categoricals=[])
+        at._apply({"hierarchical_allreduce": True})
+        assert os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] == "1"
+        at._apply({"hierarchical_allreduce": False})
+        assert os.environ["HOROVOD_HIERARCHICAL_ALLREDUCE"] == "0"
+        monkeypatch.delenv("HOROVOD_HIERARCHICAL_ALLREDUCE", raising=False)
+
+    def test_lockstep_determinism(self, monkeypatch):
+        """Two tuners fed identical (synced) scores propose identical
+        settings at every sample — the cross-rank agreement invariant."""
+        # The default categorical chain writes the hierarchical env flags;
+        # register the keys with monkeypatch so teardown restores them.
+        monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLREDUCE", "0")
+        monkeypatch.setenv("HOROVOD_HIERARCHICAL_ALLGATHER", "0")
+        mk = lambda: autotune.Autotuner(
+            warmup_samples=1, steps_per_sample=1, sync_scores=False
+        )
+        a, b = mk(), mk()
+        rng = np.random.RandomState(7)
+        for _ in range(25):
+            if not a.active:
+                break
+            score = float(rng.rand() * 1e9)
+            a.record(score, 1.0)
+            b.record(score, 1.0)
+            assert a.settings == b.settings
+        assert a.settings == b.settings
+
+    def test_tuned_threshold_feeds_ingraph_fusion(self, hvd, monkeypatch):
+        from horovod_tpu import basics
+        from horovod_tpu.ops import fusion
+
+        at = autotune.Autotuner(categoricals=[])
+        at._apply({"fusion_threshold": 12345678})
+        monkeypatch.setattr(basics._ctx(), "autotuner", at, raising=False)
+        assert fusion.fusion_threshold_bytes() == 12345678
 
     def test_from_env(self, monkeypatch):
         monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "5")
