@@ -15,3 +15,13 @@ from horovod_tpu.estimator.store import (  # noqa: F401
     Store,
     shard_arrays,
 )
+
+# KerasEstimator is import-gated on tensorflow (reference: the Keras
+# estimator lives under spark/keras/ and imports keras lazily).
+try:
+    from horovod_tpu.estimator.keras import (  # noqa: F401
+        KerasEstimator,
+        KerasModel,
+    )
+except ImportError:  # pragma: no cover - TF absent
+    pass

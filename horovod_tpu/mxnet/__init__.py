@@ -1,0 +1,186 @@
+"""MXNet frontend (reference: ``horovod/mxnet/__init__.py:40-158`` +
+``mxnet/mpi_ops.cc:1-291``).
+
+Import-gated on mxnet like the other framework shims.  The reference
+pushes async ops onto the MXNet engine through a C++ binding; here every
+collective crosses to numpy on the host and rides the shared eager data
+plane (negotiated + fused by the native control plane when it is up, the
+same path the torch and TF shims use), writing results back into the
+NDArray in place.  MXNet is not part of this image — the unit tests
+exercise this module against a mocked ``mxnet`` (documented gate); the
+module works unchanged against the real library.
+"""
+
+from __future__ import annotations
+
+import types
+from typing import Optional
+
+try:
+    import mxnet as mx
+except ImportError as _e:  # pragma: no cover - exercised via mock in tests
+    raise ImportError(
+        "horovod_tpu.mxnet requires mxnet, which is not installed in this "
+        "image; see tests/test_mxnet_frontend.py for the mocked-module "
+        "contract this frontend is verified against."
+    ) from _e
+
+import numpy as np
+
+from horovod_tpu.basics import (  # noqa: F401
+    cross_rank, cross_size, init, is_initialized, local_rank, local_size,
+    rank, shutdown, size,
+)
+from horovod_tpu.ops import collectives as C
+
+
+def _to_np(tensor) -> np.ndarray:
+    return tensor.asnumpy() if hasattr(tensor, "asnumpy") else np.asarray(tensor)
+
+
+def allreduce(tensor, average: bool = True, name: Optional[str] = None):
+    """Allreduce returning a NEW NDArray (reference ``hvd.allreduce``)."""
+    out = C.allreduce(_to_np(tensor), C.Average if average else C.Sum,
+                      name=name)
+    return mx.nd.array(out)
+
+
+def allreduce_(tensor, average: bool = True, name: Optional[str] = None):
+    """In-place allreduce (reference ``allreduce_``): the NDArray's
+    contents are replaced with the reduced values."""
+    out = C.allreduce(_to_np(tensor), C.Average if average else C.Sum,
+                      name=name)
+    tensor[:] = out
+    return tensor
+
+
+def broadcast(tensor, root_rank: int = 0, name: Optional[str] = None):
+    return mx.nd.array(C.broadcast(_to_np(tensor), root_rank, name=name))
+
+
+def broadcast_(tensor, root_rank: int = 0, name: Optional[str] = None):
+    tensor[:] = C.broadcast(_to_np(tensor), root_rank, name=name)
+    return tensor
+
+
+def allgather(tensor, name: Optional[str] = None):
+    return mx.nd.array(C.allgather(_to_np(tensor), name=name))
+
+
+class DistributedOptimizer(mx.optimizer.Optimizer):
+    """Wrap an mx optimizer so ``update`` reduces gradients first
+    (reference ``mxnet/__init__.py:40-80``): ``rescale_grad`` is divided
+    by the worker count so a SUM allreduce performs the average inside
+    the optimizer's own rescaling — one fused multiply instead of a
+    separate division pass."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        # The eager data plane reduces across PROCESSES (cross_size); in
+        # the reference size()==processes, but here size() counts devices,
+        # so the average-by-rescale must divide by the actual participant
+        # count.
+        self._optimizer.rescale_grad /= cross_size()
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def create_state_multi_precision(self, index, weight):
+        return self._optimizer.create_state_multi_precision(index, weight)
+
+    def _do_allreduce(self, index, grad):
+        if cross_size() == 1:
+            return
+        if isinstance(index, (tuple, list)):
+            # Grouped submission: one negotiation window sees the whole
+            # gradient set and fuses it (the reference expresses the same
+            # intent with descending priorities on the async engine).
+            for i, g in zip(index, grad):
+                allreduce_(g, average=False, name=f"grad.{i}")
+        else:
+            allreduce_(grad, average=False, name=f"grad.{index}")
+
+    def update(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update(index, weight, grad, state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._do_allreduce(index, grad)
+        self._optimizer.update_multi_precision(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+class DistributedTrainer(mx.gluon.Trainer):
+    """gluon Trainer whose gradient reduction is a horovod allreduce
+    instead of kvstore push/pull (reference ``mxnet/__init__.py:83-110``);
+    sum + pre-divided ``_scale`` performs the average."""
+
+    def __init__(self, params, optimizer, optimizer_params=None):
+        # If handed an already-wrapped DistributedOptimizer, unwrap WITHOUT
+        # touching it: its inner rescale_grad is already divided by
+        # cross_size() (that division performs the average), so the
+        # trainer must not divide _scale again — and mutating the shared
+        # inner optimizer would break the wrapper for its other users.
+        already_scaled = isinstance(optimizer, DistributedOptimizer)
+        if already_scaled:
+            optimizer = optimizer._optimizer
+        super().__init__(params, optimizer,
+                         optimizer_params=optimizer_params, kvstore=None)
+        if not already_scaled:
+            self._scale /= cross_size()
+
+    def _allreduce_grads(self):
+        if cross_size() == 1:
+            return
+        for param in self._params:
+            if getattr(param, "grad_req", None) != "null":
+                allreduce_(param.list_grad()[0], average=False,
+                           name=f"grad.{param.name}")
+
+
+def _append_broadcast_init(param, root_rank: int):
+    """Hook deferred-initialization so a parameter broadcasts right after
+    its shape is finally known (reference ``_append_broadcast_init``)."""
+    init_impl = param._init_impl
+
+    def wrapped_init_impl(self, *args, **kwargs):
+        init_impl(*args, **kwargs)
+        broadcast_(self.data(), root_rank=root_rank)
+        self.data().wait_to_read()
+
+    return wrapped_init_impl
+
+
+def broadcast_parameters(params, root_rank: int = 0) -> None:
+    """Broadcast a dict of NDArrays or a gluon ParameterDict from
+    ``root_rank`` (reference ``mxnet/__init__.py:120-158``); parameters
+    still pending deferred initialization broadcast post-init."""
+    if cross_size() == 1:
+        return
+    tensors = []
+    param_dict_cls = getattr(mx.gluon.parameter, "ParameterDict", None)
+    if param_dict_cls is not None and isinstance(params, param_dict_cls):
+        deferred_err = mx.gluon.parameter.DeferredInitializationError
+        for _, p in sorted(params.items()):
+            try:
+                tensors.append(p.data())
+            except deferred_err:
+                p._init_impl = types.MethodType(
+                    _append_broadcast_init(p, root_rank), p)
+    elif isinstance(params, dict):
+        tensors = [p for _, p in sorted(params.items())]
+    else:
+        raise ValueError(f"invalid params of type: {type(params)}")
+
+    for i, tensor in enumerate(tensors):
+        broadcast_(tensor, root_rank, name=f"param.{i}")
+    for tensor in tensors:
+        tensor.wait_to_read()

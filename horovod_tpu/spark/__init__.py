@@ -26,6 +26,11 @@ from horovod_tpu.estimator import (  # noqa: F401 — estimator parity surface
     TorchModel,
 )
 
+try:  # TF-gated, like the reference's spark/keras subpackage
+    from horovod_tpu.estimator import KerasEstimator, KerasModel  # noqa: F401
+except ImportError:  # pragma: no cover - TF absent
+    pass
+
 
 def _pyspark_available() -> bool:
     try:
@@ -63,49 +68,127 @@ def run(fn: Callable, args: tuple = (), kwargs: Optional[Dict] = None,
     return run_func.run(fn, args, kwargs, num_proc=nproc, env=env)
 
 
-def _spark_run(sc, fn, args, kwargs, num_proc, env, verbose):
+def _spark_run(sc, fn, args, kwargs, num_proc, env, verbose,
+               start_timeout: float = 600.0):
     """Spark task path (reference ``spark/__init__.py:104-239``): the
-    driver hosts the rendezvous KV server; tasks register their host,
-    learn rank 0's address, export the coordinator env, then run fn."""
-    import socket
+    driver hosts the job's signed rendezvous KV
+    (:class:`horovod_tpu.spark.driver.SparkDriverService`), Spark tasks
+    run :func:`horovod_tpu.spark.task.task_main` — register, ring NIC
+    probe, rank assignment, env wiring, fn execution — and results come
+    back rank-ordered through the RDD collect.
 
-    from horovod_tpu.runner.rendezvous import KVClient, RendezvousServer
+    The Spark job runs in a side thread (reference _make_spark_thread) so
+    the driver can coordinate registration while ``collect()`` blocks; a
+    task failure cancels the job group and flags the KV so blocked tasks
+    abort instead of hanging.
+    """
+    import queue
+    import socket
+    import threading
+    import time
+
+    from horovod_tpu.runner import discovery
+    from horovod_tpu.runner import secret as secret_mod
+    from horovod_tpu.spark import task as task_mod
+    from horovod_tpu.spark.driver import SCOPE, SparkDriverService
 
     num = num_proc or sc.defaultParallelism
-    server = RendezvousServer(0)
-    port = server.start()
+    driver = SparkDriverService(num, fn, args, kwargs, env)
     driver_host = os.environ.get("HOROVOD_HOSTNAME") or socket.gethostbyname(
         socket.gethostname())
-    jax_port = 9373
-    native_port = 9374
-    extra_env = dict(env or {})
-
-    def _task(index):
-        import os as _os
-        import socket as _socket
-
-        kv = KVClient(driver_host, port)
-        my_host = _socket.gethostbyname(_socket.gethostname())
-        kv.put("hosts", str(index), my_host.encode())
-        rank0_host = kv.wait("hosts", "0", timeout=120).decode()
-        _os.environ.update(extra_env)
-        _os.environ.update({
-            "HOROVOD_RANK": str(index),
-            "HOROVOD_NUM_PROC": str(num),
-            "HOROVOD_COORDINATOR_ADDR": rank0_host,
-            "HOROVOD_JAX_PORT": str(jax_port),
-            "HOROVOD_NATIVE_PORT": str(native_port),
-        })
-        return [fn(*(args or ()), **kwargs)]
+    driver_port = driver.port
+    job_group = f"horovod_tpu.spark.{driver_port}"
+    # The per-job HMAC key travels INSIDE the task closure (Spark's own
+    # serialized-closure channel): executors on other machines have fresh
+    # environments, and without the key they could not read a single
+    # signed KV entry — including the one carrying the job env.
+    secret_key = (secret_mod.get_key() or b"").decode()
 
     if verbose:
         print(f"[horovod_tpu.spark] running {num} Spark tasks; rendezvous "
-              f"at {driver_host}:{port}")
+              f"at {driver_host}:{driver_port}")
+
+    result_q: "queue.Queue" = queue.Queue()
+
+    def _run_job():
+        try:
+            sc.setJobGroup(job_group, "horovod_tpu.spark.run",
+                           interruptOnCancel=True)
+            res = (
+                sc.parallelize(range(num), num)
+                .mapPartitionsWithIndex(
+                    lambda i, _it: [task_mod.task_main(
+                        i, driver_host, driver_port, secret_key,
+                        timeout=start_timeout)])
+                .collect()
+            )
+            result_q.put(("ok", res))
+        except BaseException as e:  # noqa: BLE001 - propagate to caller
+            driver.notify_job_failed()
+            result_q.put(("error", e))
+
+    job_thread = threading.Thread(target=_run_job, daemon=True)
+    job_thread.start()
+
+    def _discover_with_abort(deadline: float):
+        """discovery.discover, but re-checked every few seconds so a task
+        crash mid-probe aborts the driver promptly (via the failed flag
+        _run_job sets) instead of blocking out the full start_timeout.
+        discover() only reads published reach-reports, so retrying it is
+        idempotent."""
+        while True:
+            if driver.failed or driver.kv.get(SCOPE, "failed") is not None:
+                raise RuntimeError("Spark job failed during NIC discovery")
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"NIC discovery did not complete within {start_timeout}s")
+            try:
+                return discovery.discover(driver.kv, num,
+                                          timeout=min(remaining, 3.0))
+            except TimeoutError:
+                continue
+
     try:
-        return (
-            sc.parallelize(range(num), num)
-            .mapPartitionsWithIndex(lambda i, _: _task(i))
-            .collect()
-        )
+        tasks = driver.wait_for_task_registration(timeout=start_timeout)
+        mapping = SparkDriverService.assign_ranks(tasks)
+        driver.publish_ranks(mapping, tasks)
+        # Ring NIC probe reports land in the same KV; pick rank 0's
+        # verified-routable address as the coordinator.
+        routable = _discover_with_abort(time.monotonic() + start_timeout)
+        rank0_index = next(i for i, r in mapping.items() if r == 0)
+        driver.publish_coordinator(
+            routable.get(rank0_index, tasks[rank0_index]["addrs"][0]),
+            jax_port=9373, native_port=9374)
+    except BaseException as startup_err:
+        driver.notify_job_failed()
+        try:
+            sc.cancelJobGroup(job_group)
+        except Exception:
+            pass
+        driver.shutdown()
+        # A task may have crashed first: surface ITS error (queued by
+        # _run_job) instead of the driver-side timeout that masked it.
+        try:
+            kind, payload = result_q.get_nowait()
+        except queue.Empty:
+            raise
+        if kind == "error":
+            raise RuntimeError(
+                "horovod_tpu.spark.run: Spark job failed during "
+                "startup") from payload
+        raise startup_err
+
+    try:
+        # start_timeout bounds STARTUP (registration/probe, above) only;
+        # fn may train for hours — wait for collect() indefinitely.
+        kind, payload = result_q.get()
+        if kind == "error":
+            raise RuntimeError(
+                "horovod_tpu.spark.run: Spark job failed") from payload
+        # task_main returns (rank, result); order by rank like the
+        # reference's ranks_to_indices-mapped results.
+        return [r for _, r in sorted(payload, key=lambda p: p[0])]
     finally:
-        server.stop()
+        job_thread.join(timeout=10)
+        driver.shutdown()

@@ -1,0 +1,131 @@
+"""Spark driver service: the launcher-side coordinator of a Spark job.
+
+Reference: ``horovod/spark/driver/driver_service.py`` (SparkDriverService /
+SparkDriverClient) and the driver half of ``spark/__init__.py:104-239`` —
+the driver holds the pickled ``fn``, collects task registrations and host
+hashes, assigns ranks host-contiguously, and distributes the coordination
+addresses.
+
+TPU re-design: instead of a pickled-RPC BasicService, the driver hosts the
+job's signed rendezvous KV server (:mod:`horovod_tpu.runner.rendezvous`)
+and all driver↔task traffic is KV puts/waits — the same transport the
+launcher already uses, so Spark tasks bootstrap exactly like
+``horovodrun``-spawned ranks.  The orted/mpirun_rsh tunnel disappears:
+tasks run ``fn`` in-process and JAX's distributed runtime (rank 0 =
+coordinator) replaces the MPI wire-up.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from horovod_tpu.runner.rendezvous import KVClient, RendezvousServer
+
+SCOPE = "spark"
+
+
+class SparkDriverService:
+    """Drives one ``horovod_tpu.spark.run`` job over the rendezvous KV."""
+
+    def __init__(self, num_proc: int, fn, args: tuple, kwargs: Dict,
+                 env: Optional[Dict[str, str]] = None) -> None:
+        self.num_proc = num_proc
+        self._server = RendezvousServer(0)
+        self.port = self._server.start()
+        self._kv: Optional[KVClient] = None
+        self._failed = False
+        payload = cloudpickle.dumps((fn, args, kwargs, dict(env or {})))
+        self.kv.put(SCOPE, "fn", payload)
+        self.kv.put(SCOPE, "num_proc", str(num_proc).encode())
+
+    @property
+    def kv(self) -> KVClient:
+        if self._kv is None:
+            self._kv = KVClient("127.0.0.1", self.port)
+        return self._kv
+
+    # -- registration (reference wait_for_initial_registration) ------------
+
+    def wait_for_task_registration(self, timeout: float = 600.0
+                                   ) -> List[Dict[str, Any]]:
+        """Block until all ``num_proc`` tasks registered; returns their
+        records ``{"index", "host_hash", "addrs"}`` in index order."""
+        deadline = time.monotonic() + timeout
+        tasks = []
+        for i in range(self.num_proc):
+            while True:
+                if self._failed or self.kv.get(SCOPE, "failed") is not None:
+                    raise RuntimeError(
+                        "Spark job failed before all tasks registered")
+                rec = self.kv.get(SCOPE, f"task.{i}")
+                if rec is not None:
+                    break
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"Spark tasks did not register within {timeout}s "
+                        f"(got {i}/{self.num_proc}); cluster may lack free "
+                        "executors — the reference raises the same way")
+                time.sleep(0.1)
+            tasks.append(json.loads(rec))
+        return tasks
+
+    # -- rank assignment (reference ranks_to_indices / host grouping) ------
+
+    @staticmethod
+    def assign_ranks(tasks: List[Dict[str, Any]]) -> Dict[int, int]:
+        """task index → rank, host-contiguous: tasks sharing a host hash
+        get consecutive ranks so ``local_rank`` is meaningful (the
+        reference builds its hosts string the same way,
+        ``spark/__init__.py:193-205``)."""
+        by_host: Dict[str, List[int]] = {}
+        for t in tasks:
+            by_host.setdefault(t["host_hash"], []).append(t["index"])
+        rank = 0
+        mapping: Dict[int, int] = {}
+        for host in sorted(by_host):
+            for idx in sorted(by_host[host]):
+                mapping[idx] = rank
+                rank += 1
+        return mapping
+
+    def publish_ranks(self, mapping: Dict[int, int],
+                      tasks: List[Dict[str, Any]]) -> None:
+        local_sizes: Dict[str, int] = {}
+        for t in tasks:
+            local_sizes[t["host_hash"]] = local_sizes.get(t["host_hash"], 0) + 1
+        payload = {
+            "index_to_rank": {str(k): v for k, v in mapping.items()},
+            "host_hash_by_index": {str(t["index"]): t["host_hash"]
+                                   for t in tasks},
+            "local_size_by_host": local_sizes,
+        }
+        self.kv.put(SCOPE, "ranks", json.dumps(payload).encode())
+
+    def publish_coordinator(self, addr: str, jax_port: int,
+                            native_port: int) -> None:
+        """Publish rank 0's routable address (from the ring NIC probe) —
+        the value the reference distributes as the mpirun host/interface
+        selection."""
+        self.kv.put(SCOPE, "coordinator", json.dumps(
+            {"addr": addr, "jax_port": jax_port,
+             "native_port": native_port}).encode())
+
+    def notify_job_failed(self) -> None:
+        """Mark the job failed so blocked tasks abort rather than hang
+        (reference notify_spark_job_failed)."""
+        self._failed = True
+        try:
+            self.kv.put(SCOPE, "failed", b"1")
+        except Exception:
+            pass
+
+    @property
+    def failed(self) -> bool:
+        return self._failed
+
+    def shutdown(self) -> None:
+        self._server.stop()

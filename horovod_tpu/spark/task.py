@@ -1,0 +1,125 @@
+"""Spark task service: the executor-side half of ``horovod_tpu.spark.run``.
+
+Reference: ``horovod/spark/task/task_service.py`` + the task half of
+``spark/__init__.py:39-71`` — each Spark task registers with the driver,
+ring-probes the next task's addresses to find routable NICs, then
+executes the per-rank entry (``mpirun_exec_fn``).
+
+TPU re-design: the task talks to the driver through the signed rendezvous
+KV, reuses the launcher's ring NIC probe (:mod:`horovod_tpu.runner.
+discovery`), and runs ``fn`` IN the Spark task process with the standard
+``HOROVOD_*`` env contract — no orted tunnel; JAX distributed init does
+the wire-up when ``fn`` calls ``horovod_tpu.init()``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import time
+from typing import Any
+
+import cloudpickle
+
+from horovod_tpu.runner import discovery
+from horovod_tpu.runner import secret as _secret
+from horovod_tpu.runner.rendezvous import KVClient
+
+from horovod_tpu.spark.driver import SCOPE
+
+
+def host_hash() -> str:
+    """Stable identifier of the machine a task runs on (reference
+    ``run/common/util/host_hash.py``: hostname-derived hash used to group
+    task indices into hosts).  Overridable via ``HOROVOD_HOST_HASH`` for
+    tests and containerized setups where hostnames lie."""
+    override = os.environ.get("HOROVOD_HOST_HASH")
+    if override:
+        return override
+    return hashlib.md5(socket.gethostname().encode()).hexdigest()[:16]
+
+
+def _wait(kv: KVClient, key: str, timeout: float) -> bytes:
+    """kv.wait that also aborts promptly if the driver flagged failure
+    (reference notify_spark_job_failed → tasks stop blocking)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if kv.get(SCOPE, "failed") is not None:
+            raise RuntimeError(
+                "Spark driver reported job failure; aborting task")
+        v = kv.get(SCOPE, key)
+        if v is not None:
+            return v
+        time.sleep(0.1)
+    raise TimeoutError(f"rendezvous key {SCOPE}/{key} not published")
+
+
+def task_main(index: int, driver_addr: str, driver_port: int,
+              secret_key: str = "", timeout: float = 600.0) -> Any:
+    """Body of one Spark task (reference ``_task_fn``,
+    ``spark/__init__.py:39-71``):
+
+    1. register index + host hash + candidate addresses with the driver;
+    2. ring-probe the next task's listener for routable NICs
+       (``runner.discovery``);
+    3. receive the rank assignment and coordinator address;
+    4. export the standard ``HOROVOD_*`` env and execute ``fn``.
+
+    ``secret_key`` is the driver's per-job HMAC key, shipped INSIDE the
+    Spark task closure (the reference ships its secret the same way,
+    inside the pickled task fn): an executor on another machine has a
+    fresh environment, and without the key it could not even read the
+    signed KV entry that carries the job's env.
+
+    Returns ``(rank, fn result)`` so the driver can order the collected
+    results by rank, matching the reference's return contract.
+    """
+    if secret_key:
+        os.environ[_secret.ENV_KEY] = secret_key
+    kv = KVClient(driver_addr, driver_port)
+    num_proc = int(_wait(kv, "num_proc", timeout))
+
+    kv.put(SCOPE, f"task.{index}", json.dumps({
+        "index": index,
+        "host_hash": host_hash(),
+        "addrs": discovery.local_addresses(),
+    }).encode())
+
+    # Ring NIC probe: same handshake the launcher uses (reference tasks
+    # probe next_task_client with match_intf=True).
+    discovery.run_task_discovery(kv, index, num_proc, timeout=timeout)
+
+    ranks = json.loads(_wait(kv, "ranks", timeout))
+    rank = int(ranks["index_to_rank"][str(index)])
+    my_host = ranks["host_hash_by_index"][str(index)]
+    local_size = int(ranks["local_size_by_host"][my_host])
+    peers_on_host = sorted(
+        int(i) for i, h in ranks["host_hash_by_index"].items()
+        if h == my_host
+    )
+    local_rank = peers_on_host.index(index)
+    coord = json.loads(_wait(kv, "coordinator", timeout))
+
+    fn, args, kwargs, extra_env = cloudpickle.loads(
+        _wait(kv, "fn", timeout))
+
+    # User env first, the computed HOROVOD_* contract ON TOP — a user
+    # propagating their shell env (which may carry stale HOROVOD_RANK /
+    # coordinator exports) must not clobber the task's wiring.
+    env = dict(extra_env)
+    env.update({
+        "HOROVOD_RANK": str(rank),
+        "HOROVOD_NUM_PROC": str(num_proc),
+        "HOROVOD_SIZE": str(num_proc),
+        "HOROVOD_LOCAL_RANK": str(local_rank),
+        "HOROVOD_LOCAL_SIZE": str(local_size),
+        "HOROVOD_COORDINATOR_ADDR": coord["addr"],
+        "HOROVOD_JAX_PORT": str(coord["jax_port"]),
+        "HOROVOD_NATIVE_PORT": str(coord["native_port"]),
+    })
+    os.environ.update(env)
+
+    result = fn(*args, **kwargs)
+    return rank, result

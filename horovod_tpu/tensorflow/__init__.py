@@ -42,12 +42,31 @@ def _to_np(t):
 
 def allreduce(tensor, average=None, op=None, name=None,
               prescale_factor=1.0, postscale_factor=1.0):
-    """Eager TF allreduce through the shared runtime (reference
-    tensorflow/__init__.py:43-118; IndexedSlices fall back to dense)."""
+    """TF allreduce through the shared runtime (reference
+    tensorflow/__init__.py:43-118; IndexedSlices fall back to dense).
+
+    Works eagerly AND inside ``tf.function``: under a function trace the
+    op embeds as a ``tf.py_function`` bridging to the eager data plane,
+    with the collective name captured at trace time from the symbolic
+    tensor (identical across ranks since the traced program is), so
+    out-of-order runtime execution of independent allreduces is matched
+    by name in the native coordinator."""
     if op is None:
         op = Average if (average is None or average) else Sum
     if isinstance(tensor, tf.IndexedSlices):
         tensor = tf.convert_to_tensor(tensor)
+    if tf.inside_function():
+        cname = name or "tf." + tensor.name.replace(":", ".")
+
+        def _bridge(t):
+            out = C.allreduce(t.numpy(), op, name=cname,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor)
+            return tf.convert_to_tensor(out)
+
+        result = tf.py_function(_bridge, [tensor], Tout=tensor.dtype)
+        result.set_shape(tensor.shape)
+        return result
     out = C.allreduce(_to_np(tensor), op, name=name,
                       prescale_factor=prescale_factor,
                       postscale_factor=postscale_factor)

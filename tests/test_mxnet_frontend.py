@@ -1,0 +1,237 @@
+"""MXNet frontend tests against a mocked ``mxnet`` module.
+
+MXNet is not installable in this image (documented gate in
+``horovod_tpu/mxnet/__init__.py``), so these tests install a minimal
+interface-faithful stand-in — NDArray with ``asnumpy``/in-place slice
+assignment/``wait_to_read``, ``optimizer.Optimizer``, ``gluon.Trainer``,
+``gluon.parameter.ParameterDict`` with deferred init — and drive the real
+frontend logic through it (the reference exercises ``test_mxnet.py``
+against the real library under mpirun; the frontend code path is the
+same either way since collectives cross at numpy)."""
+
+import sys
+import types as pytypes
+
+import numpy as np
+import pytest
+
+
+class FakeNDArray:
+    def __init__(self, arr):
+        self._arr = np.array(arr, dtype=np.float32)
+
+    def asnumpy(self):
+        return self._arr.copy()
+
+    def __setitem__(self, key, value):
+        self._arr[key] = np.asarray(value)
+
+    def wait_to_read(self):
+        pass
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+
+class FakeOptimizer:
+    def __init__(self, learning_rate=0.1, rescale_grad=1.0):
+        self.learning_rate = learning_rate
+        self.rescale_grad = rescale_grad
+        self.updates = []
+
+    def update(self, index, weight, grad, state):
+        if isinstance(index, (tuple, list)):  # real mx handles both forms
+            self.updates.append((index, [g.asnumpy().copy() for g in grad]))
+            for w, g in zip(weight, grad):
+                w[:] = w.asnumpy() - self.learning_rate * (
+                    self.rescale_grad * g.asnumpy())
+            return
+        self.updates.append((index, grad.asnumpy().copy()))
+        weight[:] = weight.asnumpy() - self.learning_rate * (
+            self.rescale_grad * grad.asnumpy())
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def create_state_multi_precision(self, index, weight):
+        return None
+
+    def set_learning_rate(self, lr):
+        self.learning_rate = lr
+
+    def set_lr_mult(self, m):
+        self.lr_mult = m
+
+    def set_wd_mult(self, m):
+        self.wd_mult = m
+
+
+class FakeTrainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore=None):
+        self._params = list(params.values()) if isinstance(params, dict) \
+            else list(params)
+        self._optimizer = optimizer
+        self._scale = 1.0
+        assert kvstore is None
+
+
+class DeferredInitializationError(Exception):
+    pass
+
+
+class FakeParameter:
+    def __init__(self, name, data=None):
+        self.name = name
+        self.grad_req = "write"
+        self._data = None if data is None else FakeNDArray(data)
+        self._grad = FakeNDArray(np.zeros(3))
+        self.init_calls = []
+
+    def data(self):
+        if self._data is None:
+            raise DeferredInitializationError(self.name)
+        return self._data
+
+    def list_grad(self):
+        return [self._grad]
+
+    def _init_impl(self, *a, **kw):
+        self._data = FakeNDArray(np.arange(3, dtype=np.float32))
+        self.init_calls.append(a)
+
+
+class FakeParameterDict:
+    def __init__(self, params):
+        self._params = dict(params)
+
+    def items(self):
+        return self._params.items()
+
+
+@pytest.fixture(scope="module")
+def hvd_mx():
+    """Install the mock and import the frontend through it."""
+    mx = pytypes.ModuleType("mxnet")
+    mx.nd = pytypes.SimpleNamespace(array=FakeNDArray)
+    mx.optimizer = pytypes.SimpleNamespace(Optimizer=FakeOptimizer)
+    mx.gluon = pytypes.SimpleNamespace(
+        Trainer=FakeTrainer,
+        parameter=pytypes.SimpleNamespace(
+            ParameterDict=FakeParameterDict,
+            DeferredInitializationError=DeferredInitializationError,
+        ),
+    )
+    saved_mx = sys.modules.get("mxnet")
+    saved_frontend = sys.modules.pop("horovod_tpu.mxnet", None)
+    sys.modules["mxnet"] = mx
+    try:
+        import horovod_tpu.mxnet as hvd_mx
+
+        yield hvd_mx
+    finally:
+        if saved_mx is not None:
+            sys.modules["mxnet"] = saved_mx
+        else:
+            sys.modules.pop("mxnet", None)
+        if saved_frontend is not None:
+            sys.modules["horovod_tpu.mxnet"] = saved_frontend
+        else:
+            sys.modules.pop("horovod_tpu.mxnet", None)
+
+
+class TestOps:
+    def test_allreduce_returns_ndarray(self, hvd, hvd_mx):
+        x = FakeNDArray([1.0, 2.0])
+        out = hvd_mx.allreduce(x, average=True)
+        assert isinstance(out, FakeNDArray)
+        np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0])  # size 1
+
+    def test_allreduce_inplace(self, hvd, hvd_mx):
+        x = FakeNDArray([3.0, 4.0])
+        ret = hvd_mx.allreduce_(x, average=False)
+        assert ret is x
+        np.testing.assert_allclose(x.asnumpy(), [3.0, 4.0])
+
+    def test_broadcast_inplace(self, hvd, hvd_mx):
+        x = FakeNDArray([5.0])
+        hvd_mx.broadcast_(x, root_rank=0)
+        np.testing.assert_allclose(x.asnumpy(), [5.0])
+
+
+class TestDistributedOptimizer:
+    def test_rescale_grad_divided_by_size(self, hvd, hvd_mx):
+        base = FakeOptimizer(rescale_grad=2.0)
+        opt = hvd_mx.DistributedOptimizer(base)
+        assert base.rescale_grad == pytest.approx(2.0 / hvd_mx.cross_size())
+
+    def test_update_delegates_and_reduces(self, hvd, hvd_mx):
+        base = FakeOptimizer(learning_rate=0.5, rescale_grad=1.0)
+        opt = hvd_mx.DistributedOptimizer(base)
+        w = FakeNDArray([1.0, 1.0])
+        g = FakeNDArray([0.2, 0.2])
+        opt.update(0, w, g, None)
+        assert base.updates and base.updates[0][0] == 0
+        np.testing.assert_allclose(w.asnumpy(), [0.9, 0.9])
+
+    def test_update_list_indices(self, hvd, hvd_mx):
+        base = FakeOptimizer()
+        opt = hvd_mx.DistributedOptimizer(base)
+        ws = [FakeNDArray([1.0]), FakeNDArray([2.0])]
+        gs = [FakeNDArray([0.1]), FakeNDArray([0.2])]
+        opt.update([0, 1], ws, gs, [None, None])
+        # FakeOptimizer.update receives the list as-is
+        assert base.updates[0][0] == [0, 1]
+
+    def test_getattr_delegation(self, hvd, hvd_mx):
+        base = FakeOptimizer(learning_rate=0.25)
+        opt = hvd_mx.DistributedOptimizer(base)
+        assert opt.learning_rate == 0.25
+        opt.set_learning_rate(0.5)
+        assert base.learning_rate == 0.5
+
+
+class TestDistributedTrainer:
+    def test_scale_divided_and_unwrap(self, hvd, hvd_mx):
+        base = FakeOptimizer()
+        wrapped = hvd_mx.DistributedOptimizer(base)
+        p = FakeParameter("w0", data=[1.0, 1.0, 1.0])
+        tr = hvd_mx.DistributedTrainer({"w0": p}, wrapped)
+        assert tr._optimizer is base  # unwrapped, reference behavior
+        assert tr._scale == pytest.approx(1.0 / hvd_mx.cross_size())
+        tr._allreduce_grads()  # size 1: no-op, must not raise
+
+
+class TestBroadcastParameters:
+    def test_dict_of_ndarrays(self, hvd, hvd_mx):
+        params = {"a": FakeNDArray([1.0]), "b": FakeNDArray([2.0])}
+        hvd_mx.broadcast_parameters(params)  # size 1: no-op
+
+    def test_parameter_dict_with_deferred_init(self, hvd, hvd_mx,
+                                               monkeypatch):
+        # Force the multi-worker path so the deferred hook is installed.
+        monkeypatch.setattr(hvd_mx, "cross_size", lambda: 2)
+        calls = []
+        monkeypatch.setattr(
+            hvd_mx, "broadcast_",
+            lambda t, root_rank=0, name=None: calls.append(name) or t)
+        ready = FakeParameter("w0", data=[1.0, 2.0, 3.0])
+        deferred = FakeParameter("w1")  # no data yet
+        pd = FakeParameterDict({"w0": ready, "w1": deferred})
+        hvd_mx.broadcast_parameters(pd, root_rank=0)
+        assert calls == ["param.0"]  # only the ready one broadcast now
+        # deferred param broadcasts as soon as init runs
+        deferred._init_impl()
+        assert len(calls) == 2
+        assert deferred._data is not None
+
+    def test_invalid_type_raises(self, hvd, hvd_mx):
+        monkey = lambda: 2
+        orig = hvd_mx.cross_size
+        hvd_mx.cross_size = monkey
+        try:
+            with pytest.raises(ValueError, match="invalid params"):
+                hvd_mx.broadcast_parameters([1, 2, 3])
+        finally:
+            hvd_mx.cross_size = orig

@@ -67,6 +67,58 @@ opt2 = torch.optim.Adam(model.parameters(), lr=1e-3)
 model(torch.randn(2, 4)).sum().backward()
 opt2.step()
 hvd.broadcast_optimizer_state(opt2, root_rank=0)
+# that backward also fired opt's hooks (they hang off the model's
+# parameters) — drain the in-flight handles before the next section
+opt.synchronize()
+
+# --- synchronize() + skip_synchronize() under gradient clipping ---------
+# (reference test_torch.py gradient-clipping idiom: synchronize manually,
+# clip the REDUCED gradients, then step inside skip_synchronize so the
+# optimizer doesn't re-reduce).
+# Re-align replicas first: the opt2 section above applied UN-reduced
+# local Adam grads (deliberately — it only tests state broadcast).
+hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+xb = torch.randn(8, 4) * (rank + 1)  # different data per rank
+yb = xb.sum(dim=1, keepdim=True)
+opt.zero_grad()
+torch.nn.functional.mse_loss(model(xb), yb).backward()
+opt.synchronize()
+clip_to = 1e-3
+total_norm = torch.nn.utils.clip_grad_norm_(model.parameters(), clip_to)
+before = torch.cat([p.detach().reshape(-1) for p in model.parameters()])
+with opt.skip_synchronize():
+    opt.step()
+after = torch.cat([p.detach().reshape(-1) for p in model.parameters()])
+# the applied update is the clipped gradient: ||delta|| <= lr * clip
+assert (after - before).norm() <= 0.05 * clip_to * 1.01 + 1e-8, \
+    (after - before).norm()
+# replicas still bit-identical (clipping happened on identical reduced
+# grads, skip_synchronize prevented a second reduction)
+flat = torch.cat([p.detach().reshape(-1) for p in model.parameters()])
+gathered = hvd.allgather(flat.unsqueeze(0))
+assert torch.allclose(gathered[0], gathered[1], atol=1e-6), \
+    (gathered[0] - gathered[1]).abs().max()
+
+# --- join() with uneven per-rank batch counts ---------------------------
+# (reference test_horovod_join_allreduce, test_torch.py:1540+): rank 0
+# exhausts its data first and joins; rank 1 keeps stepping — its
+# allreduces complete against rank 0's implicit zeros — then joins too.
+n_batches = 3 + 2 * rank
+torch.manual_seed(1000 + rank)
+for step in range(n_batches):
+    xb = torch.randn(8, 4)
+    yb = xb.sum(dim=1, keepdim=True)
+    opt.zero_grad()
+    torch.nn.functional.mse_loss(model(xb), yb).backward()
+    opt.step()
+hvd.join()
+# replicas diverged while rank 1 trained alone; re-align from the rank
+# that saw all its data (reference join examples re-broadcast after).
+hvd.broadcast_parameters(model.state_dict(), root_rank=nproc - 1)
+flat = torch.cat([p.detach().reshape(-1) for p in model.parameters()])
+gathered = hvd.allgather(flat.unsqueeze(0))
+assert torch.allclose(gathered[0], gathered[1], atol=1e-6), \
+    (gathered[0] - gathered[1]).abs().max()
 
 hvd.shutdown()
 print(f"TORCH-WORKER-OK rank={rank}")
