@@ -60,6 +60,123 @@ class MetricAverageCallback(tf.keras.callbacks.Callback):
                         name=f"metric.{k}.{epoch}"))
 
 
+class LearningRateScheduleCallback(tf.keras.callbacks.Callback):
+    """Multiply the optimizer's base LR by ``multiplier(epoch)`` within
+    ``[start_epoch, end_epoch)`` (reference ``_keras/callbacks.py:87-150``).
+
+    The base LR is read from the optimizer at train start, like the
+    reference.  ``staircase=False`` evaluates the multiplier per batch at
+    fractional epochs.  With ``momentum_correction`` (and a
+    momentum-carrying optimizer), the momentum is rescaled by
+    ``new_lr / old_lr`` for the batch where the LR changes, so the
+    accumulated velocity doesn't over/under-shoot at the new scale —
+    the reference's restore-momentum dance."""
+
+    def __init__(self, multiplier, start_epoch=0, end_epoch=None,
+                 staircase=True, momentum_correction=True,
+                 steps_per_epoch=None, initial_lr=None):
+        super().__init__()
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.momentum_correction = momentum_correction
+        self.steps_per_epoch = steps_per_epoch
+        self.initial_lr = initial_lr
+        self.current_epoch = 0
+        self.restore_momentum = None
+        self.multiplier = (multiplier if callable(multiplier)
+                           else (lambda epoch: multiplier))
+
+    def _lr_var(self):
+        return self.model.optimizer.learning_rate
+
+    def on_train_begin(self, logs=None):
+        if self.initial_lr is None:
+            self.initial_lr = float(
+                tf.keras.backend.get_value(self._lr_var()))
+
+    def _in_range(self, epoch):
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def _adjust(self, epoch):
+        if not self._in_range(epoch):
+            return
+        old_lr = float(tf.keras.backend.get_value(self._lr_var()))
+        new_lr = self.initial_lr * float(self.multiplier(epoch))
+        self._lr_var().assign(new_lr)
+        opt = self.model.optimizer
+        if (self.momentum_correction and old_lr > 0
+                and hasattr(opt, "momentum")
+                and self.restore_momentum is None):
+            m = float(tf.keras.backend.get_value(opt.momentum)) \
+                if not isinstance(opt.momentum, float) else opt.momentum
+            if m:
+                self.restore_momentum = m
+                self._set_momentum(m * new_lr / old_lr)
+
+    def _set_momentum(self, value):
+        opt = self.model.optimizer
+        if isinstance(opt.momentum, float):
+            opt.momentum = value
+        else:
+            opt.momentum.assign(value)
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase:
+            self._adjust(epoch)
+
+    def on_batch_begin(self, batch, logs=None):
+        if not self.staircase:
+            if self.steps_per_epoch is None:
+                raise ValueError(
+                    "steps_per_epoch is required when staircase=False")
+            self._adjust(self.current_epoch + batch / self.steps_per_epoch)
+
+    def on_batch_end(self, batch, logs=None):
+        if self.restore_momentum is not None:
+            self._set_momentum(self.restore_momentum)
+            self.restore_momentum = None
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = float(tf.keras.backend.get_value(self._lr_var()))
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual LR warmup from base LR to base LR × workers over
+    ``warmup_epochs`` (reference ``_keras/callbacks.py`` warmup; Goyal et
+    al. 2017 recipe cited there)."""
+
+    def __init__(self, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0, initial_lr=None):
+        from horovod_tpu import basics
+
+        self.warmup_epochs = warmup_epochs
+        self.verbose = verbose
+
+        def mult(epoch):
+            n = basics.size()
+            return 1.0 / n * (epoch * (n - 1) / warmup_epochs + 1)
+
+        super().__init__(multiplier=mult, start_epoch=0,
+                         end_epoch=warmup_epochs, staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch,
+                         initial_lr=initial_lr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        super().on_epoch_end(epoch, logs)
+        if epoch == self.end_epoch - 1 and self.verbose:
+            from horovod_tpu import basics
+
+            if basics.rank() == 0:
+                print(f"Epoch {epoch + 1}: finished gradual learning rate "
+                      f"warmup to {logs.get('lr') if logs else None}.")
+
+
 def load_model(filepath, custom_objects=None, compression=None):
     """Load a keras model and re-wrap its optimizer (reference
     keras/__init__.py:117-150)."""

@@ -157,3 +157,16 @@ def get() -> Optional[Timeline]:
     if basics.is_initialized():
         return basics._ctx().timeline
     return None
+
+
+@contextlib.contextmanager
+def trace(name: str, category: str = "host"):
+    """Nest a user-named span into the active timeline; no-op (zero
+    overhead beyond the lookup) when no timeline is recording — safe to
+    leave in production training loops."""
+    tl = get()
+    if tl is None:
+        yield
+        return
+    with tl.activity(name, category):
+        yield

@@ -203,6 +203,43 @@ class TestKerasShim:
             ])
         assert len(hist.history["loss"]) == 2
 
+    def test_lr_schedule_callback(self, hvd):
+        import horovod_tpu.keras as hvd_keras
+
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(1, input_shape=(3,))])
+        model.compile(optimizer=tf.keras.optimizers.SGD(0.1, momentum=0.9),
+                      loss="mse")
+        x = np.random.randn(32, 3).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True)
+        cb = hvd_keras.LearningRateScheduleCallback(
+            multiplier=lambda epoch: 0.5 ** epoch, staircase=True)
+        hist = model.fit(x, y, epochs=3, batch_size=16, verbose=0,
+                         callbacks=[cb])
+        # base LR read from the optimizer; epoch e runs at 0.1 * 0.5^e
+        lrs = hist.history["lr"]
+        np.testing.assert_allclose(lrs, [0.1, 0.05, 0.025], rtol=1e-5)
+        # momentum correction restored after the adjusting batch
+        assert abs(float(model.optimizer.momentum) - 0.9) < 1e-6
+
+    def test_lr_warmup_callback(self, hvd):
+        import horovod_tpu.keras as hvd_keras
+
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(1, input_shape=(3,))])
+        model.compile(optimizer=tf.keras.optimizers.SGD(0.1), loss="mse")
+        x = np.random.randn(64, 3).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True)
+        cb = hvd_keras.LearningRateWarmupCallback(
+            warmup_epochs=2, steps_per_epoch=4, verbose=0)
+        hist = model.fit(x, y, epochs=3, batch_size=16, verbose=0,
+                         callbacks=[cb])
+        # hvd.size() counts the 8 virtual chips: warmup ramps the LR from
+        # base/8 toward base*1 at epoch warmup_epochs, then leaves it.
+        lrs = hist.history["lr"]
+        assert lrs[0] < lrs[1] <= lrs[2] * (1 + 1e-6), lrs
+        assert abs(lrs[-1] - 0.1) / 0.1 < 0.25, lrs
+
     def test_load_model_rewraps(self, hvd, tmp_path):
         import horovod_tpu.keras as hvd_keras
 
