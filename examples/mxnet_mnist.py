@@ -1,0 +1,64 @@
+"""MXNet gluon distributed MNIST (reference ``examples/mxnet_mnist.py``):
+DistributedTrainer + broadcast_parameters over the shared eager data
+plane. Requires mxnet (not in this image — the frontend is verified
+against a mocked module in ``tests/test_mxnet_frontend.py``).
+
+    horovodrun -np 2 python examples/mxnet_mnist.py
+"""
+
+import numpy as np
+
+import mxnet as mx
+from mxnet import autograd, gluon
+
+import horovod_tpu.mxnet as hvd
+
+
+def synthetic_mnist(n=4096, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 1, 28, 28).astype(np.float32)
+    w = rng.randn(28 * 28, 10).astype(np.float32)
+    y = (x.reshape(n, -1) @ w).argmax(axis=1).astype(np.float32)
+    return x, y
+
+
+def main():
+    hvd.init()
+
+    x, y = synthetic_mnist()
+    x = x[hvd.cross_rank()::hvd.cross_size()]
+    y = y[hvd.cross_rank()::hvd.cross_size()]
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Conv2D(16, 3, activation="relu"),
+            gluon.nn.MaxPool2D(),
+            gluon.nn.Flatten(),
+            gluon.nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+
+    # params broadcast from rank 0 (deferred-init safe)
+    hvd.broadcast_parameters(net.collect_params(), root_rank=0)
+
+    opt_params = {"learning_rate": 0.01 * hvd.cross_size(), "momentum": 0.9}
+    trainer = hvd.DistributedTrainer(net.collect_params(), "sgd", opt_params)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    batch = 64
+    for epoch in range(3):
+        losses = []
+        for i in range(0, len(x) - batch, batch):
+            data = mx.nd.array(x[i:i + batch])
+            label = mx.nd.array(y[i:i + batch])
+            with autograd.record():
+                loss = loss_fn(net(data), label)
+            loss.backward()
+            trainer.step(batch)
+            losses.append(float(loss.mean().asscalar()))
+        avg = float(hvd.allreduce(mx.nd.array([np.mean(losses)]),
+                                  average=True).asscalar())
+        if hvd.cross_rank() == 0:
+            print(f"epoch {epoch}: loss {avg:.4f}")
+
+
+if __name__ == "__main__":
+    main()

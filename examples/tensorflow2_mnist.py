@@ -1,0 +1,67 @@
+"""TF2 eager distributed MNIST (reference ``examples/tensorflow2_mnist.py``):
+init -> shard data by rank -> DistributedGradientTape -> broadcast initial
+variables -> rank-0 checkpointing.
+
+    horovodrun -np 2 python examples/tensorflow2_mnist.py
+
+Uses a synthetic MNIST-shaped dataset so the example runs hermetically.
+"""
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def synthetic_mnist(n=4096, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 28, 28, 1).astype(np.float32)
+    w = rng.randn(28 * 28, 10).astype(np.float32)
+    y = (x.reshape(n, -1) @ w).argmax(axis=1).astype(np.int64)
+    return x, y
+
+
+def main():
+    hvd.init()
+
+    x, y = synthetic_mnist()
+    # shard by process rank (reference: dataset.shard(hvd.size(), hvd.rank()))
+    n = hvd.num_processes()
+    x, y = x[hvd.process_rank()::n], y[hvd.process_rank()::n]
+    dataset = (tf.data.Dataset.from_tensor_slices((x, y))
+               .shuffle(len(x), seed=1).batch(64).repeat())
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Conv2D(16, 3, activation="relu",
+                               input_shape=(28, 28, 1)),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(10),
+    ])
+    loss_obj = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+    # scale LR by the number of workers (reference recipe)
+    opt = tf.keras.optimizers.SGD(0.01 * hvd.num_processes())
+
+    checkpoint = tf.train.Checkpoint(model=model, optimizer=opt)
+
+    for step, (images, labels) in enumerate(dataset.take(200)):
+        with hvd.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = loss_obj(labels, model(images, training=True))
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+
+        if step == 0:
+            # after the first step so optimizer slots exist (reference
+            # BroadcastGlobalVariablesHook timing)
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
+
+        if step % 50 == 0 and hvd.process_rank() == 0:
+            print(f"step {step}: loss {float(loss):.4f}")
+
+    if hvd.process_rank() == 0:
+        checkpoint.save("/tmp/tf2_mnist_ckpt")
+
+
+if __name__ == "__main__":
+    main()

@@ -179,9 +179,25 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
 
 def load_model(filepath, custom_objects=None, compression=None):
     """Load a keras model and re-wrap its optimizer (reference
-    keras/__init__.py:117-150)."""
-    model = tf.keras.models.load_model(
-        filepath, custom_objects=custom_objects)
-    if model.optimizer is not None:
+    keras/__init__.py:117-150 + _keras/__init__.py:103-115).
+
+    Models saved while training carry the Distributed-wrapped optimizer
+    in their config (same class NAME as the base optimizer, our module
+    path); keras can only deserialize it if handed a matching class, so
+    every standard optimizer name maps to its wrapped subclass in
+    custom_objects — the reference's ``__subclasses__`` sweep."""
+    from horovod_tpu.tensorflow import distributed_optimizer_class
+
+    objs = dict(custom_objects or {})
+    for name in dir(tf.keras.optimizers):
+        cls = getattr(tf.keras.optimizers, name)
+        if (isinstance(cls, type)
+                and issubclass(cls, tf.keras.optimizers.Optimizer)
+                and cls is not tf.keras.optimizers.Optimizer):
+            objs.setdefault(name, distributed_optimizer_class(cls))
+    model = tf.keras.models.load_model(filepath, custom_objects=objs)
+    if model.optimizer is not None and not getattr(
+            model.optimizer, "_hvd_wrapped", False):
+        # saved from an unwrapped optimizer: wrap it now
         model.optimizer = DistributedOptimizer(model.optimizer)
     return model

@@ -1,10 +1,10 @@
 """TensorFlow frontend (reference: ``horovod/tensorflow/__init__.py``).
 
-TensorFlow is not part of this image, so the module import-gates: with TF
-installed the API below works (eager/tf.function TF2 style — TF tensors
-bridge through numpy into the shared eager path, exactly like the torch
-frontend); without TF, importing this module raises with a pointer to the
-JAX-native API.
+Import-gated on TF like the other framework shims: with TF installed the
+API below works (eager/tf.function TF2 style — TF tensors bridge through
+numpy into the shared eager path, exactly like the torch frontend);
+without TF, importing this module raises with a pointer to the JAX-native
+API.
 
 Provided (reference parity, tensorflow/__init__.py):
 ``allreduce`` (43-118), ``broadcast_variables`` (139-148),
@@ -29,7 +29,7 @@ import numpy as np
 
 from horovod_tpu.basics import (  # noqa: F401
     cross_rank, cross_size, init, is_initialized, local_rank, local_size,
-    rank, shutdown, size,
+    num_processes, process_rank, rank, shutdown, size,
 )
 from horovod_tpu.ops import collectives as C
 
@@ -179,14 +179,16 @@ class DistributedGradientTape(object):
         return out
 
 
-def DistributedOptimizer(optimizer, compression=None, op=Average,
-                         backward_passes_per_step=1):
-    """Wrap a keras optimizer so apply_gradients averages gradients
-    across workers first (reference factory, 410-471)."""
-
-    base_cls = optimizer.__class__
+def distributed_optimizer_class(base_cls, op=Average):
+    """Subclass ``base_cls`` so ``apply_gradients`` averages gradients
+    across workers first.  Keeps the base class's name so keras
+    (de)serialization round-trips — ``load_model`` resolves the saved
+    class through these wrappers (reference ``_keras/__init__.py:103-115``
+    custom-objects mechanism)."""
 
     class _Wrapped(base_cls):
+        _hvd_wrapped = True
+
         def apply_gradients(self, grads_and_vars, **kwargs):
             gv = list(grads_and_vars)
             arrs = [None if g is None else _to_np(
@@ -199,5 +201,12 @@ def DistributedOptimizer(optimizer, compression=None, op=Average,
             return super().apply_gradients(gv, **kwargs)
 
     _Wrapped.__name__ = base_cls.__name__
-    new = _Wrapped.from_config(optimizer.get_config())
-    return new
+    return _Wrapped
+
+
+def DistributedOptimizer(optimizer, compression=None, op=Average,
+                         backward_passes_per_step=1):
+    """Wrap a keras optimizer so apply_gradients averages gradients
+    across workers first (reference factory, 410-471)."""
+    cls = distributed_optimizer_class(optimizer.__class__, op=op)
+    return cls.from_config(optimizer.get_config())
