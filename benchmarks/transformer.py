@@ -1,0 +1,140 @@
+"""Transformer-LM training benchmark on the real chip: tokens/sec + MFU,
+with the attention implementation as the variable — XLA softmax attention
+vs the Pallas flash kernel (``horovod_tpu/ops/attention.py``).
+
+The reference has no LM benchmark (its headline is ResNet/Inception
+throughput, ``docs/benchmarks.rst``); this measures the framework's
+long-context extension the same way ``bench.py`` measures the DP path:
+synthetic data on device, warmup, median over timed iterations, MFU from
+XLA's cost analysis of the compiled step.
+
+Run:  python benchmarks/transformer.py [--seq 2048] [--attention flash]
+Prints one JSON line per configuration.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+PEAK_BY_KIND = {
+    "TPU v2": 46e12, "TPU v3": 123e12, "TPU v4": 275e12,
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--d-model", type=int, default=1024)
+    ap.add_argument("--n-layers", type=int, default=8)
+    ap.add_argument("--n-heads", type=int, default=16)
+    ap.add_argument("--d-ff", type=int, default=4096)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--attention", default="flash",
+                    choices=["reference", "flash"])
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize layers in backward (jax.checkpoint)")
+    ap.add_argument("--num-iters", type=int, default=5)
+    ap.add_argument("--steps-per-iter", type=int, default=5)
+    args = ap.parse_args()
+
+    import horovod_tpu as hvd
+    from horovod_tpu import spmd
+    from horovod_tpu.models import transformer as T
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    hvd.init()
+
+    cfg = T.TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
+        n_layers=args.n_layers, d_ff=args.d_ff, max_seq=args.seq,
+        attention_impl=args.attention, remat=args.remat,
+    )
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    opt = hvd.DistributedOptimizer(optax.adamw(3e-4))
+    opt_state = opt.init(params)
+
+    mesh, axis = hvd.mesh(), hvd.AXIS
+
+    def _step(params, opt_state, tokens):
+        batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+        loss, grads = jax.value_and_grad(
+            lambda p: T.loss_fn(p, batch, cfg))(params)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state,
+                jax.lax.pmean(loss, axis))
+
+    step = jax.jit(spmd.shard(
+        _step, in_specs=(P(), P(), P(axis)), out_specs=(P(), P(), P()),
+        mesh=mesh), donate_argnums=(0, 1))
+
+    n = hvd.size()
+    tokens = jax.device_put(
+        jnp.asarray(np.random.randint(
+            0, args.vocab, (args.batch_size * n, args.seq)), jnp.int32),
+        NamedSharding(mesh, P(axis)))
+
+    step = step.lower(params, opt_state, tokens).compile()
+    # Analytic FLOPs (XLA's cost analysis counts a lax.scan body ONCE, so
+    # it undercounts the per-layer work n_layers-fold): 6 x matmul-params
+    # x tokens for the dense path + causal attention scores, fwd+bwd.
+    n_matmul = sum(
+        int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params)
+    ) - int(np.prod(params["embed"].shape))  # embed lookup does no matmul
+    B, S = args.batch_size, args.seq
+    dense_flops = 6 * n_matmul * B * S
+    attn_flops = 6 * args.n_layers * B * S * S * args.d_model  # causal
+    # MFU convention (PaLM appendix B): model FLOPs only — remat's
+    # recompute is NOT counted, so --remat runs report the honest
+    # utilization of useful work.
+    step_flops = float(dense_flops + attn_flops)
+
+    kind = jax.devices()[0].device_kind
+    peak = next((v for k, v in PEAK_BY_KIND.items() if kind.startswith(k)),
+                None)
+
+    def _sync(x):
+        return float(np.asarray(jax.device_get(x)))
+
+    for _ in range(2):  # warmup
+        for _ in range(args.steps_per_iter):
+            params, opt_state, loss = step(params, opt_state, tokens)
+    _sync(loss)
+
+    times = []
+    for _ in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.steps_per_iter):
+            params, opt_state, loss = step(params, opt_state, tokens)
+        _sync(loss)
+        times.append((time.perf_counter() - t0) / args.steps_per_iter)
+
+    med = float(np.median(times))
+    tokens_per_step = args.batch_size * args.seq  # per chip
+    result = {
+        "metric": (f"TransformerLM d{args.d_model} L{args.n_layers} "
+                   f"seq{args.seq} {args.attention}-attention train "
+                   f"throughput per chip"),
+        "value": round(tokens_per_step / med, 1),
+        "unit": "tokens/sec/chip",
+        "median_step_s": round(med, 5),
+        "mfu": (round(step_flops / med / peak, 4) if peak and step_flops
+                else None),
+        "tflops_per_sec": (round(step_flops / med / 1e12, 1)
+                           if step_flops else None),
+        "chip": kind,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Distributed skip-gram word2vec (reference
+``examples/tensorflow_word2vec.py``): embedding training whose gradients
+are ``tf.IndexedSlices`` — they ride the SPARSE allreduce path
+(allgather of touched rows, ``docs/frontends.md``), so wire traffic
+scales with the batch's vocabulary slice, not the embedding table.
+
+    horovodrun -np 2 python examples/tensorflow_word2vec.py
+
+Synthetic corpus (Zipf-distributed token stream) so the example runs
+hermetically.
+"""
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+VOCAB = 2000
+DIM = 64
+WINDOW = 2
+
+
+def synthetic_corpus(n=100_000, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.zipf(1.3, n).clip(max=VOCAB - 1).astype(np.int64)
+
+
+def skipgram_batches(corpus, batch, seed):
+    rng = np.random.RandomState(seed)
+    while True:
+        centers = rng.randint(WINDOW, len(corpus) - WINDOW, batch)
+        offsets = rng.randint(1, WINDOW + 1, batch) * rng.choice([-1, 1], batch)
+        yield corpus[centers], corpus[centers + offsets]
+
+
+def main():
+    hvd.init()
+    rank, n = hvd.process_rank(), hvd.num_processes()
+
+    corpus = synthetic_corpus()
+    # shard the corpus by rank
+    corpus = corpus[rank::n]
+
+    emb = tf.Variable(tf.random.uniform([VOCAB, DIM], -0.05, 0.05, seed=3))
+    nce_w = tf.Variable(tf.zeros([VOCAB, DIM]))
+    opt = tf.keras.optimizers.SGD(0.5 * n)
+    hvd.broadcast_variables([emb, nce_w], root_rank=0)
+
+    batches = skipgram_batches(corpus, 256, seed=rank)
+    for step in range(200):
+        centers, contexts = next(batches)
+        negatives = np.random.RandomState(step).randint(0, VOCAB, (256, 5))
+        with tf.GradientTape() as tape:
+            h = tf.nn.embedding_lookup(emb, centers)          # sparse grad
+            pos = tf.nn.embedding_lookup(nce_w, contexts)
+            neg = tf.nn.embedding_lookup(nce_w, negatives)
+            pos_logit = tf.reduce_sum(h * pos, axis=1)
+            neg_logit = tf.einsum("bd,bkd->bk", h, neg)
+            loss = tf.reduce_mean(
+                tf.nn.sigmoid_cross_entropy_with_logits(
+                    tf.ones_like(pos_logit), pos_logit)
+                + tf.reduce_sum(tf.nn.sigmoid_cross_entropy_with_logits(
+                    tf.zeros_like(neg_logit), neg_logit), axis=1))
+        grads = tape.gradient(loss, [emb, nce_w])
+        # IndexedSlices -> sparse allreduce (allgather of touched rows)
+        grads = [hvd.allreduce(g, op=hvd.Average, name=f"w2v.g{i}")
+                 for i, g in enumerate(grads)]
+        opt.apply_gradients(zip(grads, [emb, nce_w]))
+        if step % 50 == 0 and rank == 0:
+            print(f"step {step}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

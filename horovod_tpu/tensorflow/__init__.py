@@ -41,9 +41,17 @@ def _to_np(t):
 
 
 def allreduce(tensor, average=None, op=None, name=None,
-              prescale_factor=1.0, postscale_factor=1.0):
+              prescale_factor=1.0, postscale_factor=1.0,
+              sparse_as_dense=False):
     """TF allreduce through the shared runtime (reference
-    tensorflow/__init__.py:43-118; IndexedSlices fall back to dense).
+    tensorflow/__init__.py:43-118).
+
+    ``tf.IndexedSlices`` (sparse embedding gradients) ride the
+    reference's sparse path by default — allgather of values and indices
+    (``tensorflow/__init__.py:74-89``), so the wire cost scales with the
+    touched rows, not the embedding table; ``sparse_as_dense=True``
+    densifies first (the reference's opt-in flag, useful when nearly all
+    rows are touched).
 
     Works eagerly AND inside ``tf.function``: under a function trace the
     op embeds as a ``tf.py_function`` bridging to the eager data plane,
@@ -54,7 +62,22 @@ def allreduce(tensor, average=None, op=None, name=None,
     if op is None:
         op = Average if (average is None or average) else Sum
     if isinstance(tensor, tf.IndexedSlices):
-        tensor = tf.convert_to_tensor(tensor)
+        if sparse_as_dense:
+            tensor = tf.convert_to_tensor(tensor)
+        else:
+            if op not in (Average, Sum):
+                raise NotImplementedError(
+                    "sparse allreduce supports Sum/Average (reference "
+                    "raises the same way for Adasum on IndexedSlices)")
+            nm = name or "sparse.allreduce"
+            values = tf.convert_to_tensor(
+                C.allgather(_to_np(tensor.values), name=f"{nm}.values"))
+            if op == Average:
+                values = values / cross_size()  # eager-path participants
+            indices = tf.convert_to_tensor(
+                C.allgather(_to_np(tensor.indices), name=f"{nm}.indices"))
+            return tf.IndexedSlices(values, indices,
+                                    dense_shape=tensor.dense_shape)
     if tf.inside_function():
         cname = name or "tf." + tensor.name.replace(":", ".")
 

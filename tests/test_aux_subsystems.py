@@ -368,6 +368,62 @@ class TestTFFunctionAllreduce:
         np.testing.assert_allclose(reduced.numpy(), [2.0, 4.0])
 
 
+class TestTFMultiProcess:
+    def test_two_process_tf(self, tmp_path):
+        import socket
+        import sys
+
+        from horovod_tpu.runner import launch
+        from horovod_tpu.runner.hosts import HostSpec
+
+        REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+        def free_port():
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                return s.getsockname()[1]
+
+        out = tmp_path / "out"
+        env = {
+            "PATH": os.environ.get("PATH", ""),
+            "REPO": REPO,
+            "PALLAS_AXON_POOL_IPS": "",
+            "HOROVOD_NUM_PROC": "2",
+            "HOROVOD_JAX_PORT": str(free_port()),
+            "HOROVOD_NATIVE_PORT": str(free_port()),
+        }
+        rc = launch.launch_job(
+            [sys.executable, os.path.join(REPO, "tests", "tf_worker.py")],
+            [HostSpec("localhost", 1)] * 2,
+            env=env,
+            output_filename=str(out),
+        )
+        assert rc == 0, (out / "rank.0.stderr").read_text() + (
+            out / "rank.1.stderr").read_text()
+        for r in (0, 1):
+            assert "TF-WORKER-OK" in (out / f"rank.{r}.stdout").read_text()
+
+
+class TestSparseAllreduce:
+    def test_indexed_slices_single_process(self, hvd):
+        import horovod_tpu.tensorflow as hvd_tf
+
+        slices = tf.IndexedSlices(
+            values=tf.ones([2, 3]), indices=tf.constant([0, 2], tf.int64),
+            dense_shape=tf.constant([4, 3], tf.int64))
+        red = hvd_tf.allreduce(slices, op=hvd_tf.Average)
+        assert isinstance(red, tf.IndexedSlices)
+        np.testing.assert_allclose(red.values.numpy(), np.ones((2, 3)))
+
+    def test_adasum_sparse_raises(self, hvd):
+        import horovod_tpu.tensorflow as hvd_tf
+
+        slices = tf.IndexedSlices(
+            values=tf.ones([1, 2]), indices=tf.constant([0], tf.int64))
+        with pytest.raises(NotImplementedError):
+            hvd_tf.allreduce(slices, op=hvd_tf.Adasum)
+
+
 class TestEstimatorPlatformResolution:
     def test_explicit_platform_passthrough(self):
         from horovod_tpu.estimator.estimator import (
