@@ -46,13 +46,16 @@ def main() -> None:
 
     import horovod_tpu as hvd
     from horovod_tpu import spmd
-    from horovod_tpu.models import resnet
+    from horovod_tpu.models import inception, resnet
 
     hvd.init()
 
-    model = resnet.create(args.model, num_classes=1000)
+    models_mod = inception if args.model == "InceptionV3" else resnet
+    if args.model == "InceptionV3" and args.image_size == 224:
+        args.image_size = 299  # Inception's native resolution
+    model = models_mod.create(args.model, num_classes=1000)
     rng = jax.random.PRNGKey(42)
-    variables = resnet.init_variables(model, rng, args.image_size, batch=2)
+    variables = models_mod.init_variables(model, rng, args.image_size, batch=2)
     params, batch_stats = variables["params"], variables["batch_stats"]
 
     compression = hvd.Compression.bf16 if args.fp16_allreduce else hvd.Compression.none

@@ -1,0 +1,190 @@
+"""Inception V3, TPU-first: NHWC, bfloat16 compute, fp32 BatchNorm
+statistics and head (same precision policy as :mod:`.resnet`).
+
+The reference benchmarks Inception V3 alongside ResNet-101 as its
+headline models (``docs/benchmarks.rst:13-14``); this is the standard
+architecture (Szegedy et al. 2015, "Rethinking the Inception
+Architecture") with the mixed blocks A/B/C/D/E and no aux head (the aux
+classifier is a training-era regularizer the benchmark protocol doesn't
+use).  Every branch concatenates on the channel (minor) axis, which is
+the TPU-friendly layout — XLA fuses the BN+relu epilogues into the
+convolutions per branch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class BasicConv(nn.Module):
+    """conv → BN → relu (the BasicConv2d everywhere in Inception)."""
+
+    features: int
+    kernel: tuple
+    strides: tuple = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not self.train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(BasicConv, dtype=self.dtype, train=self.train)
+        b1 = conv(64, (1, 1))(x)
+        b5 = conv(48, (1, 1))(x)
+        b5 = conv(64, (5, 5))(b5)
+        b3 = conv(64, (1, 1))(x)
+        b3 = conv(96, (3, 3))(b3)
+        b3 = conv(96, (3, 3))(b3)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = conv(self.pool_features, (1, 1))(bp)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """35x35 -> 17x17 grid reduction."""
+
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(BasicConv, dtype=self.dtype, train=self.train)
+        b3 = conv(384, (3, 3), (2, 2), padding="VALID")(x)
+        bd = conv(64, (1, 1))(x)
+        bd = conv(96, (3, 3))(bd)
+        bd = conv(96, (3, 3), (2, 2), padding="VALID")(bd)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """17x17 blocks with factorized 7x7 (1x7 + 7x1) convolutions."""
+
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(BasicConv, dtype=self.dtype, train=self.train)
+        c7 = self.channels_7x7
+        b1 = conv(192, (1, 1))(x)
+        b7 = conv(c7, (1, 1))(x)
+        b7 = conv(c7, (1, 7))(b7)
+        b7 = conv(192, (7, 1))(b7)
+        bd = conv(c7, (1, 1))(x)
+        bd = conv(c7, (7, 1))(bd)
+        bd = conv(c7, (1, 7))(bd)
+        bd = conv(c7, (7, 1))(bd)
+        bd = conv(192, (1, 7))(bd)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = conv(192, (1, 1))(bp)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """17x17 -> 8x8 grid reduction."""
+
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(BasicConv, dtype=self.dtype, train=self.train)
+        b3 = conv(192, (1, 1))(x)
+        b3 = conv(320, (3, 3), (2, 2), padding="VALID")(b3)
+        b7 = conv(192, (1, 1))(x)
+        b7 = conv(192, (1, 7))(b7)
+        b7 = conv(192, (7, 1))(b7)
+        b7 = conv(192, (3, 3), (2, 2), padding="VALID")(b7)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """8x8 blocks with split 1x3 / 3x1 branches."""
+
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        conv = partial(BasicConv, dtype=self.dtype, train=self.train)
+        b1 = conv(320, (1, 1))(x)
+        b3 = conv(384, (1, 1))(x)
+        b3 = jnp.concatenate(
+            [conv(384, (1, 3))(b3), conv(384, (3, 1))(b3)], axis=-1)
+        bd = conv(448, (1, 1))(x)
+        bd = conv(384, (3, 3))(bd)
+        bd = jnp.concatenate(
+            [conv(384, (1, 3))(bd), conv(384, (3, 1))(bd)], axis=-1)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = conv(192, (1, 1))(bp)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(BasicConv, dtype=self.dtype, train=train)
+        x = x.astype(self.dtype)
+        # stem: 299x299x3 -> 35x35x192
+        x = conv(32, (3, 3), (2, 2), padding="VALID")(x)
+        x = conv(32, (3, 3), padding="VALID")(x)
+        x = conv(64, (3, 3))(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = conv(80, (1, 1), padding="VALID")(x)
+        x = conv(192, (3, 3), padding="VALID")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # 35x35
+        x = InceptionA(32, dtype=self.dtype, train=train)(x)
+        x = InceptionA(64, dtype=self.dtype, train=train)(x)
+        x = InceptionA(64, dtype=self.dtype, train=train)(x)
+        x = InceptionB(dtype=self.dtype, train=train)(x)
+        # 17x17
+        x = InceptionC(128, dtype=self.dtype, train=train)(x)
+        x = InceptionC(160, dtype=self.dtype, train=train)(x)
+        x = InceptionC(160, dtype=self.dtype, train=train)(x)
+        x = InceptionC(192, dtype=self.dtype, train=train)(x)
+        x = InceptionD(dtype=self.dtype, train=train)(x)
+        # 8x8
+        x = InceptionE(dtype=self.dtype, train=train)(x)
+        x = InceptionE(dtype=self.dtype, train=train)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def create(name: str = "InceptionV3", num_classes: int = 1000,
+           dtype=jnp.bfloat16):
+    assert name == "InceptionV3", name
+    return InceptionV3(num_classes=num_classes, dtype=dtype)
+
+
+def init_variables(model, rng, image_size: int = 299, batch: int = 2):
+    return jax.jit(model.init, static_argnames="train")(
+        rng, jnp.zeros((batch, image_size, image_size, 3), jnp.float32),
+        train=True)
