@@ -183,7 +183,7 @@ def _attention(x, p, cfg: TransformerConfig):
     k = jnp.einsum("bsd,dhk->bshk", x, p["wk"].astype(cfg.dtype))
     v = jnp.einsum("bsd,dhk->bshk", x, p["wv"].astype(cfg.dtype))
     pos_offset = 0
-    if cfg.attention_impl == "ring":
+    if cfg.attention_impl in ("ring", "ulysses"):
         # Sequence is sharded over sp: this shard's tokens start at
         # sp_index * S_local in the global sequence.
         pos_offset = lax.axis_index("sp") * S
@@ -195,6 +195,8 @@ def _attention(x, p, cfg: TransformerConfig):
     vh = jnp.moveaxis(v, 2, 1)
     if cfg.attention_impl == "ring":
         oh = attn.ring_attention(qh, kh, vh, axis_name="sp", causal=True)
+    elif cfg.attention_impl == "ulysses":
+        oh = attn.ulysses_attention(qh, kh, vh, axis_name="sp", causal=True)
     elif cfg.attention_impl == "flash":
         oh = attn.flash_attention(qh, kh, vh, True)
     elif cfg.attention_impl == "reference":
@@ -202,7 +204,7 @@ def _attention(x, p, cfg: TransformerConfig):
     else:
         raise ValueError(
             f"unknown attention_impl {cfg.attention_impl!r}; expected "
-            "'reference', 'flash' or 'ring'")
+            "'reference', 'flash', 'ring' or 'ulysses'")
     o = jnp.moveaxis(oh, 1, 2).astype(cfg.dtype)  # (B, S, H, Dh)
     return jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(cfg.dtype))
 

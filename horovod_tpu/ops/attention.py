@@ -22,6 +22,14 @@ Layout convention: ``(batch, heads, seq, head_dim)`` f32/bf16.
   Q accumulates partial attention, merged by logsumexp weighting.  Causal
   masking degrades gracefully: a fully-masked chunk contributes weight
   exp(-1e30 - lse) == 0.
+* :func:`ulysses_attention` — the all-to-all flavor of sequence
+  parallelism (DeepSpeed-Ulysses pattern): one ``lax.all_to_all``
+  reshards from sequence-sharded to head-sharded, every device computes
+  FULL-sequence attention for its head subset (so the flash kernel and
+  plain causal masking apply unchanged), and a second all-to-all reshards
+  back.  Two collectives per attention instead of P ppermute rounds —
+  cheaper when heads divide evenly over the axis and the ICI all-to-all
+  bandwidth is good; ring wins when S_local is huge and overlap matters.
 """
 
 from __future__ import annotations
@@ -321,3 +329,40 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
     lse0 = q[..., 0].astype(jnp.float32) * 0.0 + NEG_INF
     (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(P))
     return o.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
+                      sm_scale: Optional[float] = None,
+                      impl: str = "reference"):
+    """All-to-all sequence parallelism inside ``shard_map`` (the
+    DeepSpeed-Ulysses pattern; SURVEY.md §5.7 lists it as the alltoall
+    resharding flavor of context parallelism).
+
+    Every device holds a sequence shard ``(B, H, S_local, D)``.  One
+    ``lax.all_to_all`` redistributes to ``(B, H/P, S_global, D)`` — full
+    sequence, head subset — so local attention (including the Pallas
+    flash kernel via ``impl="flash"``, and ordinary causal masking) runs
+    unchanged; the inverse all_to_all restores sequence sharding.
+    Requires ``H %% axis_size == 0``.  Differentiable end-to-end: the VJP
+    of ``all_to_all`` is the transposed all_to_all.
+    """
+    P = lax.axis_size(axis_name)
+    B, H, S, D = q.shape
+    if H % P != 0:
+        raise ValueError(
+            f"ulysses_attention needs heads ({H}) divisible by the "
+            f"'{axis_name}' axis size ({P}); use ring_attention otherwise")
+
+    def seq_to_heads(x):  # (B,H,S_local,D) -> (B,H/P,S_global,D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if impl == "flash":
+        oh = flash_attention(qh, kh, vh, causal, sm_scale=sm_scale)
+    else:
+        oh = reference_attention(qh, kh, vh, causal=causal,
+                                 sm_scale=sm_scale)
+    # (B,H/P,S_global,D) -> (B,H,S_local,D)
+    return lax.all_to_all(oh, axis_name, split_axis=2, concat_axis=1,
+                          tiled=True)

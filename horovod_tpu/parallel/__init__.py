@@ -11,4 +11,7 @@ parallelism (SURVEY.md §2.6: TP/PP/SP/EP are extensions, not ports).
 """
 
 from horovod_tpu.parallel.meshes import MeshSpec, make_mesh  # noqa: F401
-from horovod_tpu.ops.attention import ring_attention  # noqa: F401
+from horovod_tpu.ops.attention import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+)

@@ -143,6 +143,64 @@ class TestRingAttention:
         assert np.isfinite(np.asarray(out)).all()
 
 
+class TestUlyssesAttention:
+    def _run(self, q, k, v, causal, impl="reference"):
+        def inner(qs, ks, vs):
+            return A.ulysses_attention(qs, ks, vs, axis_name=hvd.AXIS,
+                                       causal=causal, impl=impl)
+
+        f = spmd.shard(
+            inner,
+            in_specs=(P(None, None, hvd.AXIS, None),) * 3,
+            out_specs=P(None, None, hvd.AXIS, None),
+        )
+        return jax.jit(f)(q, k, v)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, causal):
+        # heads divisible by the 8-device axis
+        q, k, v = _qkv(b=1, h=N, s=N * 8, d=32)
+        out = self._run(q, k, v, causal)
+        ref = A.reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_flash_inner_matches(self):
+        q, k, v = _qkv(b=1, h=N, s=N * 16, d=32)
+        out = self._run(q, k, v, True, impl="flash")
+        ref = A.reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-3, rtol=2e-3)
+
+    def test_differentiable(self):
+        q, k, v = _qkv(b=1, h=N, s=N * 4, d=16)
+
+        def loss(q, k, v):
+            def inner(qs, ks, vs):
+                return A.ulysses_attention(qs, ks, vs, axis_name=hvd.AXIS,
+                                           causal=True)
+            f = spmd.shard(
+                inner,
+                in_specs=(P(None, None, hvd.AXIS, None),) * 3,
+                out_specs=P(None, None, hvd.AXIS, None),
+            )
+            return jnp.sum(f(q, k, v) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(A.reference_attention(q, k, v, causal=True) ** 2)
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-3, rtol=1e-3, err_msg=name)
+
+    def test_indivisible_heads_raise(self):
+        q, k, v = _qkv(b=1, h=3, s=N * 2, d=16)
+        with pytest.raises(Exception, match="divisible|ring_attention"):
+            self._run(q, k, v, False)
+
+
 class TestTransformerIntegration:
     """attention_impl config: flash and ring must match the reference
     implementation through the full model forward."""
@@ -183,6 +241,35 @@ class TestTransformerIntegration:
 
         def inner(params, tokens):
             return T.forward(params, tokens, cfg_ring)
+
+        f = jax.jit(jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(), P(None, "sp")),
+            out_specs=P(None, "sp"),
+        ))
+        out = f(params, tokens)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_ulysses_matches_reference_forward(self):
+        """alltoall sequence-parallel forward over sp == full-sequence
+        reference forward (needs heads % sp == 0)."""
+        import dataclasses
+
+        from horovod_tpu.models import transformer as T
+        from jax.sharding import Mesh
+
+        cfg_ref = dataclasses.replace(self._cfg("reference"), n_heads=N)
+        cfg_uly = dataclasses.replace(cfg_ref, attention_impl="ulysses")
+        params = T.init_params(jax.random.PRNGKey(0), cfg_ref)
+        S = 64
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, S), 0, 64)
+        ref = T.forward(params, tokens, cfg_ref)
+
+        mesh = Mesh(np.array(jax.devices()[:N]), axis_names=("sp",))
+
+        def inner(params, tokens):
+            return T.forward(params, tokens, cfg_uly)
 
         f = jax.jit(jax.shard_map(
             inner, mesh=mesh,
