@@ -7,3 +7,6 @@ Reference: ``horovod/run/run.py:395-960``, ``run/gloo_run.py``,
 
 from horovod_tpu.runner.hosts import HostSpec, SlotInfo, allocate, parse_hosts  # noqa: F401
 from horovod_tpu.runner.launch import launch_job  # noqa: F401
+from horovod_tpu.runner.run_func import run  # noqa: F401 — the
+# programmatic API (reference ``horovod.run.run()``):
+# runner.run(fn, args, num_proc=N) -> per-rank results.

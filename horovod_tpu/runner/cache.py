@@ -1,0 +1,55 @@
+"""Launcher check cache (reference ``horovod/run/util/cache.py``: a
+``~/.horovod`` JSON cache that remembers expensive pre-flight results —
+ssh reachability, build checks — so repeated launches skip them).
+
+Entries carry timestamps and expire after ``ttl_seconds``; corrupt or
+unreadable cache files are treated as empty, never fatal (a cache must
+not be able to break a launch).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Optional
+
+DEFAULT_PATH = os.path.join("~", ".horovod_tpu", "cache.json")
+DEFAULT_TTL = 60 * 60  # reference uses a fixed per-parameter cache; 1h here
+
+
+class Cache:
+    def __init__(self, path: Optional[str] = None,
+                 ttl_seconds: float = DEFAULT_TTL) -> None:
+        # DEFAULT_PATH read at call time so tests (and users) can point
+        # the module-level default elsewhere.
+        self.path = os.path.expanduser(path or DEFAULT_PATH)
+        self.ttl = ttl_seconds
+
+    def _load(self) -> dict:
+        try:
+            with open(self.path) as f:
+                data = json.load(f)
+            return data if isinstance(data, dict) else {}
+        except Exception:
+            return {}
+
+    def get(self, key: str) -> Optional[Any]:
+        ent = self._load().get(key)
+        if not isinstance(ent, dict):
+            return None
+        if time.time() - float(ent.get("ts", 0)) > self.ttl:
+            return None
+        return ent.get("value")
+
+    def put(self, key: str, value: Any) -> None:
+        data = self._load()
+        data[key] = {"value": value, "ts": time.time()}
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(data, f)
+            os.replace(tmp, self.path)
+        except Exception:
+            pass  # never let the cache break a launch

@@ -65,6 +65,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     p.add_argument("--config-file", dest="config_file", default=None)
     p.add_argument("--start-port", type=int, dest="start_port", default=0,
                    help="rendezvous port (0 = ephemeral)")
+    p.add_argument("--disable-cache", action="store_true",
+                   dest="disable_cache",
+                   help="re-run pre-flight checks (ssh reachability) "
+                        "instead of using cached results")
 
     tune = p.add_argument_group("tuneable parameter arguments")
     tune.add_argument("--fusion-threshold-mb", type=float, action=_RecordStore,
@@ -116,6 +120,51 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     return args
 
 
+def check_hosts_ssh(hostnames, timeout: float = 15.0,
+                    use_cache: bool = True) -> None:
+    """Pre-flight: every remote host must accept a non-interactive ssh
+    (reference ``_check_all_hosts_ssh_successful``, ``run/run.py:63-116``
+    — run in parallel, fail fast naming the unreachable hosts).
+    Successes are remembered in the launcher cache
+    (:mod:`horovod_tpu.runner.cache`) so repeated launches skip the
+    round-trips, like the reference's ``~/.horovod`` cache."""
+    import concurrent.futures
+    import shlex
+    import subprocess
+
+    from horovod_tpu.runner import cache as cache_mod
+    from horovod_tpu.runner.launch import SSH_COMMAND_PREFIX, _is_local
+
+    remote = sorted({h for h in hostnames if not _is_local(h)})
+    c = cache_mod.Cache()
+    if use_cache:
+        remote = [h for h in remote if c.get(f"ssh.{h}") != "ok"]
+    if not remote:
+        return
+
+    def probe(host):
+        try:
+            r = subprocess.run(
+                shlex.split(SSH_COMMAND_PREFIX) + [host, "true"],
+                capture_output=True, timeout=timeout)
+            return host, r.returncode == 0
+        except Exception:
+            return host, False
+
+    with concurrent.futures.ThreadPoolExecutor(len(remote)) as ex:
+        results = list(ex.map(probe, remote))
+    failed = [h for h, ok in results if not ok]
+    if failed:
+        raise SystemExit(
+            "horovodrun: non-interactive ssh failed for host(s): "
+            + ", ".join(failed)
+            + " — ensure passwordless ssh (key-based) works to every host")
+    if use_cache:
+        for h, ok in results:
+            if ok:
+                c.put(f"ssh.{h}", "ok")
+
+
 def _run(args: argparse.Namespace) -> int:
     if args.version:
         import horovod_tpu
@@ -139,6 +188,8 @@ def _run(args: argparse.Namespace) -> int:
             host_specs = host_specs * args.np
     env = dict(os.environ)
     config_parser.set_env_from_args(env, args)
+    check_hosts_ssh([h.hostname for h in host_specs],
+                    use_cache=not args.disable_cache)
     if args.verbose:
         print(f"horovodrun: launching on {len(host_specs)} host(s)")
     return launch_job(

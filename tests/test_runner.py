@@ -116,6 +116,90 @@ class TestArgsAndConfig:
             _run(parse_args(["-np", "1"]))
 
 
+class TestSshPreflight:
+    def test_local_hosts_skip_probe(self, monkeypatch):
+        from horovod_tpu.runner import run as run_mod
+
+        import subprocess
+
+        def boom(*a, **k):
+            raise AssertionError("must not probe local hosts")
+
+        monkeypatch.setattr(subprocess, "run", boom)
+        run_mod.check_hosts_ssh(["localhost", "127.0.0.1"])  # no raise
+
+    def test_unreachable_host_fails_fast(self, monkeypatch, tmp_path):
+        from horovod_tpu.runner import cache as cache_mod
+        from horovod_tpu.runner import run as run_mod
+
+        import subprocess
+
+        monkeypatch.setattr(cache_mod, "DEFAULT_PATH",
+                            str(tmp_path / "cache.json"))
+
+        class R:
+            returncode = 255
+
+        calls = []
+
+        def fake_run(cmd, **k):
+            calls.append(cmd)
+            return R()
+
+        monkeypatch.setattr(subprocess, "run", fake_run)
+        with pytest.raises(SystemExit, match="badhost"):
+            run_mod.check_hosts_ssh(["badhost", "localhost"])
+        assert len(calls) == 1  # only the remote host probed
+
+    def test_success_cached(self, monkeypatch, tmp_path):
+        from horovod_tpu.runner import cache as cache_mod
+        from horovod_tpu.runner import run as run_mod
+
+        import subprocess
+
+        monkeypatch.setattr(cache_mod, "DEFAULT_PATH",
+                            str(tmp_path / "cache.json"))
+
+        class R:
+            returncode = 0
+
+        calls = []
+
+        def fake_run(cmd, **k):
+            calls.append(cmd)
+            return R()
+
+        monkeypatch.setattr(subprocess, "run", fake_run)
+        run_mod.check_hosts_ssh(["far1", "far2"])
+        assert len(calls) == 2
+        run_mod.check_hosts_ssh(["far1", "far2"])  # cache hit: no probes
+        assert len(calls) == 2
+        run_mod.check_hosts_ssh(["far1"], use_cache=False)  # forced
+        assert len(calls) == 3
+
+
+class TestCache:
+    def test_roundtrip_and_ttl(self, tmp_path):
+        from horovod_tpu.runner.cache import Cache
+
+        c = Cache(str(tmp_path / "c.json"), ttl_seconds=1000)
+        assert c.get("k") is None
+        c.put("k", {"a": 1})
+        assert c.get("k") == {"a": 1}
+        expired = Cache(str(tmp_path / "c.json"), ttl_seconds=0)
+        assert expired.get("k") is None
+
+    def test_corrupt_file_is_empty(self, tmp_path):
+        from horovod_tpu.runner.cache import Cache
+
+        p = tmp_path / "c.json"
+        p.write_text("{not json")
+        c = Cache(str(p))
+        assert c.get("k") is None
+        c.put("k", 1)  # must not raise
+        assert c.get("k") == 1
+
+
 class TestRendezvous:
     def test_kv_roundtrip(self):
         server = rendezvous.RendezvousServer()
