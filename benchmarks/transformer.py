@@ -43,6 +43,8 @@ def main() -> None:
                     choices=["reference", "flash"])
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize layers in backward (jax.checkpoint)")
+    ap.add_argument("--n-experts", type=int, default=0,
+                    help="MoE experts per layer (0 = dense MLP)")
     ap.add_argument("--num-iters", type=int, default=5)
     ap.add_argument("--steps-per-iter", type=int, default=5)
     args = ap.parse_args()
@@ -58,6 +60,7 @@ def main() -> None:
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_seq=args.seq,
         attention_impl=args.attention, remat=args.remat,
+        n_experts=args.n_experts,
     )
     params = T.init_params(jax.random.PRNGKey(0), cfg)
     opt = hvd.DistributedOptimizer(optax.adamw(3e-4))
@@ -122,7 +125,9 @@ def main() -> None:
     tokens_per_step = args.batch_size * args.seq  # per chip
     result = {
         "metric": (f"TransformerLM d{args.d_model} L{args.n_layers} "
-                   f"seq{args.seq} {args.attention}-attention train "
+                   f"seq{args.seq}"
+                   + (f" moe{args.n_experts}" if args.n_experts > 1 else "")
+                   + f" {args.attention}-attention train "
                    f"throughput per chip"),
         "value": round(tokens_per_step / med, 1),
         "unit": "tokens/sec/chip",

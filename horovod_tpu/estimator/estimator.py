@@ -34,6 +34,11 @@ class EstimatorParams:
     seed: int = 0
     run_id: Optional[str] = None
     verbose: int = 0
+    # Held-out fraction in [0, 1) evaluated each epoch (reference
+    # EstimatorParams.validation, ``spark/common/params.py:52-53`` —
+    # the float-split flavor; the column-name flavor is DataFrame
+    # machinery this numpy data path doesn't have).
+    validation: Optional[float] = None
     # JAX platform pinned in worker ranks.  "auto" (default) trains on
     # TPU when a single worker process can own the visible chips
     # (num_proc == 1) and falls back to CPU otherwise — the launcher does
@@ -86,6 +91,23 @@ def _probe_tpu_available() -> bool:
             return False
         _probe_result["tpu"] = proc.returncode == 0
     return _probe_result["tpu"]
+
+
+def _split_validation(x: np.ndarray, y: np.ndarray, validation, seed: int):
+    """Deterministic shuffled train/val split (reference
+    ``util.py:_train_val_split``); returns (x, y, xv, yv) with the val
+    pair None when no validation was requested."""
+    if not validation:
+        return x, y, None, None
+    frac = float(validation)
+    if not 0.0 < frac < 1.0:
+        raise ValueError(f"validation must be in (0, 1), got {validation}")
+    idx = np.random.RandomState(seed).permutation(len(x))
+    n_val = max(int(len(x) * frac), 1)
+    val, tr = idx[:n_val], idx[n_val:]
+    if len(tr) == 0:
+        raise ValueError("validation split leaves no training rows")
+    return x[tr], y[tr], x[val], y[val]
 
 
 def _steps_per_epoch(n_total: int, num_proc: int, batch_size: int) -> int:
@@ -151,7 +173,13 @@ def _jax_train_fn(store, run_id, spec, num_proc):
     # its peers never match (the steady-state ordering contract).  The
     # global min is computable locally from (n_total, num_proc, bs).
     steps = _steps_per_epoch(spec["n_total"], num_proc, bs)
+    xv = yv = None
+    if spec.get("n_val"):
+        vshard = store.load_arrays(store.get_val_data_path(str(rank)))
+        xv, yv = vshard["x"], vshard["y"]
+        val_loss_fn = jax.jit(lambda p, xb, yb: loss_fn(p, xb, yb))
     history: List[float] = []
+    val_history: List[float] = []
     for epoch in range(spec["epochs"]):
         idx = rng.permutation(len(x)) if spec["shuffle"] else np.arange(len(x))
         losses = []
@@ -162,11 +190,20 @@ def _jax_train_fn(store, run_id, spec, num_proc):
         # epoch metric averaged across ranks (MetricAverageCallback role)
         history.append(float(np.mean(hvd.allreduce(
             np.asarray(losses, np.float32), hvd.Average))))
+        if xv is not None:
+            # row-weighted global mean: shards differ by up to one row
+            part = np.asarray([
+                float(val_loss_fn(params, xv, yv)) * len(xv),
+                float(len(xv)),
+            ], np.float32)
+            tot = hvd.allreduce(part, hvd.Sum, name=f"val.{epoch}")
+            val_history.append(float(tot[0] / tot[1]))
 
     if rank == 0:
         store.save_obj(store.get_checkpoint_path(run_id),
                        {"params": jax.device_get(params),
-                        "history": history})
+                        "history": history,
+                        "val_history": val_history})
     hvd.barrier()
     return history
 
@@ -196,12 +233,18 @@ class JaxEstimator:
 
         p = self.params
         run_id = p.run_id or f"run_{uuid.uuid4().hex[:8]}"
-        shards = shard_arrays({"x": np.asarray(x), "y": np.asarray(y)},
-                              p.num_proc)
+        x, y, xv, yv = _split_validation(
+            np.asarray(x), np.asarray(y), p.validation, p.seed)
         remote_store = self.store.to_remote()
-        for r, shard in enumerate(shards):
+        for r, shard in enumerate(shard_arrays({"x": x, "y": y},
+                                               p.num_proc)):
             remote_store.save_arrays(
                 remote_store.get_train_data_path(str(r)), shard)
+        if xv is not None:
+            for r, shard in enumerate(shard_arrays({"x": xv, "y": yv},
+                                                   p.num_proc)):
+                remote_store.save_arrays(
+                    remote_store.get_val_data_path(str(r)), shard)
 
         spec = {
             "loss_fn": self.loss_fn,
@@ -212,6 +255,7 @@ class JaxEstimator:
             "shuffle": p.shuffle,
             "seed": p.seed,
             "n_total": len(x),
+            "n_val": 0 if xv is None else len(xv),
         }
         run_func.run(
             _jax_train_fn, (remote_store, run_id, spec, p.num_proc),
@@ -219,7 +263,9 @@ class JaxEstimator:
         )
         ckpt = remote_store.load_obj(remote_store.get_checkpoint_path(run_id))
         return JaxModel(model_fn=self.model_fn, params=ckpt["params"],
-                        history=ckpt["history"], run_id=run_id)
+                        history=ckpt["history"],
+                        val_history=ckpt.get("val_history", []),
+                        run_id=run_id)
 
 
 @dataclass(eq=False)  # auto __eq__ over array fields raises on compare
@@ -229,6 +275,7 @@ class JaxModel:
     model_fn: Callable
     params: Any
     history: List[float] = field(default_factory=list)
+    val_history: List[float] = field(default_factory=list)
     run_id: str = ""
 
     def predict(self, x: np.ndarray) -> np.ndarray:
@@ -269,7 +316,13 @@ def _torch_train_fn(store, run_id, spec, num_proc):
     g = torch.Generator().manual_seed(spec["seed"] + rank)
     bs = spec["batch_size"]
     steps = _steps_per_epoch(spec["n_total"], num_proc, bs)
+    xv = yv = None
+    if spec.get("n_val"):
+        vshard = store.load_arrays(store.get_val_data_path(str(rank)))
+        xv = torch.from_numpy(vshard["x"]).float()
+        yv = torch.from_numpy(vshard["y"]).float()
     history = []
+    val_history = []
     for epoch in range(spec["epochs"]):
         idx = (torch.randperm(len(x), generator=g) if spec["shuffle"]
                else torch.arange(len(x)))
@@ -283,11 +336,19 @@ def _torch_train_fn(store, run_id, spec, num_proc):
             losses.append(float(loss.detach()))
         avg = hvd.allreduce(torch.tensor(np.mean(losses)), op=hvd.Average)
         history.append(float(avg))
+        if xv is not None:
+            with torch.no_grad():
+                vloss = float(loss_fn(model(xv), yv)) * len(xv)
+            part = hvd.allreduce(
+                torch.tensor([vloss, float(len(xv))]), op=hvd.Sum,
+                name=f"val.{epoch}")
+            val_history.append(float(part[0] / part[1]))
 
     if rank == 0:
         store.save_obj(store.get_checkpoint_path(run_id),
                        {"state_dict": model.state_dict(),
-                        "history": history})
+                        "history": history,
+                        "val_history": val_history})
     return history
 
 
@@ -310,12 +371,18 @@ class TorchEstimator:
 
         p = self.params
         run_id = p.run_id or f"run_{uuid.uuid4().hex[:8]}"
-        shards = shard_arrays({"x": np.asarray(x), "y": np.asarray(y)},
-                              p.num_proc)
+        x, y, xv, yv = _split_validation(
+            np.asarray(x), np.asarray(y), p.validation, p.seed)
         remote_store = self.store.to_remote()
-        for r, shard in enumerate(shards):
+        for r, shard in enumerate(shard_arrays({"x": x, "y": y},
+                                               p.num_proc)):
             remote_store.save_arrays(
                 remote_store.get_train_data_path(str(r)), shard)
+        if xv is not None:
+            for r, shard in enumerate(shard_arrays({"x": xv, "y": yv},
+                                                   p.num_proc)):
+                remote_store.save_arrays(
+                    remote_store.get_val_data_path(str(r)), shard)
         spec = {
             "model_factory": self.model_factory,
             "optimizer_factory": self.optimizer_factory,
@@ -325,6 +392,7 @@ class TorchEstimator:
             "shuffle": p.shuffle,
             "seed": p.seed,
             "n_total": len(x),
+            "n_val": 0 if xv is None else len(xv),
         }
         run_func.run(
             _torch_train_fn, (remote_store, run_id, spec, p.num_proc),
@@ -333,13 +401,16 @@ class TorchEstimator:
         ckpt = remote_store.load_obj(remote_store.get_checkpoint_path(run_id))
         model = self.model_factory()
         model.load_state_dict(ckpt["state_dict"])
-        return TorchModel(model=model, history=ckpt["history"], run_id=run_id)
+        return TorchModel(model=model, history=ckpt["history"],
+                          val_history=ckpt.get("val_history", []),
+                          run_id=run_id)
 
 
 @dataclass(eq=False)
 class TorchModel:
     model: Any
     history: List[float] = field(default_factory=list)
+    val_history: List[float] = field(default_factory=list)
     run_id: str = ""
 
     def predict(self, x: np.ndarray) -> np.ndarray:

@@ -33,7 +33,7 @@ import tensorflow  # noqa: F401 — real import gate: this module's surface
 import numpy as np
 
 from horovod_tpu.estimator.estimator import (
-    EstimatorParams, _steps_per_epoch, resolve_platform,
+    EstimatorParams, _split_validation, _steps_per_epoch, resolve_platform,
 )
 from horovod_tpu.estimator.store import Store, shard_arrays
 
@@ -96,6 +96,10 @@ def _keras_train_fn(store, run_id, spec, num_proc):
 
     bs = spec["batch_size"]
     steps = _steps_per_epoch(spec["n_total"], num_proc, bs)
+    val_kwargs = {}
+    if spec.get("n_val"):
+        vshard = store.load_arrays(store.get_val_data_path(str(rank)))
+        val_kwargs = {"validation_data": (vshard["x"], vshard["y"])}
     history = model.fit(
         x, y,
         batch_size=bs,
@@ -104,6 +108,7 @@ def _keras_train_fn(store, run_id, spec, num_proc):
         shuffle=spec["shuffle"],
         verbose=spec["verbose"],
         callbacks=callbacks,
+        **val_kwargs,
     )
 
     if rank == 0:
@@ -142,12 +147,18 @@ class KerasEstimator:
 
         p = self.params
         run_id = p.run_id or f"run_{uuid.uuid4().hex[:8]}"
-        shards = shard_arrays({"x": np.asarray(x), "y": np.asarray(y)},
-                              p.num_proc)
+        x, y, xv, yv = _split_validation(
+            np.asarray(x), np.asarray(y), p.validation, p.seed)
         remote_store = self.store.to_remote()
-        for r, shard in enumerate(shards):
+        for r, shard in enumerate(shard_arrays({"x": x, "y": y},
+                                               p.num_proc)):
             remote_store.save_arrays(
                 remote_store.get_train_data_path(str(r)), shard)
+        if xv is not None:
+            for r, shard in enumerate(shard_arrays({"x": xv, "y": yv},
+                                                   p.num_proc)):
+                remote_store.save_arrays(
+                    remote_store.get_val_data_path(str(r)), shard)
 
         spec = _serialize_keras(self.model, self.optimizer, self.loss,
                                 self.metrics)
@@ -160,6 +171,7 @@ class KerasEstimator:
             "seed": p.seed,
             "verbose": p.verbose,
             "n_total": len(x),
+            "n_val": 0 if xv is None else len(xv),
         })
         run_func.run(
             _keras_train_fn, (remote_store, run_id, spec, p.num_proc),

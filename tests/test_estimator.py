@@ -170,6 +170,43 @@ class TestJaxEstimator:
         assert pred.shape == (8, 1)
 
 
+class TestValidationSplit:
+    def test_split_semantics(self):
+        from horovod_tpu.estimator.estimator import _split_validation
+
+        x = np.arange(100).reshape(100, 1).astype(np.float32)
+        y = x.copy()
+        xt, yt, xv, yv = _split_validation(x, y, 0.2, seed=3)
+        assert len(xv) == 20 and len(xt) == 80
+        # deterministic, disjoint, complete
+        xt2, _, xv2, _ = _split_validation(x, y, 0.2, seed=3)
+        np.testing.assert_array_equal(xt, xt2)
+        np.testing.assert_array_equal(xv, xv2)
+        assert not set(xv.ravel()) & set(xt.ravel())
+        assert _split_validation(x, y, None, 0)[2] is None
+        with pytest.raises(ValueError):
+            _split_validation(x, y, 1.5, 0)
+
+    def test_jax_estimator_reports_val_history(self, tmp_path):
+        import optax
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(256, 4).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True).astype(np.float32)
+        est = JaxEstimator(
+            model_fn=_jax_model,
+            loss_fn=_jax_loss,
+            init_params=_jax_init_params,
+            optimizer=optax.adam(1e-2),
+            store=LocalStore(str(tmp_path)),
+            params=EstimatorParams(num_proc=2, epochs=6, batch_size=16,
+                                   validation=0.25, jax_platform="cpu"),
+        )
+        model = est.fit(x, y)
+        assert len(model.val_history) == 6
+        assert model.val_history[-1] < model.val_history[0], model.val_history
+
+
 class TestKerasEstimator:
     def test_fit_predict_end_to_end(self, tmp_path):
         tf = pytest.importorskip("tensorflow")
