@@ -53,6 +53,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         prog="horovodrun", description="Launch a horovod_tpu training job."
     )
     p.add_argument("-v", "--version", action="store_true", dest="version")
+    p.add_argument("-cb", "--check-build", action="store_true",
+                   dest="check_build",
+                   help="show available frontends / control plane / data "
+                        "plane and exit (reference --check-build)")
     p.add_argument("-np", "--num-proc", type=int, dest="np", default=None,
                    help="number of host processes (defaults to number of -H hosts)")
     group_hosts = p.add_mutually_exclusive_group()
@@ -165,12 +169,71 @@ def check_hosts_ssh(hostnames, timeout: float = 15.0,
                 c.put(f"ssh.{h}", "ok")
 
 
+def check_build() -> int:
+    """Print the capability matrix (reference ``horovodrun --check-build``,
+    ``run/run.py:289-326`` — frameworks / controllers / tensor ops, with
+    [X] marks).  Here the controller is always the native TCP star and
+    the data plane is XLA; what varies is which frontends import and
+    which XLA backends are visible."""
+    import importlib.util
+
+    import horovod_tpu
+
+    def mark(ok):
+        return "X" if ok else " "
+
+    def importable(mod):
+        try:
+            return importlib.util.find_spec(mod) is not None
+        except Exception:
+            return False
+
+    def xla_backend(name):
+        try:
+            import jax
+
+            return len(jax.devices(name)) > 0
+        except Exception:
+            return False
+
+    native_ok = True
+    try:
+        from horovod_tpu import native  # noqa: F401
+    except Exception:
+        native_ok = False
+
+    print(f"""\
+horovod_tpu v{horovod_tpu.__version__}:
+
+Available Frontends:
+    [X] JAX (native)
+    [{mark(importable('tensorflow'))}] TensorFlow
+    [{mark(importable('torch'))}] PyTorch
+    [{mark(importable('tensorflow'))}] Keras
+    [{mark(importable('mxnet'))}] MXNet
+
+Available Control Planes:
+    [{mark(native_ok)}] native TCP star (eager negotiation/fusion/cache)
+    [X] compiled SPMD (no runtime controller needed under jit)
+
+Available Data Planes (XLA backends visible from this process):
+    [{mark(xla_backend('tpu'))}] TPU (ICI/DCN collectives)
+    [{mark(xla_backend('cpu'))}] CPU
+
+Cluster Integrations:
+    [X] horovodrun / run_func launcher
+    [{mark(importable('pyspark'))}] Spark""")
+    return 0
+
+
 def _run(args: argparse.Namespace) -> int:
     if args.version:
         import horovod_tpu
 
         print(horovod_tpu.__version__)
         return 0
+    if args.check_build:
+        return check_build()
     if not args.command:
         raise SystemExit("horovodrun: no command specified")
     config_parser.apply_config_file(args, args.config_file)
