@@ -15,3 +15,7 @@ from horovod_tpu.ops.attention import (  # noqa: F401
     ring_attention,
     ulysses_attention,
 )
+from horovod_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_apply,
+    stack_to_stages,
+)

@@ -1,0 +1,101 @@
+"""True pipeline parallelism: GPipe-style microbatched execution over the
+``pp`` mesh axis inside ``shard_map``.
+
+The reference framework has no pipelining (SURVEY.md §2.6 — DP only);
+and GSPMD alone only gives *layer-stack sharding* (weights sharded over
+``pp``, gathered on use).  This module adds the real thing: each device
+owns one contiguous STAGE of layers, activations flow stage-to-stage
+over the ICI ring via ``lax.ppermute``, and M microbatches keep every
+stage busy outside the fill/drain bubble.
+
+Schedule (GPipe, stored activations):
+
+    tick t = 0 .. M+P-2
+      stage 0   feeds microbatch t            (while t < M)
+      stage s   computes what stage s-1 produced at tick t-1
+      stage P-1 emits microbatch t-(P-1)      (from tick P-1 on)
+
+Bubble fraction = (P-1)/(M+P-1): amortized away by raising M.  The whole
+schedule is one ``lax.scan`` over ticks — compile time is constant in M
+and P.  Backward is automatic: ``jax.grad`` differentiates through the
+scan and the ``ppermute``s (the VJP of a ring shift is the reverse ring
+shift), which yields exactly the reverse-order pipeline schedule without
+writing it by hand.  Memory is GPipe-like (activations of all in-flight
+microbatches are saved by autodiff); wrap ``stage_fn`` in
+``jax.checkpoint`` to trade recompute for memory.
+
+Requirements: ``stage_fn`` must be shape-preserving (activations in ==
+activations out — true for transformer blocks), and the number of layers
+must divide evenly into stages.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
+                   *, axis_name: str = "pp"):
+    """Run ``microbatches`` through the P pipeline stages.
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` applying THIS device's stage to
+        one microbatch; must preserve ``x.shape``.
+      stage_params: this device's stage parameters (inside ``shard_map``,
+        pass the pp-sharded slice — e.g. a layer stack reshaped to
+        ``(P, layers_per_stage, ...)`` and sharded on axis 0, squeezed).
+      microbatches: ``(M, mb, ...)`` array, replicated over ``axis_name``
+        (shard data over a separate ``dp`` axis, not ``pp``).
+      axis_name: the pipeline mesh axis bound by ``shard_map``.
+
+    Returns:
+      ``(M, mb, ...)`` outputs of the LAST stage, broadcast to every
+      stage member (one ``psum`` — lets the loss/readout be computed
+      replicated, and keeps the return value meaningful on all devices).
+    """
+    P = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    right = [(i, (i + 1) % P) for i in range(P)]
+
+    def tick(buf, t):
+        # Stage 0 reads the schedule's fresh microbatch (zeros in the
+        # drain phase — those ticks' outputs are discarded below);
+        # other stages read what arrived from the left last tick.
+        mb = microbatches[jnp.clip(t, 0, M - 1)]
+        mb = jnp.where(t < M, mb, jnp.zeros_like(mb))
+        x = jnp.where(s == 0, mb, buf)
+        y = stage_fn(stage_params, x)
+        return lax.ppermute(y, axis_name, right), y
+
+    # Derive the initial carry from axis_index so it is varying-over-axis
+    # under shard_map (the ppermuted carry-out is; a plain replicated
+    # zeros literal would mismatch the scan carry type).
+    buf0 = jnp.zeros_like(microbatches[0]) + (s * 0).astype(
+        microbatches.dtype)
+    _, ys = lax.scan(tick, buf0, jnp.arange(M + P - 1))
+
+    # Last stage's outputs for microbatch m appear at tick m + P - 1.
+    out_last = lax.dynamic_slice_in_dim(ys, P - 1, M, axis=0)
+    # Select the last stage's values and share them with the whole axis:
+    # every other stage contributes zeros, so the psum IS a broadcast.
+    return lax.psum(jnp.where(s == P - 1, out_last, jnp.zeros_like(out_last)),
+                    axis_name)
+
+
+def stack_to_stages(stacked, n_stages: int):
+    """Reshape a ``(n_layers, ...)`` scanned-layer pytree to
+    ``(n_stages, n_layers/n_stages, ...)`` so axis 0 can be sharded over
+    ``pp`` (one stage of layers per device)."""
+    def reshape(leaf):
+        n = leaf.shape[0]
+        if n % n_stages:
+            raise ValueError(
+                f"{n} layers do not divide into {n_stages} pipeline stages")
+        return leaf.reshape(n_stages, n // n_stages, *leaf.shape[1:])
+
+    return jax.tree_util.tree_map(reshape, stacked)
