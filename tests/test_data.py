@@ -1,0 +1,61 @@
+"""Input pipeline: sharding/lockstep/shuffle/prefetch contract."""
+
+import jax
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd  # noqa: F401
+from horovod_tpu.data import DataLoader
+
+
+def _arrays(n=100):
+    return {"x": np.arange(n, dtype=np.float32).reshape(n, 1),
+            "y": np.arange(n, dtype=np.float32)}
+
+
+class TestDataLoader:
+    def test_batches_on_device_and_complete(self, hvd):
+        dl = DataLoader(_arrays(64), 8, shuffle=False, shard=False)
+        batches = list(dl)
+        assert len(batches) == len(dl) == 8
+        assert all(isinstance(b["x"], jax.Array) for b in batches)
+        seen = np.concatenate([np.asarray(b["y"]) for b in batches])
+        np.testing.assert_array_equal(np.sort(seen), np.arange(64))
+
+    def test_drop_remainder(self, hvd):
+        dl = DataLoader(_arrays(70), 8, shuffle=False, shard=False)
+        assert len(dl) == 8  # 70 // 8, last 6 rows dropped
+
+    def test_epoch_reshuffle_deterministic(self, hvd):
+        a = _arrays(32)
+        dl1 = DataLoader(a, 8, shuffle=True, seed=5, shard=False)
+        dl2 = DataLoader(a, 8, shuffle=True, seed=5, shard=False)
+        e1 = [np.asarray(b["y"]) for b in dl1]
+        e1b = [np.asarray(b["y"]) for b in dl1]  # second epoch differs
+        e2 = [np.asarray(b["y"]) for b in dl2]
+        np.testing.assert_array_equal(np.concatenate(e1),
+                                      np.concatenate(e2))
+        assert not np.array_equal(np.concatenate(e1), np.concatenate(e1b))
+
+    def test_prefetch_zero_and_large(self, hvd):
+        for prefetch in (0, 100):
+            dl = DataLoader(_arrays(32), 8, shuffle=False, shard=False,
+                            prefetch=prefetch)
+            assert len(list(dl)) == 4
+
+    def test_mesh_sharding_placement(self, hvd):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        sh = NamedSharding(hvd.mesh(), P(hvd.AXIS))
+        dl = DataLoader(_arrays(64), 16, shuffle=False, shard=False,
+                        sharding=sh)
+        b = next(iter(dl))
+        assert b["x"].sharding == sh
+
+    def test_length_mismatch_raises(self, hvd):
+        with pytest.raises(ValueError, match="disagree"):
+            DataLoader({"x": np.zeros((4, 1)), "y": np.zeros(5)}, 2)
+
+    def test_oversized_batch_raises(self, hvd):
+        with pytest.raises(ValueError, match="exceeds"):
+            DataLoader(_arrays(4), 8, shard=False)

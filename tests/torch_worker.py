@@ -120,5 +120,22 @@ gathered = hvd.allgather(flat.unsqueeze(0))
 assert torch.allclose(gathered[0], gathered[1], atol=1e-6), \
     (gathered[0] - gathered[1]).abs().max()
 
+# --- DataLoader sharding + lockstep across real processes --------------
+from horovod_tpu.data import DataLoader  # noqa: E402
+
+rows = np.arange(101, dtype=np.float32)
+dl = DataLoader({"y": rows}, 10, shuffle=False)
+# lockstep: both ranks agree on the batch count (min shard decides):
+# 101 rows over 2 ranks -> shards of 51/50 -> 5 batches each.
+assert len(dl) == 5, len(dl)
+mine = np.concatenate([np.asarray(b["y"]) for b in dl])
+assert len(mine) == 50
+# disjoint: gather both ranks' rows, no overlap
+import horovod_tpu as hvd_core  # noqa: E402
+
+all_rows = hvd_core.allgather(mine[None, :], name="dl.rows")
+a, b = np.asarray(all_rows)
+assert not set(a.tolist()) & set(b.tolist())
+
 hvd.shutdown()
 print(f"TORCH-WORKER-OK rank={rank}")
