@@ -42,11 +42,12 @@ def parse_args():
     return ap.parse_args()
 
 
-def synthetic_batches(batch, image_size=224, seed=0):
+def synthetic_dataset(n, image_size=224, seed=0):
     rng = np.random.RandomState(seed)
-    while True:
-        yield (rng.rand(batch, image_size, image_size, 3).astype(np.float32),
-               rng.randint(0, 1000, (batch,)).astype(np.int32))
+    return {
+        "images": rng.rand(n, image_size, image_size, 3).astype(np.float32),
+        "labels": rng.randint(0, 1000, (n,)).astype(np.int32),
+    }
 
 
 def main():
@@ -109,18 +110,22 @@ def main():
         _step, in_specs=(P(), P(), P(), P(axis), P(axis)),
         out_specs=(P(), P(), P(), P()), mesh=mesh), donate_argnums=(0, 1, 2))
 
-    batches = synthetic_batches(args.batch_size * n)
-    sharding = NamedSharding(mesh, P(axis))
+    # Device-prefetched input pipeline: next batch's host->HBM transfer
+    # overlaps the current step (horovod_tpu.data.DataLoader; swap
+    # synthetic_dataset for a real reader keeping the same dict shape).
+    from horovod_tpu.data import DataLoader
+
+    data = synthetic_dataset(args.batch_size * n * steps_per_epoch)
+    data["images"] = data["images"].astype(jnp.bfloat16)
+    loader = DataLoader(data, args.batch_size * n, shard=False,
+                        sharding=NamedSharding(mesh, P(axis)))
     for epoch in range(start_epoch, args.epochs):
         with timeline.trace(f"epoch.{epoch}"):
             losses = []
-            for _ in range(steps_per_epoch):
-                images, labels = next(batches)
-                images = jax.device_put(
-                    jnp.asarray(images, jnp.bfloat16), sharding)
-                labels = jax.device_put(jnp.asarray(labels), sharding)
+            for batch in loader:
                 params, opt_state, batch_stats, loss = step(
-                    params, opt_state, batch_stats, images, labels)
+                    params, opt_state, batch_stats,
+                    batch["images"], batch["labels"])
                 losses.append(loss)
             epoch_loss = float(np.mean([float(np.asarray(l))
                                         for l in losses]))
