@@ -80,7 +80,8 @@ from horovod_tpu.state import (
     broadcast_parameters,
 )
 from horovod_tpu.join import join, masked_average
-from horovod_tpu import callbacks, elastic, spmd, parallel
+from horovod_tpu import callbacks, data, elastic, spmd, parallel
+from horovod_tpu.data import DataLoader
 
 __version__ = "0.1.0"
 
