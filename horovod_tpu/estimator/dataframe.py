@@ -1,0 +1,50 @@
+"""DataFrame entry point for the estimators.
+
+Reference: the Spark estimators take a DataFrame plus ``feature_cols`` /
+``label_cols`` params and materialize it for the trainers
+(``spark/common/util.py:prepare_data``, 608 LoC of DataFrame→Parquet
+plumbing).  Here the same user contract — "hand the estimator a
+DataFrame and column names" — converts through pandas into the numpy
+(x, y) the trainers shard, with list-valued columns (embeddings, images
+flattened row-wise) stacked into 2-D blocks and multiple feature columns
+concatenated in the order given.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def _col_to_block(col) -> np.ndarray:
+    """One column -> (N, k) float block: scalars k=1, list/array values
+    stack to their common width."""
+    first = col.iloc[0]
+    if np.ndim(first) == 0:
+        return np.asarray(col, np.float32).reshape(-1, 1)
+    block = np.stack([np.asarray(v, np.float32).ravel() for v in col])
+    return block
+
+
+def df_to_arrays(df, feature_cols: Sequence[str],
+                 label_cols: Sequence[str]) -> Tuple[np.ndarray, np.ndarray]:
+    """(x, y) float32 matrices from DataFrame columns (reference
+    ``to_petastorm``-style vector assembly, minus Spark)."""
+    missing = [c for c in list(feature_cols) + list(label_cols)
+               if c not in df.columns]
+    if missing:
+        raise ValueError(f"columns not in DataFrame: {missing}")
+    x = np.concatenate([_col_to_block(df[c]) for c in feature_cols], axis=1)
+    y = np.concatenate([_col_to_block(df[c]) for c in label_cols], axis=1)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+class DataFrameFitMixin:
+    """Adds ``fit_df(df, feature_cols, label_cols)`` to an estimator
+    whose ``fit(x, y)`` takes numpy matrices."""
+
+    def fit_df(self, df, feature_cols: Sequence[str],
+               label_cols: Sequence[str]):
+        x, y = df_to_arrays(df, feature_cols, label_cols)
+        return self.fit(x, y)

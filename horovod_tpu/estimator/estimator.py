@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from horovod_tpu.estimator.dataframe import DataFrameFitMixin
 from horovod_tpu.estimator.store import Store, shard_arrays
 
 
@@ -208,7 +209,7 @@ def _jax_train_fn(store, run_id, spec, num_proc):
     return history
 
 
-class JaxEstimator:
+class JaxEstimator(DataFrameFitMixin):
     """Distributed-training estimator for a pure-JAX model.
 
     ``model_fn(params, x)`` is the forward; ``loss_fn(params, x, y)`` the
@@ -352,7 +353,7 @@ def _torch_train_fn(store, run_id, spec, num_proc):
     return history
 
 
-class TorchEstimator:
+class TorchEstimator(DataFrameFitMixin):
     """Distributed-training estimator for a torch model (reference
     ``spark/torch/estimator.py`` shape: model + optimizer + loss in,
     Model transformer out)."""

@@ -121,7 +121,10 @@ def _keras_train_fn(store, run_id, spec, num_proc):
     return True
 
 
-class KerasEstimator:
+from horovod_tpu.estimator.dataframe import DataFrameFitMixin
+
+
+class KerasEstimator(DataFrameFitMixin):
     """Distributed-training estimator for a tf.keras model (reference
     ``KerasEstimator``): pass an (uncompiled) model plus optimizer/loss/
     metrics; ``fit(x, y)`` trains on ``params.num_proc`` ranks and

@@ -82,6 +82,53 @@ class Store:
     def load_obj(self, path: str) -> Any:
         return pickle.loads(self.read_bytes(path))
 
+    # -- Parquet (the reference's intermediate format; spark/common/
+    # util.py materializes DataFrames as Parquet for the trainers) -------
+    def save_parquet(self, path: str, arrays: Dict[str, np.ndarray]) -> None:
+        """Write a dict of equal-length arrays as one Parquet file.
+        Multi-dim arrays become fixed-size-list columns (the same shape
+        Petastorm round-trips); restored exactly by :meth:`load_parquet`."""
+        import io
+
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        cols, meta = {}, {}
+        for k, v in arrays.items():
+            v = np.asarray(v)
+            if v.ndim > 1:
+                meta[k] = v.shape[1:]
+                v2 = v.reshape(len(v), -1)
+                cols[k] = pa.FixedSizeListArray.from_arrays(
+                    pa.array(v2.ravel()), v2.shape[1])
+            else:
+                cols[k] = pa.array(v)
+        table = pa.table(cols)
+        table = table.replace_schema_metadata(
+            {b"horovod_tpu.shapes": pickle.dumps(meta)})
+        buf = io.BytesIO()
+        pq.write_table(table, buf)
+        self.write_bytes(path, buf.getvalue())
+
+    def load_parquet(self, path: str) -> Dict[str, np.ndarray]:
+        import io
+
+        import pyarrow.parquet as pq
+
+        table = pq.read_table(io.BytesIO(self.read_bytes(path)))
+        meta = {}
+        md = table.schema.metadata or {}
+        if b"horovod_tpu.shapes" in md:
+            meta = pickle.loads(md[b"horovod_tpu.shapes"])
+        out = {}
+        for k in table.column_names:
+            col = table.column(k).combine_chunks()
+            arr = np.asarray(col.flatten() if k in meta else col)
+            if k in meta:
+                arr = arr.reshape((len(table),) + tuple(meta[k]))
+            out[k] = arr
+        return out
+
     @staticmethod
     def create(prefix_path: str) -> "Store":
         """Pick a Store for the path (reference ``Store.create``:

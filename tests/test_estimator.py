@@ -170,6 +170,72 @@ class TestJaxEstimator:
         assert pred.shape == (8, 1)
 
 
+class TestParquet:
+    def test_roundtrip_multidim(self, tmp_path):
+        store = LocalStore(str(tmp_path))
+        arrays = {
+            "img": np.random.RandomState(0).rand(6, 4, 3).astype(np.float32),
+            "label": np.arange(6, dtype=np.int64),
+        }
+        p = str(tmp_path / "data.parquet")
+        store.save_parquet(p, arrays)
+        back = store.load_parquet(p)
+        np.testing.assert_array_equal(back["img"], arrays["img"])
+        np.testing.assert_array_equal(back["label"], arrays["label"])
+
+    def test_readable_by_plain_pyarrow(self, tmp_path):
+        # interchange: other tools must be able to read what we write
+        import pyarrow.parquet as pq
+
+        store = LocalStore(str(tmp_path))
+        p = str(tmp_path / "data.parquet")
+        store.save_parquet(p, {"a": np.arange(5, dtype=np.float32)})
+        t = pq.read_table(p)
+        assert t.column_names == ["a"] and len(t) == 5
+
+
+class TestDataFrameFit:
+    def test_df_to_arrays_blocks(self):
+        import pandas as pd
+
+        from horovod_tpu.estimator.dataframe import df_to_arrays
+
+        df = pd.DataFrame({
+            "f1": [1.0, 2.0, 3.0],
+            "vec": [np.full(4, 7.0)] * 3,
+            "y": [0.5, 1.5, 2.5],
+        })
+        x, y = df_to_arrays(df, ["f1", "vec"], ["y"])
+        assert x.shape == (3, 5) and y.shape == (3, 1)
+        np.testing.assert_allclose(x[:, 0], [1, 2, 3])
+        np.testing.assert_allclose(x[:, 1:], 7.0)
+        with pytest.raises(ValueError, match="not in DataFrame"):
+            df_to_arrays(df, ["nope"], ["y"])
+
+    def test_jax_estimator_fit_df(self, tmp_path):
+        import optax
+        import pandas as pd
+
+        rng = np.random.RandomState(0)
+        xs = rng.randn(128, 4).astype(np.float32)
+        df = pd.DataFrame({
+            "features": list(xs),
+            "target": xs.sum(axis=1),
+        })
+        est = JaxEstimator(
+            model_fn=_jax_model,
+            loss_fn=_jax_loss,
+            init_params=_jax_init_params,
+            optimizer=optax.adam(1e-2),
+            store=LocalStore(str(tmp_path)),
+            params=EstimatorParams(num_proc=2, epochs=4, batch_size=16,
+                                   jax_platform="cpu"),
+        )
+        model = est.fit_df(df, feature_cols=["features"],
+                           label_cols=["target"])
+        assert model.history[-1] < model.history[0]
+
+
 class TestValidationSplit:
     def test_split_semantics(self):
         from horovod_tpu.estimator.estimator import _split_validation
