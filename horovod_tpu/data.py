@@ -85,7 +85,7 @@ class DataLoader:
             return np.arange(n)
         rank = basics.process_rank() if basics.is_initialized() else 0
         rng = np.random.RandomState(
-            (self.seed * 1000003 + self._epoch) ^ rank)
+            ((self.seed * 1000003 + self._epoch) ^ rank) % (2 ** 32))
         return rng.permutation(n)
 
     def __iter__(self) -> Iterator[Dict[str, jax.Array]]:

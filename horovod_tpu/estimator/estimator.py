@@ -111,6 +111,33 @@ def _split_validation(x: np.ndarray, y: np.ndarray, validation, seed: int):
     return x[tr], y[tr], x[val], y[val]
 
 
+def _stage_data(remote_store, x, y, p: "EstimatorParams"):
+    """Split, shard and materialize train (+ optional validation) data
+    through the store — the staging step every estimator flavor shares.
+    Returns ``(n_train, n_val)``.
+
+    Guards the lockstep contract: a validation fraction so small that
+    some rank's shard would be EMPTY is rejected up front — an empty
+    shard would turn that rank's epoch-end val reduction into NaN (mean
+    of zero rows) and poison every rank through the allreduce."""
+    x, y, xv, yv = _split_validation(
+        np.asarray(x), np.asarray(y), p.validation, p.seed)
+    if xv is not None and len(xv) < p.num_proc:
+        raise ValueError(
+            f"validation={p.validation} keeps only {len(xv)} rows — fewer "
+            f"than num_proc={p.num_proc}, so some worker would hold an "
+            "empty validation shard; raise validation or lower num_proc")
+    for r, shard in enumerate(shard_arrays({"x": x, "y": y}, p.num_proc)):
+        remote_store.save_arrays(
+            remote_store.get_train_data_path(str(r)), shard)
+    if xv is not None:
+        for r, shard in enumerate(shard_arrays({"x": xv, "y": yv},
+                                               p.num_proc)):
+            remote_store.save_arrays(
+                remote_store.get_val_data_path(str(r)), shard)
+    return len(x), 0 if xv is None else len(xv)
+
+
 def _steps_per_epoch(n_total: int, num_proc: int, batch_size: int) -> int:
     """Identical on every rank: min over ranks of full batches per shard
     (shard r holds (r+1)*n//P - r*n//P rows)."""
@@ -234,18 +261,8 @@ class JaxEstimator(DataFrameFitMixin):
 
         p = self.params
         run_id = p.run_id or f"run_{uuid.uuid4().hex[:8]}"
-        x, y, xv, yv = _split_validation(
-            np.asarray(x), np.asarray(y), p.validation, p.seed)
         remote_store = self.store.to_remote()
-        for r, shard in enumerate(shard_arrays({"x": x, "y": y},
-                                               p.num_proc)):
-            remote_store.save_arrays(
-                remote_store.get_train_data_path(str(r)), shard)
-        if xv is not None:
-            for r, shard in enumerate(shard_arrays({"x": xv, "y": yv},
-                                                   p.num_proc)):
-                remote_store.save_arrays(
-                    remote_store.get_val_data_path(str(r)), shard)
+        n_train, n_val = _stage_data(remote_store, x, y, p)
 
         spec = {
             "loss_fn": self.loss_fn,
@@ -255,8 +272,8 @@ class JaxEstimator(DataFrameFitMixin):
             "epochs": p.epochs,
             "shuffle": p.shuffle,
             "seed": p.seed,
-            "n_total": len(x),
-            "n_val": 0 if xv is None else len(xv),
+            "n_total": n_train,
+            "n_val": n_val,
         }
         run_func.run(
             _jax_train_fn, (remote_store, run_id, spec, p.num_proc),
@@ -372,18 +389,8 @@ class TorchEstimator(DataFrameFitMixin):
 
         p = self.params
         run_id = p.run_id or f"run_{uuid.uuid4().hex[:8]}"
-        x, y, xv, yv = _split_validation(
-            np.asarray(x), np.asarray(y), p.validation, p.seed)
         remote_store = self.store.to_remote()
-        for r, shard in enumerate(shard_arrays({"x": x, "y": y},
-                                               p.num_proc)):
-            remote_store.save_arrays(
-                remote_store.get_train_data_path(str(r)), shard)
-        if xv is not None:
-            for r, shard in enumerate(shard_arrays({"x": xv, "y": yv},
-                                                   p.num_proc)):
-                remote_store.save_arrays(
-                    remote_store.get_val_data_path(str(r)), shard)
+        n_train, n_val = _stage_data(remote_store, x, y, p)
         spec = {
             "model_factory": self.model_factory,
             "optimizer_factory": self.optimizer_factory,
@@ -392,8 +399,8 @@ class TorchEstimator(DataFrameFitMixin):
             "epochs": p.epochs,
             "shuffle": p.shuffle,
             "seed": p.seed,
-            "n_total": len(x),
-            "n_val": 0 if xv is None else len(xv),
+            "n_total": n_train,
+            "n_val": n_val,
         }
         run_func.run(
             _torch_train_fn, (remote_store, run_id, spec, p.num_proc),

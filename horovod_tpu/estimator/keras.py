@@ -33,9 +33,9 @@ import tensorflow  # noqa: F401 — real import gate: this module's surface
 import numpy as np
 
 from horovod_tpu.estimator.estimator import (
-    EstimatorParams, _split_validation, _steps_per_epoch, resolve_platform,
+    EstimatorParams, _stage_data, _steps_per_epoch, resolve_platform,
 )
-from horovod_tpu.estimator.store import Store, shard_arrays
+from horovod_tpu.estimator.store import Store
 
 
 def _serialize_keras(model, optimizer, loss, metrics) -> Dict[str, Any]:
@@ -150,18 +150,8 @@ class KerasEstimator(DataFrameFitMixin):
 
         p = self.params
         run_id = p.run_id or f"run_{uuid.uuid4().hex[:8]}"
-        x, y, xv, yv = _split_validation(
-            np.asarray(x), np.asarray(y), p.validation, p.seed)
         remote_store = self.store.to_remote()
-        for r, shard in enumerate(shard_arrays({"x": x, "y": y},
-                                               p.num_proc)):
-            remote_store.save_arrays(
-                remote_store.get_train_data_path(str(r)), shard)
-        if xv is not None:
-            for r, shard in enumerate(shard_arrays({"x": xv, "y": yv},
-                                                   p.num_proc)):
-                remote_store.save_arrays(
-                    remote_store.get_val_data_path(str(r)), shard)
+        n_train, n_val = _stage_data(remote_store, x, y, p)
 
         spec = _serialize_keras(self.model, self.optimizer, self.loss,
                                 self.metrics)
@@ -173,8 +163,8 @@ class KerasEstimator(DataFrameFitMixin):
             "shuffle": p.shuffle,
             "seed": p.seed,
             "verbose": p.verbose,
-            "n_total": len(x),
-            "n_val": 0 if xv is None else len(xv),
+            "n_total": n_train,
+            "n_val": n_val,
         })
         run_func.run(
             _keras_train_fn, (remote_store, run_id, spec, p.num_proc),

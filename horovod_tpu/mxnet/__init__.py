@@ -83,6 +83,10 @@ class DistributedOptimizer(mx.optimizer.Optimizer):
         self._optimizer.rescale_grad /= cross_size()
 
     def __getattr__(self, item):
+        if item == "_optimizer":
+            # only reachable when __init__ hasn't run (deepcopy/unpickle
+            # protocol probes) — delegating would recurse forever
+            raise AttributeError(item)
         return getattr(self._optimizer, item)
 
     def create_state_multi_precision(self, index, weight):

@@ -93,16 +93,23 @@ def _spark_run(sc, fn, args, kwargs, num_proc, env, verbose,
     from horovod_tpu.spark.driver import SCOPE, SparkDriverService
 
     num = num_proc or sc.defaultParallelism
+    # The per-job HMAC key travels INSIDE the task closure (Spark's own
+    # serialized-closure channel): executors on other machines have fresh
+    # environments, and without the key they could not read a single
+    # signed KV entry — including the one carrying the job env.  A key is
+    # GENERATED when none is exported (same as launch_job): the driver's
+    # KV listens on an open port and tasks cloudpickle what they read
+    # from it, so an unsigned KV would be remote code execution for
+    # anyone who can reach the port.  Exported before the driver starts
+    # so its server verifies from the first request.
+    secret_key = (secret_mod.get_key() or b"").decode() \
+        or secret_mod.make_secret_key()
+    os.environ[secret_mod.ENV_KEY] = secret_key
     driver = SparkDriverService(num, fn, args, kwargs, env)
     driver_host = os.environ.get("HOROVOD_HOSTNAME") or socket.gethostbyname(
         socket.gethostname())
     driver_port = driver.port
     job_group = f"horovod_tpu.spark.{driver_port}"
-    # The per-job HMAC key travels INSIDE the task closure (Spark's own
-    # serialized-closure channel): executors on other machines have fresh
-    # environments, and without the key they could not read a single
-    # signed KV entry — including the one carrying the job env.
-    secret_key = (secret_mod.get_key() or b"").decode()
 
     if verbose:
         print(f"[horovod_tpu.spark] running {num} Spark tasks; rendezvous "
@@ -172,7 +179,7 @@ def _spark_run(sc, fn, args, kwargs, num_proc, env, verbose,
         try:
             kind, payload = result_q.get_nowait()
         except queue.Empty:
-            raise
+            raise startup_err
         if kind == "error":
             raise RuntimeError(
                 "horovod_tpu.spark.run: Spark job failed during "

@@ -62,7 +62,11 @@ def allreduce(tensor, average=None, op=None, name=None,
     if op is None:
         op = Average if (average is None or average) else Sum
     if isinstance(tensor, tf.IndexedSlices):
-        if sparse_as_dense:
+        if sparse_as_dense or tf.inside_function():
+            # Under a tf.function trace the sparse tensors are symbolic
+            # (no .numpy()), so the traced path densifies and rides the
+            # py_function bridge below; the row-proportional sparse wire
+            # format is an eager-path optimization.
             tensor = tf.convert_to_tensor(tensor)
         else:
             if op not in (Average, Sum):
@@ -70,8 +74,9 @@ def allreduce(tensor, average=None, op=None, name=None,
                     "sparse allreduce supports Sum/Average (reference "
                     "raises the same way for Adasum on IndexedSlices)")
             nm = name or "sparse.allreduce"
+            vals = _to_np(tensor.values) * prescale_factor
             values = tf.convert_to_tensor(
-                C.allgather(_to_np(tensor.values), name=f"{nm}.values"))
+                C.allgather(vals, name=f"{nm}.values") * postscale_factor)
             if op == Average:
                 values = values / cross_size()  # eager-path participants
             indices = tf.convert_to_tensor(

@@ -52,6 +52,12 @@ class TestDataLoader:
         b = next(iter(dl))
         assert b["x"].sharding == sh
 
+    def test_large_seed_ok(self, hvd):
+        # seeds >= 4295 used to overflow numpy's 32-bit RandomState range
+        dl = DataLoader(_arrays(16), 4, shuffle=True, seed=2 ** 31,
+                        shard=False)
+        assert len(list(dl)) == 4
+
     def test_length_mismatch_raises(self, hvd):
         with pytest.raises(ValueError, match="disagree"):
             DataLoader({"x": np.zeros((4, 1)), "y": np.zeros(5)}, 2)

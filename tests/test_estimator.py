@@ -253,6 +253,18 @@ class TestValidationSplit:
         with pytest.raises(ValueError):
             _split_validation(x, y, 1.5, 0)
 
+    def test_tiny_validation_fraction_rejected(self, tmp_path):
+        # a split leaving fewer val rows than workers would give some
+        # rank an EMPTY shard -> NaN poisoning the epoch reduction
+        from horovod_tpu.estimator.estimator import _stage_data
+
+        store = LocalStore(str(tmp_path))
+        x = np.zeros((100, 2), np.float32)
+        y = np.zeros((100, 1), np.float32)
+        with pytest.raises(ValueError, match="empty validation shard"):
+            _stage_data(store, x, y,
+                        EstimatorParams(num_proc=4, validation=0.01))
+
     def test_jax_estimator_reports_val_history(self, tmp_path):
         import optax
 

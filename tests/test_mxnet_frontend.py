@@ -166,6 +166,15 @@ class TestDistributedOptimizer:
         opt = hvd_mx.DistributedOptimizer(base)
         assert base.rescale_grad == pytest.approx(2.0 / hvd_mx.cross_size())
 
+    def test_deepcopy_does_not_recurse(self, hvd, hvd_mx):
+        # deepcopy probes __deepcopy__ before __init__ runs on the copy;
+        # __getattr__ must not recurse on the missing _optimizer
+        import copy
+
+        opt = hvd_mx.DistributedOptimizer(FakeOptimizer(rescale_grad=1.0))
+        clone = copy.deepcopy(opt)
+        assert clone._optimizer.rescale_grad == opt._optimizer.rescale_grad
+
     def test_update_delegates_and_reduces(self, hvd, hvd_mx):
         base = FakeOptimizer(learning_rate=0.5, rescale_grad=1.0)
         opt = hvd_mx.DistributedOptimizer(base)
