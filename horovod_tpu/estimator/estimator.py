@@ -40,6 +40,10 @@ class EstimatorParams:
     # the float-split flavor; the column-name flavor is DataFrame
     # machinery this numpy data path doesn't have).
     validation: Optional[float] = None
+    # Intermediate shard format in the Store: "npz" or "parquet" (the
+    # reference's format; interchangeable with external Parquet tools).
+    # Readers sniff the magic, so trainers are format-agnostic.
+    storage_format: str = "npz"
     # JAX platform pinned in worker ranks.  "auto" (default) trains on
     # TPU when a single worker process can own the visible chips
     # (num_proc == 1) and falls back to CPU otherwise — the launcher does
@@ -129,12 +133,14 @@ def _stage_data(remote_store, x, y, p: "EstimatorParams"):
             "empty validation shard; raise validation or lower num_proc")
     for r, shard in enumerate(shard_arrays({"x": x, "y": y}, p.num_proc)):
         remote_store.save_arrays(
-            remote_store.get_train_data_path(str(r)), shard)
+            remote_store.get_train_data_path(str(r)), shard,
+            format=p.storage_format)
     if xv is not None:
         for r, shard in enumerate(shard_arrays({"x": xv, "y": yv},
                                                p.num_proc)):
             remote_store.save_arrays(
-                remote_store.get_val_data_path(str(r)), shard)
+                remote_store.get_val_data_path(str(r)), shard,
+                format=p.storage_format)
     return len(x), 0 if xv is None else len(xv)
 
 

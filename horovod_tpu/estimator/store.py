@@ -63,9 +63,19 @@ class Store:
         return self
 
     # -- convenience on top of bytes IO ----------------------------------
-    def save_arrays(self, path: str, arrays: Dict[str, np.ndarray]) -> None:
+    def save_arrays(self, path: str, arrays: Dict[str, np.ndarray], *,
+                    format: str = "npz") -> None:
+        """``format``: "npz" (default) or "parquet" (the reference's
+        intermediate format).  Readers sniff the file magic, so the two
+        formats share paths and no consumer needs to know which was
+        chosen."""
         import io
 
+        if format == "parquet":
+            self.save_parquet(path, arrays)
+            return
+        if format != "npz":
+            raise ValueError(f"unknown storage format {format!r}")
         buf = io.BytesIO()
         np.savez(buf, **arrays)
         self.write_bytes(path, buf.getvalue())
@@ -73,7 +83,10 @@ class Store:
     def load_arrays(self, path: str) -> Dict[str, np.ndarray]:
         import io
 
-        with np.load(io.BytesIO(self.read_bytes(path))) as z:
+        data = self.read_bytes(path)
+        if data[:4] == b"PAR1":  # parquet magic
+            return self._parquet_bytes_to_arrays(data)
+        with np.load(io.BytesIO(data)) as z:
             return {k: z[k] for k in z.files}
 
     def save_obj(self, path: str, obj: Any) -> None:
@@ -111,11 +124,15 @@ class Store:
         self.write_bytes(path, buf.getvalue())
 
     def load_parquet(self, path: str) -> Dict[str, np.ndarray]:
+        return self._parquet_bytes_to_arrays(self.read_bytes(path))
+
+    @staticmethod
+    def _parquet_bytes_to_arrays(data: bytes) -> Dict[str, np.ndarray]:
         import io
 
         import pyarrow.parquet as pq
 
-        table = pq.read_table(io.BytesIO(self.read_bytes(path)))
+        table = pq.read_table(io.BytesIO(data))
         meta = {}
         md = table.schema.metadata or {}
         if b"horovod_tpu.shapes" in md:

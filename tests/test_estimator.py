@@ -183,6 +183,29 @@ class TestParquet:
         np.testing.assert_array_equal(back["img"], arrays["img"])
         np.testing.assert_array_equal(back["label"], arrays["label"])
 
+    def test_estimator_trains_from_parquet_shards(self, tmp_path):
+        import optax
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(128, 4).astype(np.float32)
+        y = x.sum(axis=1, keepdims=True).astype(np.float32)
+        est = JaxEstimator(
+            model_fn=_jax_model,
+            loss_fn=_jax_loss,
+            init_params=_jax_init_params,
+            optimizer=optax.adam(1e-2),
+            store=LocalStore(str(tmp_path)),
+            params=EstimatorParams(num_proc=2, epochs=4, batch_size=16,
+                                   storage_format="parquet",
+                                   jax_platform="cpu"),
+        )
+        model = est.fit(x, y)
+        assert model.history[-1] < model.history[0]
+        # the shards really are parquet (magic), not npz
+        with open(LocalStore(str(tmp_path)).get_train_data_path("0"),
+                  "rb") as f:
+            assert f.read(4) == b"PAR1"
+
     def test_readable_by_plain_pyarrow(self, tmp_path):
         # interchange: other tools must be able to read what we write
         import pyarrow.parquet as pq
