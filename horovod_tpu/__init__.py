@@ -80,8 +80,9 @@ from horovod_tpu.state import (
     broadcast_parameters,
 )
 from horovod_tpu.join import join, masked_average
-from horovod_tpu import callbacks, data, elastic, spmd, parallel
+from horovod_tpu import callbacks, data, elastic, spmd, parallel, timeline
 from horovod_tpu.data import DataLoader
+from horovod_tpu.timeline import start_timeline, stop_timeline
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,16 @@
+"""``horovod_tpu.tensorflow.keras`` — alias of :mod:`horovod_tpu.keras`
+(the reference exposes the keras surface at both ``horovod.keras`` and
+``horovod.tensorflow.keras``; scripts import either)."""
+
+from horovod_tpu.keras import *  # noqa: F401,F403
+from horovod_tpu.keras import (  # noqa: F401 — explicit for tooling
+    BroadcastGlobalVariablesCallback,
+    DistributedOptimizer,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+    init,
+    load_model,
+    rank,
+    size,
+)
