@@ -19,6 +19,7 @@ from horovod_tpu.basics import (  # noqa: F401
     init, is_initialized, local_rank, local_size, rank, shutdown, size,
 )
 from horovod_tpu.tensorflow import (  # noqa: F401
+    Compression,
     DistributedOptimizer,
     allgather,
     allreduce,

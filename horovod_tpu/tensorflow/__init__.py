@@ -32,6 +32,8 @@ from horovod_tpu.basics import (  # noqa: F401
     num_processes, process_rank, rank, shutdown, size,
 )
 from horovod_tpu.ops import collectives as C
+from horovod_tpu.ops.compression import Compression  # noqa: F401 — parity
+# surface of the reference's tensorflow/compression.py
 
 Average, Sum, Adasum = C.Average, C.Sum, C.Adasum
 
@@ -200,14 +202,27 @@ class DistributedGradientTape(object):
             tf.convert_to_tensor(g) if isinstance(g, tf.IndexedSlices) else g)
             for g in grads]
         present = [i for i, a in enumerate(arrs) if a is not None]
-        reduced = C.grouped_allreduce([arrs[i] for i in present], self._op)
+        reduced = _reduce_group([arrs[i] for i in present], self._op,
+                                self._compression)
         out = list(grads)
         for i, r in zip(present, reduced):
             out[i] = tf.convert_to_tensor(r)
         return out
 
 
-def distributed_optimizer_class(base_cls, op=Average):
+def _reduce_group(arrs, op, compression):
+    """Grouped allreduce with optional 16-bit wire compression (the
+    reference compresses per tensor before enqueue,
+    ``tensorflow/__init__.py:43-118`` + ``compression.py``)."""
+    if compression is None or compression is Compression.none:
+        return C.grouped_allreduce(arrs, op)
+    pairs = [compression.compress(a) for a in arrs]
+    reduced = C.grouped_allreduce([p[0] for p in pairs], op)
+    return [np.asarray(compression.decompress(r, p[1]))
+            for r, p in zip(reduced, pairs)]
+
+
+def distributed_optimizer_class(base_cls, op=Average, compression=None):
     """Subclass ``base_cls`` so ``apply_gradients`` averages gradients
     across workers first.  Keeps the base class's name so keras
     (de)serialization round-trips — ``load_model`` resolves the saved
@@ -223,7 +238,8 @@ def distributed_optimizer_class(base_cls, op=Average):
                 tf.convert_to_tensor(g) if isinstance(g, tf.IndexedSlices)
                 else g) for g, _ in gv]
             present = [i for i, a in enumerate(arrs) if a is not None]
-            reduced = C.grouped_allreduce([arrs[i] for i in present], op)
+            reduced = _reduce_group([arrs[i] for i in present], op,
+                                    compression)
             for i, r in zip(present, reduced):
                 gv[i] = (tf.convert_to_tensor(r), gv[i][1])
             return super().apply_gradients(gv, **kwargs)
@@ -236,5 +252,6 @@ def DistributedOptimizer(optimizer, compression=None, op=Average,
                          backward_passes_per_step=1):
     """Wrap a keras optimizer so apply_gradients averages gradients
     across workers first (reference factory, 410-471)."""
-    cls = distributed_optimizer_class(optimizer.__class__, op=op)
+    cls = distributed_optimizer_class(optimizer.__class__, op=op,
+                                      compression=compression)
     return cls.from_config(optimizer.get_config())
