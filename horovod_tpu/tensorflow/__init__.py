@@ -222,12 +222,20 @@ def _reduce_group(arrs, op, compression):
             for r, p in zip(reduced, pairs)]
 
 
-def distributed_optimizer_class(base_cls, op=Average, compression=None):
+def distributed_optimizer_class(base_cls, op=Average, compression=None,
+                                backward_passes_per_step=1):
     """Subclass ``base_cls`` so ``apply_gradients`` averages gradients
     across workers first.  Keeps the base class's name so keras
     (de)serialization round-trips — ``load_model`` resolves the saved
     class through these wrappers (reference ``_keras/__init__.py:103-115``
-    custom-objects mechanism)."""
+    custom-objects mechanism).
+
+    ``backward_passes_per_step > 1`` turns on local gradient aggregation
+    (reference ``tensorflow/__init__.py:328-365``): the first N-1 calls
+    accumulate on the host and apply NOTHING; the Nth reduces the
+    accumulated average across workers and applies it."""
+
+    bpps = int(backward_passes_per_step)
 
     class _Wrapped(base_cls):
         _hvd_wrapped = True
@@ -237,6 +245,27 @@ def distributed_optimizer_class(base_cls, op=Average, compression=None):
             arrs = [None if g is None else _to_np(
                 tf.convert_to_tensor(g) if isinstance(g, tf.IndexedSlices)
                 else g) for g, _ in gv]
+            if bpps > 1:
+                # plain __dict__ storage: keras 3 optimizers TRACK
+                # attribute assignments (lists get copied into tracked
+                # structures), which would silently detach this state
+                state = self.__dict__.setdefault(
+                    "_hvd_agg_state", {"agg": None, "passes": 0})
+                if state["agg"] is None:
+                    state["agg"] = [None] * len(arrs)
+                agg = state["agg"]
+                if len(agg) != len(arrs):
+                    raise ValueError(
+                        "apply_gradients called with a different variable "
+                        "set mid-aggregation window")
+                for i, a in enumerate(arrs):
+                    if a is not None:
+                        agg[i] = a if agg[i] is None else agg[i] + a
+                state["passes"] += 1
+                if state["passes"] % bpps != 0:
+                    return None  # accumulate only; nothing applied yet
+                arrs = [None if a is None else a / bpps for a in agg]
+                state["agg"] = None
             present = [i for i, a in enumerate(arrs) if a is not None]
             reduced = _reduce_group([arrs[i] for i in present], op,
                                     compression)
@@ -252,6 +281,7 @@ def DistributedOptimizer(optimizer, compression=None, op=Average,
                          backward_passes_per_step=1):
     """Wrap a keras optimizer so apply_gradients averages gradients
     across workers first (reference factory, 410-471)."""
-    cls = distributed_optimizer_class(optimizer.__class__, op=op,
-                                      compression=compression)
+    cls = distributed_optimizer_class(
+        optimizer.__class__, op=op, compression=compression,
+        backward_passes_per_step=backward_passes_per_step)
     return cls.from_config(optimizer.get_config())

@@ -204,6 +204,23 @@ class TestTFCompression:
         assert g.dtype == tf.float32
         np.testing.assert_allclose(g.numpy(), exact, rtol=1e-3)
 
+    def test_backward_passes_per_step_aggregates(self, hvd):
+        import horovod_tpu.tensorflow as hvd_tf
+
+        v = tf.Variable([0.0])
+        opt = hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(1.0), backward_passes_per_step=3)
+        # two accumulation passes apply nothing...
+        for g in ([1.0], [2.0]):
+            opt.apply_gradients([(tf.constant(g), v)])
+            np.testing.assert_allclose(v.numpy(), [0.0])
+        # ...the third applies the mean of the window: (1+2+3)/3 = 2
+        opt.apply_gradients([(tf.constant([3.0]), v)])
+        np.testing.assert_allclose(v.numpy(), [-2.0])
+        # next window starts fresh
+        opt.apply_gradients([(tf.constant([6.0]), v)])
+        np.testing.assert_allclose(v.numpy(), [-2.0])
+
     def test_optimizer_compression_trains(self, hvd):
         import horovod_tpu.tensorflow as hvd_tf
 
