@@ -224,6 +224,8 @@ def _jax_train_fn(store, run_id, spec, num_proc):
         # epoch metric averaged across ranks (MetricAverageCallback role)
         history.append(float(np.mean(hvd.allreduce(
             np.asarray(losses, np.float32), hvd.Average))))
+        if spec.get("verbose") and rank == 0:
+            print(f"epoch {epoch}: loss {history[-1]:.4f}")
         if xv is not None:
             # row-weighted global mean: shards differ by up to one row
             part = np.asarray([
@@ -278,6 +280,7 @@ class JaxEstimator(DataFrameFitMixin):
             "epochs": p.epochs,
             "shuffle": p.shuffle,
             "seed": p.seed,
+            "verbose": p.verbose,
             "n_total": n_train,
             "n_val": n_val,
         }
@@ -360,6 +363,8 @@ def _torch_train_fn(store, run_id, spec, num_proc):
             losses.append(float(loss.detach()))
         avg = hvd.allreduce(torch.tensor(np.mean(losses)), op=hvd.Average)
         history.append(float(avg))
+        if spec.get("verbose") and rank == 0:
+            print(f"epoch {epoch}: loss {history[-1]:.4f}")
         if xv is not None:
             with torch.no_grad():
                 vloss = float(loss_fn(model(xv), yv)) * len(xv)
@@ -405,6 +410,7 @@ class TorchEstimator(DataFrameFitMixin):
             "epochs": p.epochs,
             "shuffle": p.shuffle,
             "seed": p.seed,
+            "verbose": p.verbose,
             "n_total": n_train,
             "n_val": n_val,
         }

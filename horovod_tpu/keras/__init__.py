@@ -195,10 +195,12 @@ def load_model(filepath, custom_objects=None, compression=None):
         if (isinstance(cls, type)
                 and issubclass(cls, tf.keras.optimizers.Optimizer)
                 and cls is not tf.keras.optimizers.Optimizer):
-            objs.setdefault(name, distributed_optimizer_class(cls))
+            objs.setdefault(name, distributed_optimizer_class(
+                cls, compression=compression))
     model = tf.keras.models.load_model(filepath, custom_objects=objs)
     if model.optimizer is not None and not getattr(
             model.optimizer, "_hvd_wrapped", False):
         # saved from an unwrapped optimizer: wrap it now
-        model.optimizer = DistributedOptimizer(model.optimizer)
+        model.optimizer = DistributedOptimizer(model.optimizer,
+                                               compression=compression)
     return model
