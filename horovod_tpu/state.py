@@ -64,12 +64,15 @@ def broadcast_object(obj: Any, root_rank: int = 0, name: str = None) -> Any:
         payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
     else:
         payload = np.zeros((0,), np.uint8)
-    length = C.broadcast(np.asarray(payload.size, np.int64), root_rank)
+    length = C.broadcast(np.asarray(payload.size, np.int64), root_rank,
+                         name=f"{name}.len" if name else None)
     n = int(length)
     send = np.zeros((n,), np.uint8)
     if me_is_root:
         send[:] = payload
-    data = np.asarray(C.broadcast(send, root_rank), np.uint8)
+    data = np.asarray(C.broadcast(send, root_rank,
+                                  name=f"{name}.data" if name else None),
+                      np.uint8)
     return pickle.loads(data.tobytes())
 
 
@@ -83,9 +86,12 @@ def allgather_object(obj: Any, name: str = None) -> list:
     pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
     payload = np.frombuffer(buf.getvalue(), dtype=np.uint8)
     lengths = np.asarray(
-        C.allgather(np.asarray([payload.size], np.int64)), np.int64
+        C.allgather(np.asarray([payload.size], np.int64),
+                    name=f"{name}.len" if name else None), np.int64
     )
-    data = np.asarray(C.allgather(payload), np.uint8)
+    data = np.asarray(C.allgather(payload,
+                                  name=f"{name}.data" if name else None),
+                      np.uint8)
     out = []
     off = 0
     for n in lengths:

@@ -134,10 +134,17 @@ _timeline: Optional[Timeline] = None
 
 
 def start_timeline(path: str, mark_cycles: bool = False) -> Timeline:
-    """``hvd.start_timeline`` parity (``common/basics.py``)."""
+    """``hvd.start_timeline`` parity (``common/basics.py``).
+
+    ``mark_cycles`` exports ``HOROVOD_TIMELINE_MARK_CYCLES`` so the
+    native control plane (which owns the negotiation cycles) emits a
+    cycle tick per background iteration when it initializes — the
+    reference's flag reaches its C++ core the same way."""
     global _timeline
     if _timeline is not None:
         raise ValueError("timeline already started")
+    if mark_cycles:
+        os.environ["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
     _timeline = Timeline(path)
     return _timeline
 
