@@ -93,11 +93,13 @@ def allreduce_async(tensor, average=None, name=None, op=None,
                            prescale_factor=prescale_factor,
                            postscale_factor=postscale_factor)
 
+    shape = tuple(tensor.shape)
+
     def post(a):
         out = _from_numpy(np.asarray(a))
         if compression is not None:
             out = compression.decompress(out, ctx)
-        return out
+        return out.reshape(shape)  # wire promotes 0-d to (1,)
 
     return _register(jh, post)
 
@@ -115,7 +117,9 @@ def allreduce_async_(tensor, average=None, name=None, op=None, **kw) -> int:
 
         def post_inplace(a, _post=post):
             out = _post(a)
-            tensor.data.copy_(out.to(tensor.dtype))
+            # the data plane promotes 0-d scalars to shape (1,) on the
+            # wire (e.g. BatchNorm's num_batches_tracked) — restore
+            tensor.data.copy_(out.to(tensor.dtype).reshape(tensor.shape))
             return tensor
 
         _inflight[h] = (jh, post_inplace)
@@ -148,7 +152,10 @@ def allgather(tensor, name=None) -> torch.Tensor:
 
 def broadcast_async(tensor, root_rank, name=None) -> int:
     jh = C.broadcast_async(_to_numpy(tensor), root_rank, name=name)
-    return _register(jh, lambda a: _from_numpy(np.asarray(a)))
+    shape = tuple(tensor.shape)
+    # wire promotes 0-d to (1,): restore the caller's shape
+    return _register(
+        jh, lambda a: _from_numpy(np.asarray(a)).reshape(shape))
 
 
 def broadcast(tensor, root_rank, name=None) -> torch.Tensor:
@@ -162,7 +169,9 @@ def broadcast_async_(tensor, root_rank, name=None) -> int:
 
         def post_inplace(a, _post=post):
             out = _post(a)
-            tensor.data.copy_(out.to(tensor.dtype))
+            # the data plane promotes 0-d scalars to shape (1,) on the
+            # wire (e.g. BatchNorm's num_batches_tracked) — restore
+            tensor.data.copy_(out.to(tensor.dtype).reshape(tensor.shape))
             return tensor
 
         _inflight[h] = (jh, post_inplace)

@@ -120,6 +120,16 @@ gathered = hvd.allgather(flat.unsqueeze(0))
 assert torch.allclose(gathered[0], gathered[1], atol=1e-6), \
     (gathered[0] - gathered[1]).abs().max()
 
+# --- 0-d tensors across the wire (BatchNorm num_batches_tracked) -------
+bn = torch.nn.BatchNorm1d(4)
+bn(torch.randn(8, 4))  # num_batches_tracked becomes a 0-d int64 == 1
+bn.num_batches_tracked.fill_(rank + 3)
+hvd.broadcast_parameters(bn.state_dict(), root_rank=0)
+assert bn.num_batches_tracked.shape == ()  # shape restored, not (1,)
+assert int(bn.num_batches_tracked) == 3
+scalar = hvd.allreduce(torch.tensor(float(rank)), op=hvd.Sum)
+assert scalar.shape == () and float(scalar) == 1.0, scalar
+
 # --- DataLoader sharding + lockstep across real processes --------------
 from horovod_tpu.data import DataLoader  # noqa: E402
 
