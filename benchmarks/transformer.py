@@ -43,6 +43,10 @@ def main() -> None:
                     choices=["reference", "flash"])
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize layers in backward (jax.checkpoint)")
+    ap.add_argument("--remat-policy", default="full",
+                    choices=["full", "dots"],
+                    help="full: recompute everything; dots: keep matmul "
+                         "outputs, recompute elementwise only")
     ap.add_argument("--n-experts", type=int, default=0,
                     help="MoE experts per layer (0 = dense MLP)")
     ap.add_argument("--num-iters", type=int, default=5)
@@ -60,6 +64,7 @@ def main() -> None:
         vocab_size=args.vocab, d_model=args.d_model, n_heads=args.n_heads,
         n_layers=args.n_layers, d_ff=args.d_ff, max_seq=args.seq,
         attention_impl=args.attention, remat=args.remat,
+        remat_policy=args.remat_policy,
         n_experts=args.n_experts,
     )
     params = T.init_params(jax.random.PRNGKey(0), cfg)

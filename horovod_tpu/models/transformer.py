@@ -53,6 +53,11 @@ class TransformerConfig:
     # FLOPs for O(n_layers) less HBM — the standard long-context /
     # big-batch lever on TPU where HBM, not MXU, binds.
     remat: bool = False
+    # Remat granularity: "full" recomputes everything (max memory
+    # savings); "dots" keeps matmul outputs resident and recomputes only
+    # the cheap elementwise work (jax checkpoint_dots policy) — much less
+    # recompute when HBM still fits the dot outputs.
+    remat_policy: str = "full"
 
     @property
     def head_dim(self) -> int:
@@ -231,6 +236,16 @@ def _moe_mlp(x, p, cfg: TransformerConfig):
     return y * gate[..., None].astype(cfg.dtype)
 
 
+def _remat(layer, cfg: TransformerConfig):
+    if cfg.remat_policy == "full":
+        return jax.checkpoint(layer)
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint(
+            layer, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}; "
+                     "expected 'full' or 'dots'")
+
+
 def forward(params: Dict, tokens, cfg: TransformerConfig):
     """Logits for next-token prediction.  ``tokens``: (B, S) int32."""
     x = params["embed"].astype(cfg.dtype)[tokens]
@@ -246,7 +261,7 @@ def forward(params: Dict, tokens, cfg: TransformerConfig):
         return x, None
 
     if cfg.remat:
-        layer = jax.checkpoint(layer)
+        layer = _remat(layer, cfg)
     x, _ = lax.scan(layer, x, params["layers"])
     x = _rmsnorm(x, params["ln_f"])
     return jnp.einsum("bsd,dv->bsv", x, params["head"].astype(cfg.dtype)).astype(
@@ -310,7 +325,7 @@ def pipelined_forward(params: Dict, tokens, cfg: TransformerConfig, *,
         return x, None
 
     if cfg.remat:
-        layer = jax.checkpoint(layer)
+        layer = _remat(layer, cfg)
 
     def stage_fn(lp_stack, xb):
         out, _ = lax.scan(layer, xb, lp_stack)

@@ -91,13 +91,16 @@ class TestTransformer:
 
         params = T.init_params(jax.random.PRNGKey(0), self.CFG)
         batch = T.synthetic_batch(0, self.CFG, batch=2)
-        cfg_r = dataclasses.replace(self.CFG, remat=True)
         l0, g0 = jax.value_and_grad(lambda p: T.loss_fn(p, batch, self.CFG))(params)
-        l1, g1 = jax.value_and_grad(lambda p: T.loss_fn(p, batch, cfg_r))(params)
-        assert jnp.allclose(l0, l1, atol=1e-6)
-        for a, b in zip(jax.tree_util.tree_leaves(g0),
-                        jax.tree_util.tree_leaves(g1)):
-            assert jnp.allclose(a, b, atol=1e-5), (a - b).max()
+        for policy in ("full", "dots"):
+            cfg_r = dataclasses.replace(self.CFG, remat=True,
+                                        remat_policy=policy)
+            l1, g1 = jax.value_and_grad(
+                lambda p: T.loss_fn(p, batch, cfg_r))(params)
+            assert jnp.allclose(l0, l1, atol=1e-6)
+            for a, b in zip(jax.tree_util.tree_leaves(g0),
+                            jax.tree_util.tree_leaves(g1)):
+                assert jnp.allclose(a, b, atol=1e-5), (policy, (a - b).max())
 
     def test_moe_forward(self):
         cfg = T.TransformerConfig(
