@@ -192,8 +192,173 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale, block_q: int, block_k: int):
     return o.reshape(B, H, S, D), lse[:, 0, :].reshape(B, H, S)
 
 
+def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                           dk_ref, dv_ref, dk_acc, dv_acc,
+                           *, block_q: int, block_k: int, causal: bool,
+                           scale: float, num_q: int):
+    """Grid: (BH, num_k_blocks, num_q_blocks); Q innermost so the dk/dv
+    scratch accumulates across Q steps for one K block."""
+    ik = pl.program_id(1)
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = True
+    if causal:  # Q blocks strictly above the diagonal contribute nothing
+        run = iq * block_q + block_q - 1 >= ik * block_k
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]                      # (block_q, d) native dtype
+        do = do_ref[0]                    # (block_q, d)
+        k = k_ref[0]                      # (block_k, d)
+        v = v_ref[0]
+        lse = lse_ref[0, 0, :]            # (block_q,) f32
+        delta = delta_ref[0, 0, :]        # (block_q,) f32
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = iq * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ik * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])     # (block_q, block_k) f32
+        # dv_j += p^T do_i
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dp = do v^T ; ds = p * (dp - delta) * scale
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        # dk_j += ds^T q_i
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(iq == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                         dq_ref, dq_acc,
+                         *, block_q: int, block_k: int, causal: bool,
+                         scale: float, num_k: int):
+    """Grid: (BH, num_q_blocks, num_k_blocks); K innermost, dq scratch
+    accumulates across K steps for one Q block."""
+    iq = pl.program_id(1)
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    run = True
+    if causal:
+        run = ik * block_k <= iq * block_q + block_q - 1
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]
+        do = do_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        lse = lse_ref[0, 0, :]
+        delta = delta_ref[0, 0, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = iq * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ik * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dq_acc[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ik == num_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(causal, scale, block_q, block_k, q, k, v, o, lse, do):
+    """Fused Pallas backward: two tiled kernels (dk/dv then dq), O(block)
+    VMEM, no (S, block_k) f32 materialization in HBM."""
+    B, H, S, D = q.shape
+    T = k.shape[2]
+    nq, nk = S // block_q, T // block_k
+    qr = q.reshape(B * H, S, D)
+    kr = k.reshape(B * H, T, D)
+    vr = v.reshape(B * H, T, D)
+    dor = do.reshape(B * H, S, D).astype(q.dtype)
+    # delta_i = rowsum(do * o); same (BH, 8, S) sublane-replicated layout
+    # as the forward's LSE output.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(
+        delta.reshape(B * H, 1, S), (B * H, 8, S)).astype(jnp.float32)
+    lse_t = jnp.broadcast_to(
+        lse.reshape(B * H, 1, S), (B * H, 8, S)).astype(jnp.float32)
+
+    q_spec_by_q = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0))
+    q_spec_by_k = pl.BlockSpec((1, block_q, D), lambda b, j, i: (b, i, 0))
+    k_spec_by_q = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0))
+    k_spec_by_k = pl.BlockSpec((1, block_k, D), lambda b, j, i: (b, j, 0))
+    row_by_q = pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i))
+    row_by_k = pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkdv_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale,
+                          num_q=nq),
+        grid=(B * H, nk, nq),
+        in_specs=[q_spec_by_k, q_spec_by_k, row_by_k, row_by_k,
+                  k_spec_by_k, k_spec_by_k],
+        out_specs=[k_spec_by_k, k_spec_by_k],
+        out_shape=[jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, T, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=_use_interpret(),
+    )(qr, dor, lse_t, delta, kr, vr)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
+                          block_k=block_k, causal=causal, scale=scale,
+                          num_k=nk),
+        grid=(B * H, nq, nk),
+        in_specs=[q_spec_by_q, q_spec_by_q, row_by_q, row_by_q,
+                  k_spec_by_q, k_spec_by_q],
+        out_specs=q_spec_by_q,
+        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=_use_interpret(),
+    )(qr, dor, lse_t, delta, kr, vr)
+
+    return (dq.reshape(B, H, S, D), dk.reshape(B, H, T, D),
+            dv.reshape(B, H, T, D))
+
+
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
-    """Analytic flash backward from the saved LSE, scanned over K blocks:
+    """Flash backward from the saved LSE.
+
+    Tileable shapes run the fused Pallas kernels (above): O(block) VMEM,
+    no (S, block) f32 score materialization in HBM.  Untileable shapes
+    fall back to the analytic XLA form scanned over K blocks:
 
         p_ij = exp(q_i k_j^T * scale - lse_i)
         dv_j = p^T do ;  dp = do v^T ;  ds = p * (dp - rowsum(do * o))
@@ -203,6 +368,10 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
     B, H, S, D = q.shape
     T = k.shape[2]
     scale = _sm_scale(q, sm_scale)
+    bq = min(block_q, S)
+    bkp = min(block_k, T)
+    if _PALLAS and S % bq == 0 and T % bkp == 0 and D % 8 == 0:
+        return _flash_bwd_pallas(causal, scale, bq, bkp, q, k, v, o, lse, do)
     bk = min(block_k, T)
     if T % bk:
         bk = T
