@@ -477,14 +477,28 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
         o, lse, ks, vs = carry
         src = (me - s_idx) % P  # which shard's K/V we hold this step
         if causal:
-            allowed = jnp.where(
-                src < me,
-                jnp.ones((S, S), bool),
-                jnp.where(src == me, cols <= rows, jnp.zeros((S, S), bool)),
-            )[None, None]
+            # Chunks from later shards (src > me) are FULLY masked; a
+            # lax.cond skips their attention compute entirely instead of
+            # computing it and discarding through the -inf merge — for a
+            # causal ring that's ~half of all (shard, step) pairs, so
+            # ~2x less chunk compute.  Differentiable: the skipped
+            # branch is constant, and those chunks contribute exactly
+            # nothing to the merged output either way.
+            def live(qq, kk, vv):
+                allowed = jnp.where(
+                    src < me, jnp.ones((S, S), bool), cols <= rows,
+                )[None, None]
+                return _chunk_attn(qq, kk, vv, allowed, scale)
+
+            def dead(qq, kk, vv):
+                # derive from qq so the outputs are varying-over-axis
+                # like live's (shard_map vma typing)
+                z = qq.astype(jnp.float32) * 0.0
+                return z, z[..., 0] + NEG_INF
+
+            o_c, lse_c = lax.cond(src <= me, live, dead, q, ks, vs)
         else:
-            allowed = None
-        o_c, lse_c = _chunk_attn(q, ks, vs, allowed, scale)
+            o_c, lse_c = _chunk_attn(q, ks, vs, None, scale)
         lse_new = jnp.logaddexp(lse, lse_c)
         o = (o * jnp.exp(lse - lse_new)[..., None]
              + o_c * jnp.exp(lse_c - lse_new)[..., None])
