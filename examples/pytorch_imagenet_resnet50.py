@@ -82,6 +82,7 @@ def main():
     if rank == 0 and os.path.exists(args.checkpoint):
         ckpt = torch.load(args.checkpoint, weights_only=False)
         model.load_state_dict(ckpt["model"])
+        opt.load_state_dict(ckpt["optimizer"])  # momentum buffers too
         start_epoch = ckpt["epoch"] + 1
     start_epoch = hvd.broadcast_object(start_epoch, root_rank=0)
     hvd.broadcast_parameters(model.state_dict(), root_rank=0)
@@ -111,8 +112,9 @@ def main():
             torch.tensor(float(np.mean(losses))), op=hvd.Average))
         if rank == 0:
             print(f"epoch {epoch}: loss {avg:.4f} lr {lr:.4f}")
-            torch.save({"model": model.state_dict(), "epoch": epoch},
-                       args.checkpoint)
+            torch.save({"model": model.state_dict(),
+                        "optimizer": opt.state_dict(),
+                        "epoch": epoch}, args.checkpoint)
 
     hvd.shutdown()
 

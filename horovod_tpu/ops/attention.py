@@ -369,11 +369,10 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
     T = k.shape[2]
     scale = _sm_scale(q, sm_scale)
     bq = min(block_q, S)
-    bkp = min(block_k, T)
-    if _PALLAS and S % bq == 0 and T % bkp == 0 and D % 8 == 0:
-        return _flash_bwd_pallas(causal, scale, bq, bkp, q, k, v, o, lse, do)
     bk = min(block_k, T)
-    if T % bk:
+    if _PALLAS and S % bq == 0 and T % bk == 0 and D % 8 == 0:
+        return _flash_bwd_pallas(causal, scale, bq, bk, q, k, v, o, lse, do)
+    if T % bk:  # analytic fallback: widen to one K block
         bk = T
     nk = T // bk
 

@@ -138,13 +138,27 @@ def start_timeline(path: str, mark_cycles: bool = False) -> Timeline:
 
     ``mark_cycles`` exports ``HOROVOD_TIMELINE_MARK_CYCLES`` so the
     native control plane (which owns the negotiation cycles) emits a
-    cycle tick per background iteration when it initializes — the
-    reference's flag reaches its C++ core the same way."""
+    cycle tick per background iteration.  The native runtime latches the
+    flag at ``hvd.init()`` — when it is already running, the export only
+    reaches FUTURE inits, so warn rather than silently no-op (the
+    launcher's ``--timeline-mark-cycles`` flag sets the env before
+    workers init and is the reliable path)."""
     global _timeline
     if _timeline is not None:
         raise ValueError("timeline already started")
     if mark_cycles:
         os.environ["HOROVOD_TIMELINE_MARK_CYCLES"] = "1"
+        from horovod_tpu import basics
+
+        if basics.is_initialized():
+            import logging
+
+            logging.getLogger("horovod_tpu").warning(
+                "start_timeline(mark_cycles=True) after init(): the "
+                "native runtime latched the flag at init, so cycle "
+                "ticks start at the NEXT init; set "
+                "HOROVOD_TIMELINE_MARK_CYCLES=1 (or use horovodrun "
+                "--timeline-mark-cycles) before init() instead")
     _timeline = Timeline(path)
     return _timeline
 
@@ -154,6 +168,8 @@ def stop_timeline() -> None:
     if _timeline is not None:
         _timeline.close()
         _timeline = None
+    # don't leak the cycle-marker request into a later, unrelated init
+    os.environ.pop("HOROVOD_TIMELINE_MARK_CYCLES", None)
 
 
 def get() -> Optional[Timeline]:

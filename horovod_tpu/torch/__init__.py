@@ -194,13 +194,18 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
     if isinstance(optimizer, torch.optim.LBFGS):
         raise ValueError("cannot broadcast torch.optim.LBFGS state")
     state_dict = optimizer.state_dict()
-    # Rank 0's structure (param groups + which state keys exist) first.
+    # Rank 0's structure (param groups + which state keys exist, with
+    # tensor shapes/dtypes so ranks MISSING that state — e.g. after a
+    # rank-0-only checkpoint restore — can materialize zero buffers and
+    # participate; the reference auto-materializes missing state too).
     meta = broadcast_object(
         {
             "param_groups": state_dict["param_groups"],
             "state_keys": {
                 pid: sorted(
-                    (k, torch.is_tensor(v))
+                    (k, torch.is_tensor(v),
+                     tuple(v.shape) if torch.is_tensor(v) else None,
+                     str(v.dtype) if torch.is_tensor(v) else None)
                     for k, v in st.items()
                 )
                 for pid, st in state_dict["state"].items()
@@ -224,16 +229,17 @@ def broadcast_optimizer_state(optimizer, root_rank: int = 0) -> None:
     for pid, keys in meta["state_keys"].items():
         st = state_dict["state"].get(pid, {})
         new_state[pid] = {}
-        for k, is_tensor in keys:
+        for k, is_tensor, shape, dtype_str in keys:
             if is_tensor:
-                if k not in st or not torch.is_tensor(st[k]):
-                    raise ValueError(
-                        "broadcast_optimizer_state requires the optimizer "
-                        "to have state on all ranks — run one step on "
-                        "dummy gradients first (the reference initializes "
-                        "missing state the same way)")
+                local = st.get(k)
+                if local is None or not torch.is_tensor(local):
+                    # materialize a same-shaped zero buffer so this rank
+                    # submits a matching collective; the broadcast
+                    # overwrites it with root's values
+                    local = torch.zeros(
+                        shape, dtype=getattr(torch, dtype_str.split(".")[-1]))
                 new_state[pid][k] = broadcast(
-                    st[k], root_rank, name=f"broadcast.opt.{pid}.{k}")
+                    local, root_rank, name=f"broadcast.opt.{pid}.{k}")
             else:
                 new_state[pid][k] = scalars[(pid, k)]
     state_dict["state"] = new_state
