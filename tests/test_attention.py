@@ -200,6 +200,31 @@ class TestUlyssesAttention:
         with pytest.raises(Exception, match="divisible|ring_attention"):
             self._run(q, k, v, False)
 
+    def test_flash_inner_differentiable_under_shard_map(self):
+        """The Pallas custom-vjp kernels must transpose correctly inside
+        shard_map (the ulysses production path)."""
+        q, k, v = _qkv(b=1, h=N, s=N * 16, d=32)
+
+        def loss(q, k, v):
+            def inner(qs, ks, vs):
+                return A.ulysses_attention(qs, ks, vs, axis_name=hvd.AXIS,
+                                           causal=True, impl="flash")
+            f = spmd.shard(
+                inner,
+                in_specs=(P(None, None, hvd.AXIS, None),) * 3,
+                out_specs=P(None, None, hvd.AXIS, None),
+            )
+            return jnp.sum(f(q, k, v) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(A.reference_attention(q, k, v, causal=True) ** 2)
+
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-3, rtol=2e-3, err_msg=name)
+
 
 class TestTransformerIntegration:
     """attention_impl config: flash and ring must match the reference
