@@ -150,6 +150,19 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _out_sds(shape, dtype, like):
+    """ShapeDtypeStruct that inherits ``like``'s varying-over-mesh-axes
+    type, so the pallas_call type-checks inside ``shard_map`` (ring
+    attention runs the kernel per sequence shard)."""
+    try:
+        vma = jax.typeof(like).vma
+    except Exception:
+        vma = None
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _flash_fwd(q, k, v, causal: bool, sm_scale, block_q: int, block_k: int):
     B, H, S, D = q.shape
     T = k.shape[2]
@@ -179,8 +192,8 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale, block_q: int, block_k: int):
             pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, 8, S), jnp.float32),
+            _out_sds((B * H, S, D), q.dtype, q),
+            _out_sds((B * H, 8, S), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -296,9 +309,16 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _flash_bwd_pallas(causal, scale, block_q, block_k, q, k, v, o, lse, do):
+def _flash_bwd_pallas(causal, scale, block_q, block_k, q, k, v, o, lse, do,
+                      dlse=None):
     """Fused Pallas backward: two tiled kernels (dk/dv then dq), O(block)
-    VMEM, no (S, block_k) f32 materialization in HBM."""
+    VMEM, no (S, block_k) f32 materialization in HBM.
+
+    ``dlse``: optional cotangent of the LSE output (when the caller
+    differentiates through the logsumexp too, e.g. ring attention's
+    merge).  ∂lse_i/∂s_ij = p_ij, so it folds into the kernels as
+    ``delta_i -= dlse_i`` — the same place the o-path's rowsum(do·o)
+    enters."""
     B, H, S, D = q.shape
     T = k.shape[2]
     nq, nk = S // block_q, T // block_k
@@ -309,6 +329,8 @@ def _flash_bwd_pallas(causal, scale, block_q, block_k, q, k, v, o, lse, do):
     # delta_i = rowsum(do * o); same (BH, 8, S) sublane-replicated layout
     # as the forward's LSE output.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
     delta = jnp.broadcast_to(
         delta.reshape(B * H, 1, S), (B * H, 8, S)).astype(jnp.float32)
     lse_t = jnp.broadcast_to(
@@ -329,8 +351,8 @@ def _flash_bwd_pallas(causal, scale, block_q, block_k, q, k, v, o, lse, do):
         in_specs=[q_spec_by_k, q_spec_by_k, row_by_k, row_by_k,
                   k_spec_by_k, k_spec_by_k],
         out_specs=[k_spec_by_k, k_spec_by_k],
-        out_shape=[jax.ShapeDtypeStruct((B * H, T, D), k.dtype),
-                   jax.ShapeDtypeStruct((B * H, T, D), v.dtype)],
+        out_shape=[_out_sds((B * H, T, D), k.dtype, q),
+                   _out_sds((B * H, T, D), v.dtype, q)],
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=_use_interpret(),
@@ -344,7 +366,7 @@ def _flash_bwd_pallas(causal, scale, block_q, block_k, q, k, v, o, lse, do):
         in_specs=[q_spec_by_q, q_spec_by_q, row_by_q, row_by_q,
                   k_spec_by_q, k_spec_by_q],
         out_specs=q_spec_by_q,
-        out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
+        out_shape=_out_sds((B * H, S, D), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=_use_interpret(),
     )(qr, dor, lse_t, delta, kr, vr)
@@ -353,7 +375,7 @@ def _flash_bwd_pallas(causal, scale, block_q, block_k, q, k, v, o, lse, do):
             dv.reshape(B, H, T, D))
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, do, dlse=None):
     """Flash backward from the saved LSE.
 
     Tileable shapes run the fused Pallas kernels (above): O(block) VMEM,
@@ -363,6 +385,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
         p_ij = exp(q_i k_j^T * scale - lse_i)
         dv_j = p^T do ;  dp = do v^T ;  ds = p * (dp - rowsum(do * o))
         dq_i += ds k_j * scale ;  dk_j = ds^T q_i * scale
+
+    ``dlse`` (cotangent of the LSE output) folds in as delta -= dlse.
     """
     q, k, v, o, lse = res
     B, H, S, D = q.shape
@@ -371,7 +395,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
     bq = min(block_q, S)
     bk = min(block_k, T)
     if _PALLAS and S % bq == 0 and T % bk == 0 and D % 8 == 0:
-        return _flash_bwd_pallas(causal, scale, bq, bk, q, k, v, o, lse, do)
+        return _flash_bwd_pallas(causal, scale, bq, bk, q, k, v, o, lse, do,
+                                 dlse=dlse)
     if T % bk:  # analytic fallback: widen to one K block
         bk = T
     nk = T // bk
@@ -379,6 +404,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
     qf = q.astype(jnp.float32)
     dof = do.astype(jnp.float32)
     delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1)  # (B,H,S)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     rows = lax.broadcasted_iota(jnp.int32, (S, bk), 0)
 
@@ -431,6 +458,32 @@ def _fa_bwd(causal, sm_scale, block_q, block_k, res, do):
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             sm_scale: Optional[float] = None,
+                             block_q: int = 1024, block_k: int = 512):
+    """:func:`flash_attention` that also returns the per-row logsumexp as
+    a DIFFERENTIABLE output ``(o, lse)`` — the building block for merge-
+    based compositions (ring attention) whose gradients flow through the
+    lse weights; the backward folds the lse cotangent in as
+    ``delta -= dlse``."""
+    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+
+
+def _fal_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _fal_bwd(causal, sm_scale, block_q, block_k, res, ct):
+    do, dlse = ct
+    return _flash_bwd(causal, sm_scale, block_q, block_k, res, do,
+                      dlse=dlse)
+
+
+flash_attention_with_lse.defvjp(_fal_fwd, _fal_bwd)
+
+
 # --- chunk attention with LSE (building block for ring) -----------------------
 
 
@@ -472,30 +525,40 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
     rows = lax.broadcasted_iota(jnp.int32, (S, S), 0)
     cols = lax.broadcasted_iota(jnp.int32, (S, S), 1)
 
+    # Chunk attention is the masked XLA form (_chunk_attn), not the
+    # Pallas kernel: a pallas_call inside the switch inside this scan
+    # inside a MODEL's layer scan trips a jax lowering-cache bug in the
+    # interpreter (KeyError: closed_call), so the kernelized chunk —
+    # flash_attention_with_lse exists for it, dlse-correct — waits on a
+    # jax fix.  XLA still fuses the masked form well.
     def step(carry, s_idx):
         o, lse, ks, vs = carry
         src = (me - s_idx) % P  # which shard's K/V we hold this step
         if causal:
-            # Chunks from later shards (src > me) are FULLY masked; a
-            # lax.cond skips their attention compute entirely instead of
-            # computing it and discarding through the -inf merge — for a
-            # causal ring that's ~half of all (shard, step) pairs, so
-            # ~2x less chunk compute.  Differentiable: the skipped
+            # Three chunk kinds by shard order — full attention to
+            # earlier shards, causal to self, and NOTHING from later
+            # shards: the dead branch skips the attention compute
+            # entirely (for a causal ring that's ~half of all
+            # (shard, step) pairs) instead of computing and discarding
+            # through the -inf merge.  Differentiable: the skipped
             # branch is constant, and those chunks contribute exactly
             # nothing to the merged output either way.
-            def live(qq, kk, vv):
-                allowed = jnp.where(
-                    src < me, jnp.ones((S, S), bool), cols <= rows,
-                )[None, None]
-                return _chunk_attn(qq, kk, vv, allowed, scale)
+            def full(qq, kk, vv):
+                return _chunk_attn(qq, kk, vv, None, scale)
+
+            def self_causal(qq, kk, vv):
+                return _chunk_attn(qq, kk, vv,
+                                   (cols <= rows)[None, None], scale)
 
             def dead(qq, kk, vv):
                 # derive from qq so the outputs are varying-over-axis
-                # like live's (shard_map vma typing)
+                # like the live branches' (shard_map vma typing)
                 z = qq.astype(jnp.float32) * 0.0
                 return z, z[..., 0] + NEG_INF
 
-            o_c, lse_c = lax.cond(src <= me, live, dead, q, ks, vs)
+            idx = jnp.where(src < me, 2, jnp.where(src == me, 1, 0))
+            o_c, lse_c = lax.switch(idx, (dead, self_causal, full),
+                                    q, ks, vs)
         else:
             o_c, lse_c = _chunk_attn(q, ks, vs, None, scale)
         lse_new = jnp.logaddexp(lse, lse_c)

@@ -88,6 +88,37 @@ class TestFlashBackward:
         assert np.isfinite(np.asarray(g)).all()
 
 
+class TestFlashWithLse:
+    def test_outputs_and_both_cotangents(self):
+        """(o, lse) forward matches the reference, and gradients through
+        BOTH outputs (the dlse term: delta -= dlse) are exact."""
+        q, k, v = _qkv(b=1, h=2, s=128, d=32)
+
+        def loss_flash(q, k, v):
+            o, lse = A.flash_attention_with_lse(q, k, v, True)
+            return jnp.sum(o.astype(jnp.float32) ** 2) + jnp.sum(lse ** 2)
+
+        def loss_ref(q, k, v):
+            o, lse = A._reference_attention_lse(
+                q, k, v, True, A._sm_scale(q, None))
+            return jnp.sum(o.astype(jnp.float32) ** 2) + jnp.sum(lse ** 2)
+
+        o, lse = jax.jit(
+            lambda q, k, v: A.flash_attention_with_lse(q, k, v, True)
+        )(q, k, v)
+        o_r, lse_r = A._reference_attention_lse(
+            q, k, v, True, A._sm_scale(q, None))
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_r),
+                                   atol=2e-4, rtol=2e-4)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_r),
+                                   atol=2e-4, rtol=2e-4)
+        g = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-3, rtol=2e-3, err_msg=name)
+
+
 class TestRingAttention:
     def _run_ring(self, q, k, v, causal):
         """q/k/v are (B, H, S_total, D); shard the sequence over the mesh."""
