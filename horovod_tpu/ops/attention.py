@@ -13,10 +13,13 @@ point-to-points.
 Layout convention: ``(batch, heads, seq, head_dim)`` f32/bf16.
 
 * :func:`flash_attention` — online-softmax tiled attention, one Pallas
-  kernel; O(block) VMEM, saves the logsumexp for the backward.  Backward
-  is the standard analytic flash backward (dq/dk/dv from the saved LSE)
-  expressed blockwise in XLA — recomputation happens per K-block inside a
-  ``lax.scan`` so memory stays O(S·block).
+  kernel; O(block) VMEM, saves the logsumexp for the backward.  The
+  backward is a pair of fused Pallas kernels (dk/dv with Q innermost,
+  dq with K innermost) computing the analytic flash gradients from the
+  saved LSE — no (S, block) score materialization in HBM; untileable
+  shapes fall back to the same math expressed blockwise in XLA.
+  :func:`flash_attention_with_lse` additionally exposes the LSE as a
+  differentiable output (dlse folds in as ``delta -= dlse``).
 * :func:`ring_attention` — each device holds a contiguous sequence shard;
   K/V shards rotate around the ring with ``lax.ppermute`` while the local
   Q accumulates partial attention, merged by logsumexp weighting.  Causal
