@@ -20,11 +20,20 @@ Layout convention: ``(batch, heads, seq, head_dim)`` f32/bf16.
   shapes fall back to the same math expressed blockwise in XLA.
   :func:`flash_attention_with_lse` additionally exposes the LSE as a
   differentiable output (dlse folds in as ``delta -= dlse``).
+* :func:`flash_attention_shifted` — the same kernels with the mask as a
+  RUNTIME scalar: allowed iff ``col + shift <= row``, ``shift`` an int32
+  operand staged into SMEM.  ``shift = 0`` is ordinary causal,
+  ``shift <= -T`` is unmasked, ``shift >= S`` masks everything (the
+  kernel then yields o=0, lse=-inf, which vanishes in a logsumexp
+  merge).  This is what lets ring attention call ONE kernel per chunk
+  instead of dispatching through ``lax.switch`` (whose pallas-in-switch-
+  in-scan nesting trips a jax lowering-cache bug, see ``ring_attention``).
 * :func:`ring_attention` — each device holds a contiguous sequence shard;
   K/V shards rotate around the ring with ``lax.ppermute`` while the local
-  Q accumulates partial attention, merged by logsumexp weighting.  Causal
-  masking degrades gracefully: a fully-masked chunk contributes weight
-  exp(-1e30 - lse) == 0.
+  Q accumulates partial attention, merged by logsumexp weighting.  Each
+  chunk runs the Pallas flash kernel with ``shift = (src - me) * S_kv``:
+  earlier shards come out fully attended, the diagonal shard causally,
+  later shards fully masked — one code path, no per-kind dispatch.
 * :func:`ulysses_attention` — the all-to-all flavor of sequence
   parallelism (DeepSpeed-Ulysses pattern): one ``lax.all_to_all``
   reshards from sequence-sharded to head-sharded, every device computes
@@ -52,39 +61,57 @@ def _sm_scale(q, sm_scale):
     return 1.0 / np.sqrt(q.shape[-1]) if sm_scale is None else sm_scale
 
 
+def _float0_like(x):
+    """Cotangent for an integer-dtype primal (custom_vjp convention)."""
+    return np.zeros(np.shape(x), dtype=jax.dtypes.float0)
+
+
 # --- reference (oracle) -------------------------------------------------------
 
 
-def _reference_attention_lse(q, k, v, causal, scale):
-    """One O(S^2) score computation -> (output, logsumexp)."""
+def _reference_attention_lse(q, k, v, shift, scale):
+    """One O(S^2) score computation -> (output, logsumexp).
+
+    ``shift``: None for unmasked, else a (traced or static) int scalar —
+    position (row, col) is attended iff ``col + shift <= row``.  shift=0
+    is standard causal."""
     scores = jnp.einsum("bhsd,bhtd->bhst", q, k).astype(jnp.float32) * scale
-    if causal:
+    if shift is not None:
         S, T = scores.shape[-2], scores.shape[-1]
         rows = lax.broadcasted_iota(jnp.int32, (S, T), 0)
         cols = lax.broadcasted_iota(jnp.int32, (S, T), 1)
-        scores = jnp.where(cols <= rows, scores, NEG_INF)
-    lse = jax.nn.logsumexp(scores, axis=-1)
-    w = jnp.exp(scores - lse[..., None])
-    o = jnp.einsum("bhst,bhtd->bhsd", w.astype(v.dtype), v)
+        scores = jnp.where(cols + shift <= rows, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    m = jnp.maximum(m, NEG_INF)  # fully-masked rows: stay finite
+    p = jnp.where(scores > NEG_INF * 0.5, jnp.exp(scores - m), 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    l_safe = jnp.where(l > 0, l, 1.0)
+    o = jnp.einsum("bhst,bhtd->bhsd", (p / l_safe).astype(v.dtype), v)
+    lse = jnp.where(l[..., 0] > 0, m[..., 0] + jnp.log(l_safe[..., 0]),
+                    NEG_INF)
     return o, lse
 
 
 def reference_attention(q, k, v, *, causal: bool = False,
                         sm_scale: Optional[float] = None):
     """O(S^2)-memory oracle used by tests and as the small-shape fallback."""
-    o, _ = _reference_attention_lse(q, k, v, causal, _sm_scale(q, sm_scale))
+    o, _ = _reference_attention_lse(q, k, v, 0 if causal else None,
+                                    _sm_scale(q, sm_scale))
     return o
 
 
 # --- Pallas forward kernel ----------------------------------------------------
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+def _flash_fwd_kernel(shift_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
                       acc_ref, m_ref, l_ref,
-                      *, block_q: int, block_k: int, causal: bool,
+                      *, block_q: int, block_k: int, masked: bool,
                       scale: float, num_k: int):
     """Grid: (batch*heads, num_q_blocks, num_k_blocks); K innermost, so the
-    (acc, m, l) scratch carries the online softmax across K steps."""
+    (acc, m, l) scratch carries the online softmax across K steps.
+
+    ``shift_ref`` is a (1,) int32 in SMEM: position (row, col) attends iff
+    ``col + shift <= row`` (only read when ``masked``)."""
     iq = pl.program_id(1)
     ik = pl.program_id(2)
 
@@ -94,10 +121,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # Causal: K blocks strictly above the diagonal contribute nothing.
+    # K blocks entirely above the shifted diagonal contribute nothing.
     run = True
-    if causal:
-        run = ik * block_k <= iq * block_q + block_q - 1
+    if masked:
+        run = ik * block_k + shift_ref[0] <= iq * block_q + block_q - 1
 
     @pl.when(run)
     def _step():
@@ -109,16 +136,21 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (block_q, block_k)
-        if causal:
+        if masked:
             rows = iq * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ik * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows, s, NEG_INF)
+            s = jnp.where(cols + shift_ref[0] <= rows, s, NEG_INF)
         m_prev = m_ref[:, :1]                               # (block_q, 1)
         m_cur = jnp.max(s, axis=-1, keepdims=True)          # (block_q, 1)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)                              # (block_q, block_k)
+        if masked:
+            # Rows fully masked so far have m_new == NEG_INF; exp(s-m_new)
+            # would be exp(0)=1 garbage — zero those lanes explicitly.
+            p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - m_new), 0.0)
+        else:
+            p = jnp.exp(s - m_new)                          # (block_q, block_k)
         alpha = jnp.exp(m_prev - m_new)                     # (block_q, 1)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         # P·V in the value dtype (bf16 MXU) with f32 accumulation; exact
@@ -135,8 +167,10 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_safe = jnp.where(l > 0, l, 1.0)
         o_ref[0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
         # LSE layout (BH, 8, S): 8 replicated sublanes satisfy the TPU
-        # (÷8, ÷128) tile constraint; caller reads sublane 0.
-        lse = m_ref[:, 0] + jnp.log(l_safe[:, 0])  # (block_q,)
+        # (÷8, ÷128) tile constraint; caller reads sublane 0.  Fully
+        # masked rows (l == 0) report -inf so they vanish in merges.
+        lse = jnp.where(l[:, 0] > 0, m_ref[:, 0] + jnp.log(l_safe[:, 0]),
+                        NEG_INF)  # (block_q,)
         lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
 
 
@@ -166,7 +200,32 @@ def _out_sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _flash_fwd(q, k, v, causal: bool, sm_scale, block_q: int, block_k: int):
+def _shift_operand(shift, like):
+    """(1,) int32 SMEM operand for the kernels (0 when unmasked)."""
+    arr = jnp.asarray(0 if shift is None else shift, jnp.int32).reshape(1)
+    try:
+        vma = set(jax.typeof(like).vma)
+        have = set(jax.typeof(arr).vma)
+    except Exception:
+        return arr
+    need = tuple(vma - have)
+    if need:  # match the tensor operands' varying-over-axis type
+        arr = jax.lax.pvary(arr, need)
+    return arr
+
+
+_SMEM_SPEC = None
+
+
+def _smem_spec():
+    global _SMEM_SPEC
+    if _SMEM_SPEC is None:
+        _SMEM_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+    return _SMEM_SPEC
+
+
+def _flash_fwd(q, k, v, shift, sm_scale, block_q: int, block_k: int):
+    """shift: None (no mask) or int scalar (traced ok) — shifted causal."""
     B, H, S, D = q.shape
     T = k.shape[2]
     block_q = min(block_q, S)
@@ -174,11 +233,11 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale, block_q: int, block_k: int):
     scale = _sm_scale(q, sm_scale)
     if (not _PALLAS or S % block_q or T % block_k
             or D % 8):  # fall back for shapes the kernel can't tile
-        return _reference_attention_lse(q, k, v, causal, scale)
+        return _reference_attention_lse(q, k, v, shift, scale)
     nq, nk = S // block_q, T // block_k
     kernel = functools.partial(
         _flash_fwd_kernel, block_q=block_q, block_k=block_k,
-        causal=causal, scale=scale, num_k=nk)
+        masked=shift is not None, scale=scale, num_k=nk)
     qr = q.reshape(B * H, S, D)
     kr = k.reshape(B * H, T, D)
     vr = v.reshape(B * H, T, D)
@@ -186,6 +245,7 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale, block_q: int, block_k: int):
         kernel,
         grid=(B * H, nq, nk),
         in_specs=[
+            _smem_spec(),
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
@@ -204,13 +264,13 @@ def _flash_fwd(q, k, v, causal: bool, sm_scale, block_q: int, block_k: int):
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=_use_interpret(),
-    )(qr, kr, vr)
+    )(_shift_operand(shift, q), qr, kr, vr)
     return o.reshape(B, H, S, D), lse[:, 0, :].reshape(B, H, S)
 
 
-def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                           dk_ref, dv_ref, dk_acc, dv_acc,
-                           *, block_q: int, block_k: int, causal: bool,
+def _flash_bwd_dkdv_kernel(shift_ref, q_ref, do_ref, lse_ref, delta_ref,
+                           k_ref, v_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                           *, block_q: int, block_k: int, masked: bool,
                            scale: float, num_q: int):
     """Grid: (BH, num_k_blocks, num_q_blocks); Q innermost so the dk/dv
     scratch accumulates across Q steps for one K block."""
@@ -223,8 +283,8 @@ def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
     run = True
-    if causal:  # Q blocks strictly above the diagonal contribute nothing
-        run = iq * block_q + block_q - 1 >= ik * block_k
+    if masked:  # Q blocks entirely above the shifted diagonal: nothing
+        run = iq * block_q + block_q - 1 >= ik * block_k + shift_ref[0]
 
     @pl.when(run)
     def _step():
@@ -237,13 +297,18 @@ def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
+        if masked:
             rows = iq * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ik * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])     # (block_q, block_k) f32
+            s = jnp.where(cols + shift_ref[0] <= rows, s, NEG_INF)
+            # exp(NEG_INF - NEG_INF) == 1 for rows whose lse is -inf
+            # (fully masked): their cotangents are exactly zero, but keep
+            # p finite-clean anyway.
+            p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - lse[:, None]), 0.0)
+        else:
+            p = jnp.exp(s - lse[:, None])  # (block_q, block_k) f32
         # dv_j += p^T do_i
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -264,9 +329,9 @@ def _flash_bwd_dkdv_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                         dq_ref, dq_acc,
-                         *, block_q: int, block_k: int, causal: bool,
+def _flash_bwd_dq_kernel(shift_ref, q_ref, do_ref, lse_ref, delta_ref,
+                         k_ref, v_ref, dq_ref, dq_acc,
+                         *, block_q: int, block_k: int, masked: bool,
                          scale: float, num_k: int):
     """Grid: (BH, num_q_blocks, num_k_blocks); K innermost, dq scratch
     accumulates across K steps for one Q block."""
@@ -278,8 +343,8 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     run = True
-    if causal:
-        run = ik * block_k <= iq * block_q + block_q - 1
+    if masked:
+        run = ik * block_k + shift_ref[0] <= iq * block_q + block_q - 1
 
     @pl.when(run)
     def _step():
@@ -292,13 +357,15 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
+        if masked:
             rows = iq * block_q + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
             cols = ik * block_k + lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(cols <= rows, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+            s = jnp.where(cols + shift_ref[0] <= rows, s, NEG_INF)
+            p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - lse[:, None]), 0.0)
+        else:
+            p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -312,7 +379,7 @@ def _flash_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _flash_bwd_pallas(causal, scale, block_q, block_k, q, k, v, o, lse, do,
+def _flash_bwd_pallas(shift, scale, block_q, block_k, q, k, v, o, lse, do,
                       dlse=None):
     """Fused Pallas backward: two tiled kernels (dk/dv then dq), O(block)
     VMEM, no (S, block_k) f32 materialization in HBM.
@@ -346,12 +413,15 @@ def _flash_bwd_pallas(causal, scale, block_q, block_k, q, k, v, o, lse, do,
     row_by_q = pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i))
     row_by_k = pl.BlockSpec((1, 8, block_q), lambda b, j, i: (b, 0, i))
 
+    masked = shift is not None
+    sh = _shift_operand(shift, q)
+
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, block_q=block_q,
-                          block_k=block_k, causal=causal, scale=scale,
+                          block_k=block_k, masked=masked, scale=scale,
                           num_q=nq),
         grid=(B * H, nk, nq),
-        in_specs=[q_spec_by_k, q_spec_by_k, row_by_k, row_by_k,
+        in_specs=[_smem_spec(), q_spec_by_k, q_spec_by_k, row_by_k, row_by_k,
                   k_spec_by_k, k_spec_by_k],
         out_specs=[k_spec_by_k, k_spec_by_k],
         out_shape=[_out_sds((B * H, T, D), k.dtype, q),
@@ -359,26 +429,26 @@ def _flash_bwd_pallas(causal, scale, block_q, block_k, q, k, v, o, lse, do,
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=_use_interpret(),
-    )(qr, dor, lse_t, delta, kr, vr)
+    )(sh, qr, dor, lse_t, delta, kr, vr)
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_q=block_q,
-                          block_k=block_k, causal=causal, scale=scale,
+                          block_k=block_k, masked=masked, scale=scale,
                           num_k=nk),
         grid=(B * H, nq, nk),
-        in_specs=[q_spec_by_q, q_spec_by_q, row_by_q, row_by_q,
+        in_specs=[_smem_spec(), q_spec_by_q, q_spec_by_q, row_by_q, row_by_q,
                   k_spec_by_q, k_spec_by_q],
         out_specs=q_spec_by_q,
         out_shape=_out_sds((B * H, S, D), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=_use_interpret(),
-    )(qr, dor, lse_t, delta, kr, vr)
+    )(sh, qr, dor, lse_t, delta, kr, vr)
 
     return (dq.reshape(B, H, S, D), dk.reshape(B, H, T, D),
             dv.reshape(B, H, T, D))
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, do, dlse=None):
+def _flash_bwd(shift, sm_scale, block_q, block_k, res, do, dlse=None):
     """Flash backward from the saved LSE.
 
     Tileable shapes run the fused Pallas kernels (above): O(block) VMEM,
@@ -390,6 +460,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do, dlse=None):
         dq_i += ds k_j * scale ;  dk_j = ds^T q_i * scale
 
     ``dlse`` (cotangent of the LSE output) folds in as delta -= dlse.
+    ``shift``: None for unmasked, else the shifted-causal int scalar.
     """
     q, k, v, o, lse = res
     B, H, S, D = q.shape
@@ -398,7 +469,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do, dlse=None):
     bq = min(block_q, S)
     bk = min(block_k, T)
     if _PALLAS and S % bq == 0 and T % bk == 0 and D % 8 == 0:
-        return _flash_bwd_pallas(causal, scale, bq, bk, q, k, v, o, lse, do,
+        return _flash_bwd_pallas(shift, scale, bq, bk, q, k, v, o, lse, do,
                                  dlse=dlse)
     if T % bk:  # analytic fallback: widen to one K block
         bk = T
@@ -417,10 +488,13 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do, dlse=None):
         ks = lax.dynamic_slice_in_dim(k, jb * bk, bk, axis=2).astype(jnp.float32)
         vs = lax.dynamic_slice_in_dim(v, jb * bk, bk, axis=2).astype(jnp.float32)
         s = jnp.einsum("bhsd,bhtd->bhst", qf, ks) * scale  # (B,H,S,bk)
-        if causal:
+        if shift is not None:
             cols = jb * bk + lax.broadcasted_iota(jnp.int32, (S, bk), 1)
-            s = jnp.where(cols <= rows, s, NEG_INF)
-        p = jnp.exp(s - lse[..., None])                     # (B,H,S,bk)
+            s = jnp.where(cols + shift <= rows, s, NEG_INF)
+            p = jnp.where(s > NEG_INF * 0.5,
+                          jnp.exp(s - lse[..., None]), 0.0)
+        else:
+            p = jnp.exp(s - lse[..., None])                 # (B,H,S,bk)
         dv = jnp.einsum("bhst,bhsd->bhtd", p, dof)
         dp = jnp.einsum("bhsd,bhtd->bhst", dof, vs)
         ds = p * (dp - delta[..., None]) * scale
@@ -445,17 +519,20 @@ def flash_attention(q, k, v, causal: bool = False,
     on CPU it runs the same kernel under the Pallas interpreter.  Shapes
     that can't tile (S % block, D % 8) silently use the XLA reference.
     """
-    o, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    o, _ = _flash_fwd(q, k, v, 0 if causal else None, sm_scale,
+                      block_q, block_k)
     return o
 
 
 def _fa_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    o, lse = _flash_fwd(q, k, v, 0 if causal else None, sm_scale,
+                        block_q, block_k)
     return o, (q, k, v, o, lse)
 
 
 def _fa_bwd(causal, sm_scale, block_q, block_k, res, do):
-    return _flash_bwd(causal, sm_scale, block_q, block_k, res, do)
+    return _flash_bwd(0 if causal else None, sm_scale, block_q, block_k,
+                      res, do)
 
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
@@ -470,21 +547,58 @@ def flash_attention_with_lse(q, k, v, causal: bool = False,
     based compositions (ring attention) whose gradients flow through the
     lse weights; the backward folds the lse cotangent in as
     ``delta -= dlse``."""
-    return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return _flash_fwd(q, k, v, 0 if causal else None, sm_scale,
+                      block_q, block_k)
 
 
 def _fal_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    o, lse = _flash_fwd(q, k, v, 0 if causal else None, sm_scale,
+                        block_q, block_k)
     return (o, lse), (q, k, v, o, lse)
 
 
 def _fal_bwd(causal, sm_scale, block_q, block_k, res, ct):
     do, dlse = ct
-    return _flash_bwd(causal, sm_scale, block_q, block_k, res, do,
-                      dlse=dlse)
+    return _flash_bwd(0 if causal else None, sm_scale, block_q, block_k,
+                      res, do, dlse=dlse)
 
 
 flash_attention_with_lse.defvjp(_fal_fwd, _fal_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention_shifted(q, k, v, shift,
+                            sm_scale: Optional[float] = None,
+                            block_q: int = 1024, block_k: int = 512):
+    """Flash attention with a RUNTIME shifted-causal mask -> ``(o, lse)``.
+
+    ``shift`` is an int32 scalar (traced values welcome): position
+    (row, col) attends iff ``col + shift <= row``.  shift=0 is ordinary
+    causal; shift <= -T allows everything; shift >= S masks everything
+    and yields o=0, lse=NEG_INF (a no-op under logsumexp merging).  The
+    scalar rides to the kernel through SMEM, so ONE compiled kernel
+    serves every chunk kind of ring attention — full, diagonal, and dead
+    — with no ``lax.switch`` wrapper (pallas-in-switch-in-scan trips a
+    jax lowering-cache bug; a data-dependent mask sidesteps it).  Both
+    outputs are differentiable; dlse folds in as ``delta -= dlse``.
+    """
+    return _flash_fwd(q, k, v, shift, sm_scale, block_q, block_k)
+
+
+def _fas_fwd(q, k, v, shift, sm_scale, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, shift, sm_scale, block_q, block_k)
+    return (o, lse), (q, k, v, o, lse, shift)
+
+
+def _fas_bwd(sm_scale, block_q, block_k, res, ct):
+    q, k, v, o, lse, shift = res
+    do, dlse = ct
+    dq, dk, dv = _flash_bwd(shift, sm_scale, block_q, block_k,
+                            (q, k, v, o, lse), do, dlse=dlse)
+    return dq, dk, dv, _float0_like(shift)
+
+
+flash_attention_shifted.defvjp(_fas_fwd, _fas_bwd)
 
 
 # --- chunk attention with LSE (building block for ring) -----------------------
@@ -508,80 +622,88 @@ def _chunk_attn(q, k, v, mask, scale):
 
 
 def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
-                   sm_scale: Optional[float] = None):
+                   sm_scale: Optional[float] = None,
+                   impl: str = "flash",
+                   block_q: int = 1024, block_k: int = 512):
     """Sequence-parallel attention inside ``shard_map``: every device holds
     a contiguous sequence shard of q/k/v ``(B, H, S_local, D)``; K/V rotate
     around the mesh-axis ring via ``lax.ppermute`` (ICI neighbor exchange)
     while partial attention accumulates with logsumexp merging.
 
-    With ``causal=True``, shard ``r`` attends fully to shards ``< r``,
-    causally to itself, and not at all to shards ``> r`` (those chunks are
-    masked to NEG_INF and vanish in the merge).  Differentiable end-to-end;
-    the VJP rides the transposed ``ppermute``s back around the ring.
+    Each chunk is computed by the Pallas flash kernel
+    (:func:`flash_attention_shifted`) with ``shift = (src - me) * S_kv``:
+    the globally-causal mask restricted to the (me, src) shard pair IS a
+    shifted-causal mask, so earlier shards come out fully attended, the
+    diagonal shard causally, and later shards fully masked (o=0,
+    lse=-inf, which the merge annihilates) — one kernel call per step,
+    no ``lax.switch`` chunk dispatch (whose pallas-in-switch-in-scan
+    nesting trips a jax lowering-cache bug, the r2 blocker).  Dead-chunk
+    blocks are still skipped inside the kernel: the ``pl.when`` grid
+    predicate compares against the runtime shift.
+
+    ``impl="reference"`` keeps the masked-XLA chunk path (used by tests
+    as a second oracle and by shapes that can't tile — though the flash
+    path falls back internally too).  Differentiable end-to-end; the VJP
+    rides the transposed ``ppermute``s back around the ring.
     """
     P = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     scale = _sm_scale(q, sm_scale)
     B, H, S, D = q.shape
+    T = k.shape[2]
     perm = [(i, (i + 1) % P) for i in range(P)]
+    use_flash = impl == "flash"
 
-    rows = lax.broadcasted_iota(jnp.int32, (S, S), 0)
-    cols = lax.broadcasted_iota(jnp.int32, (S, S), 1)
-
-    # Chunk attention is the masked XLA form (_chunk_attn), not the
-    # Pallas kernel: a pallas_call inside the switch inside this scan
-    # inside a MODEL's layer scan trips a jax lowering-cache bug in the
-    # interpreter (KeyError: closed_call), so the kernelized chunk —
-    # flash_attention_with_lse exists for it, dlse-correct — waits on a
-    # jax fix.  XLA still fuses the masked form well.
     def step(carry, s_idx):
         o, lse, ks, vs = carry
         src = (me - s_idx) % P  # which shard's K/V we hold this step
-        if causal:
-            # Three chunk kinds by shard order — full attention to
-            # earlier shards, causal to self, and NOTHING from later
-            # shards: the dead branch skips the attention compute
-            # entirely (for a causal ring that's ~half of all
-            # (shard, step) pairs) instead of computing and discarding
-            # through the -inf merge.  Differentiable: the skipped
-            # branch is constant, and those chunks contribute exactly
-            # nothing to the merged output either way.
-            def full(qq, kk, vv):
-                return _chunk_attn(qq, kk, vv, None, scale)
-
-            def self_causal(qq, kk, vv):
-                return _chunk_attn(qq, kk, vv,
-                                   (cols <= rows)[None, None], scale)
-
-            def dead(qq, kk, vv):
-                # derive from qq so the outputs are varying-over-axis
-                # like the live branches' (shard_map vma typing)
-                z = qq.astype(jnp.float32) * 0.0
-                return z, z[..., 0] + NEG_INF
-
-            idx = jnp.where(src < me, 2, jnp.where(src == me, 1, 0))
-            o_c, lse_c = lax.switch(idx, (dead, self_causal, full),
-                                    q, ks, vs)
+        last = s_idx == P - 1
+        if use_flash:
+            if causal:
+                shift = ((src - me) * T).astype(jnp.int32)
+                o_c, lse_c = flash_attention_shifted(
+                    q, ks, vs, shift, scale, block_q, block_k)
+            else:
+                o_c, lse_c = flash_attention_with_lse(
+                    q, ks, vs, False, scale, block_q, block_k)
+            o_c = o_c.astype(jnp.float32)
+            lse_c = lse_c.astype(jnp.float32)
+        elif causal:
+            shift = (src - me) * T
+            rows = lax.broadcasted_iota(jnp.int32, (S, T), 0)
+            cols = lax.broadcasted_iota(jnp.int32, (S, T), 1)
+            o_c, lse_c = _chunk_attn(
+                q, ks, vs, (cols + shift <= rows)[None, None], scale)
         else:
             o_c, lse_c = _chunk_attn(q, ks, vs, None, scale)
         lse_new = jnp.logaddexp(lse, lse_c)
         o = (o * jnp.exp(lse - lse_new)[..., None]
              + o_c * jnp.exp(lse_c - lse_new)[..., None])
-        ks = lax.ppermute(ks, axis_name, perm)
-        vs = lax.ppermute(vs, axis_name, perm)
-        return (o, lse_new, ks, vs), None
+        if not last:  # the final rotation's result is never read
+            ks = lax.ppermute(ks, axis_name, perm)
+            vs = lax.ppermute(vs, axis_name, perm)
+        return o, lse_new, ks, vs
 
     # Derive the initial carry from q so it inherits q's varying-over-axis
     # type under shard_map (a plain literal would mismatch the carry-out).
     o0 = jnp.zeros_like(q, jnp.float32) * 0.0
     lse0 = q[..., 0].astype(jnp.float32) * 0.0 + NEG_INF
-    (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v), jnp.arange(P))
+    # The ring loop is UNROLLED (P is the static mesh-axis size): each
+    # step is one kernel call + a ppermute, so XLA can overlap step i's
+    # neighbor exchange with step i-1's compute — a lax.scan would
+    # serialize them behind the carry.  Unrolling also keeps the Pallas
+    # call out of scan-in-scan nesting, which the interpret-mode
+    # lowering used on CPU can't cache correctly (KeyError: closed_call).
+    carry = (o0, lse0, k, v)
+    for s_idx in range(P):
+        carry = step(carry, s_idx)
+    o = carry[0]
     return o.astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
                       sm_scale: Optional[float] = None,
-                      impl: str = "reference"):
+                      impl: str = "flash"):
     """All-to-all sequence parallelism inside ``shard_map`` (the
     DeepSpeed-Ulysses pattern; SURVEY.md §5.7 lists it as the alltoall
     resharding flavor of context parallelism).
@@ -589,10 +711,10 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
     Every device holds a sequence shard ``(B, H, S_local, D)``.  One
     ``lax.all_to_all`` redistributes to ``(B, H/P, S_global, D)`` — full
     sequence, head subset — so local attention (including the Pallas
-    flash kernel via ``impl="flash"``, and ordinary causal masking) runs
-    unchanged; the inverse all_to_all restores sequence sharding.
-    Requires ``H %% axis_size == 0``.  Differentiable end-to-end: the VJP
-    of ``all_to_all`` is the transposed all_to_all.
+    flash kernel via the default ``impl="flash"``, and ordinary causal
+    masking) runs unchanged; the inverse all_to_all restores sequence
+    sharding.  Requires ``H %% axis_size == 0``.  Differentiable
+    end-to-end: the VJP of ``all_to_all`` is the transposed all_to_all.
     """
     P = lax.axis_size(axis_name)
     B, H, S, D = q.shape

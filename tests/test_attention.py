@@ -100,14 +100,14 @@ class TestFlashWithLse:
 
         def loss_ref(q, k, v):
             o, lse = A._reference_attention_lse(
-                q, k, v, True, A._sm_scale(q, None))
+                q, k, v, 0, A._sm_scale(q, None))
             return jnp.sum(o.astype(jnp.float32) ** 2) + jnp.sum(lse ** 2)
 
         o, lse = jax.jit(
             lambda q, k, v: A.flash_attention_with_lse(q, k, v, True)
         )(q, k, v)
         o_r, lse_r = A._reference_attention_lse(
-            q, k, v, True, A._sm_scale(q, None))
+            q, k, v, 0, A._sm_scale(q, None))
         np.testing.assert_allclose(np.asarray(o), np.asarray(o_r),
                                    atol=2e-4, rtol=2e-4)
         np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_r),
@@ -119,14 +119,61 @@ class TestFlashWithLse:
                                        atol=2e-3, rtol=2e-3, err_msg=name)
 
 
+class TestFlashShifted:
+    """The runtime shifted-causal mask: one kernel serves every ring chunk
+    kind (full / diagonal-causal / dead) via an SMEM int32 shift."""
+
+    @pytest.mark.parametrize("shift", [-128, -64, 0, 64])
+    def test_matches_reference_shift(self, shift):
+        q, k, v = _qkv(b=1, h=2, s=128, d=32)
+        o, lse = jax.jit(
+            lambda q, k, v, s: A.flash_attention_shifted(q, k, v, s,
+                                                         None, 64, 64)
+        )(q, k, v, jnp.int32(shift))
+        o_r, lse_r = A._reference_attention_lse(
+            q, k, v, shift, A._sm_scale(q, None))
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_r),
+                                   atol=2e-4, rtol=2e-4)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_r),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_dead_chunk_yields_zero_and_neg_inf(self):
+        """shift >= S masks everything: o == 0, lse == NEG_INF, so the
+        chunk vanishes under a logsumexp merge."""
+        q, k, v = _qkv(b=1, h=1, s=64, d=16)
+        o, lse = A.flash_attention_shifted(q, k, v, jnp.int32(64),
+                                           None, 64, 64)
+        np.testing.assert_array_equal(np.asarray(o), 0.0)
+        assert (np.asarray(lse) <= A.NEG_INF / 2).all()
+
+    def test_gradients_match_reference_shift(self):
+        q, k, v = _qkv(b=1, h=1, s=128, d=16)
+        shift = jnp.int32(-64)  # half-window: exercises partial masking
+
+        def loss_flash(q, k, v):
+            o, lse = A.flash_attention_shifted(q, k, v, shift, None, 64, 64)
+            return jnp.sum(o ** 2) + jnp.sum(lse ** 2)
+
+        def loss_ref(q, k, v):
+            o, lse = A._reference_attention_lse(
+                q, k, v, shift, A._sm_scale(q, None))
+            return jnp.sum(o ** 2) + jnp.sum(lse ** 2)
+
+        g = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b, name in zip(g, gr, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-3, rtol=2e-3, err_msg=name)
+
+
 class TestRingAttention:
-    def _run_ring(self, q, k, v, causal):
+    def _run_ring(self, q, k, v, causal, impl="flash"):
         """q/k/v are (B, H, S_total, D); shard the sequence over the mesh."""
         B, H, S, D = q.shape
 
         def inner(qs, ks, vs):
             return A.ring_attention(
-                qs, ks, vs, axis_name=hvd.AXIS, causal=causal)
+                qs, ks, vs, axis_name=hvd.AXIS, causal=causal, impl=impl)
 
         f = spmd.shard(
             inner,
@@ -135,10 +182,11 @@ class TestRingAttention:
         )
         return jax.jit(f)(q, k, v)
 
+    @pytest.mark.parametrize("impl", ["flash", "reference"])
     @pytest.mark.parametrize("causal", [False, True])
-    def test_matches_full_attention(self, causal):
+    def test_matches_full_attention(self, causal, impl):
         q, k, v = _qkv(b=1, h=2, s=N * 16, d=32)
-        out = self._run_ring(q, k, v, causal)
+        out = self._run_ring(q, k, v, causal, impl)
         ref = A.reference_attention(q, k, v, causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-4, rtol=2e-4)
@@ -298,10 +346,14 @@ class TestTransformerIntegration:
         def inner(params, tokens):
             return T.forward(params, tokens, cfg_ring)
 
+        # check_vma=False: the production wrapper (spmd.shard) disables
+        # vma tracking too — the Pallas CPU interpreter can't slice
+        # varying-over-axis operands (jax suggests this exact workaround).
         f = jax.jit(jax.shard_map(
             inner, mesh=mesh,
             in_specs=(P(), P(None, "sp")),
             out_specs=P(None, "sp"),
+            check_vma=False,
         ))
         out = f(params, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -331,6 +383,7 @@ class TestTransformerIntegration:
             inner, mesh=mesh,
             in_specs=(P(), P(None, "sp")),
             out_specs=P(None, "sp"),
+            check_vma=False,  # Pallas CPU interpreter vs varying operands
         ))
         out = f(params, tokens)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
