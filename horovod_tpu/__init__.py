@@ -69,6 +69,7 @@ from horovod_tpu.ops.collectives import (
 )
 from horovod_tpu.ops.compression import Compression
 from horovod_tpu.optim import (
+    DistributedAdasumOptimizer,
     DistributedGradientTape,
     DistributedOptimizer,
     distributed_gradients,

@@ -142,11 +142,66 @@ def vhdd_program(mesh, axis: str):
     return jax.jit(spmd.shard(fn, in_specs=spec, out_specs=spec, mesh=mesh))
 
 
+def vhdd_program_group(mesh, axis: str, n: int):
+    """Compiled distributed VHDD over a GROUP of ``n`` tensors: the same
+    log2(P) ``ppermute`` rounds as :func:`vhdd_program` with all tensors
+    exchanged together (shared communication), but coefficient math done
+    PER TENSOR — ``_adasum_over_axis`` maps the dot products over the
+    pytree leaves.  This is the reference's fused-buffer semantics
+    (``adasum.h:194-338`` FusedAllreduce loops per-tensor for the dots
+    while the buffer rides the wire as one message)."""
+    from horovod_tpu import spmd
+
+    spec = jax.sharding.PartitionSpec(axis)
+
+    def fn(*blocks):  # per-shard: n arrays of (1, ...)
+        tree = [jnp.squeeze(b, 0) for b in blocks]
+        out = _adasum_over_axis(tree, axis)
+        return tuple(o[None] for o in out)
+
+    return jax.jit(spmd.shard(fn, in_specs=(spec,) * n,
+                              out_specs=(spec,) * n, mesh=mesh))
+
+
 @functools.lru_cache(maxsize=1)
 def _compiled_eager_vhdd():
     from horovod_tpu.ops import collectives as C
 
     return vhdd_program(C._process_mesh(), "proc")
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_eager_vhdd_group(n: int):
+    from horovod_tpu.ops import collectives as C
+
+    return vhdd_program_group(C._process_mesh(), "proc", n)
+
+
+def eager_adasum_group(arrays):
+    """Eager Adasum of a FUSED tensor group with per-tensor coefficients.
+
+    Used by the native executor when the controller fused several Adasum
+    requests into one response: concatenating and running a single dot
+    would change the math (one global coefficient instead of one per
+    layer, diverging from reference ``adasum.h`` FusedAllreduce), so the
+    group shares the communication rounds while each tensor keeps its own
+    pairwise coefficients."""
+    from horovod_tpu.ops import collectives as C
+
+    arrays = [np.asarray(a) for a in arrays]
+    P = basics.cross_size()
+    if P == 1:
+        return [a.copy() for a in arrays]
+    if P & (P - 1) == 0:
+        outs = _compiled_eager_vhdd_group(len(arrays))(
+            *[C._to_global(a) for a in arrays])
+        return [C._local_shard_to_host(o)[0] for o in outs]
+    # Non-power-of-2 fallback: gather + serial oracle per tensor.
+    return [
+        np.asarray(adasum_reduce_stack(C._replicated_to_host(
+            C._compiled_identity_replicated()(C._to_global(a)))))
+        for a in arrays
+    ]
 
 
 def eager_adasum(x: np.ndarray) -> np.ndarray:

@@ -142,6 +142,108 @@ def DistributedOptimizer(
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+class _AdasumDeltaState(NamedTuple):
+    inner: Any
+    start: Any       # params at the last sync (None when k == 1)
+    counter: jnp.ndarray
+
+
+def DistributedAdasumOptimizer(
+    optimizer: optax.GradientTransformation,
+    *,
+    axis_name=None,
+    compression=Compression.none,
+    backward_passes_per_step: int = 1,
+) -> optax.GradientTransformation:
+    """Delta-model Adasum: combine LOCAL OPTIMIZER UPDATES, not gradients.
+
+    The published Adasum usage mode (reference
+    ``tensorflow/__init__.py:313-407`` ``_DistributedAdasumOptimizer``,
+    ``torch/__init__.py:219-407``): each worker applies its own optimizer
+    step, and the resulting parameter delta — which already carries the
+    optimizer's adaptive scaling — is Adasum-allreduced, so the
+    scale-insensitive pairwise combination operates on actual model
+    movement:
+
+        start  = params at the last sync
+        local  = params + inner_update(grads)          (optimizer logic)
+        delta  = local - start
+        global = adasum_allreduce(delta)
+        params = start + global
+
+    In optax terms the inner update IS the per-step delta, so with
+    ``backward_passes_per_step == 1`` no snapshot is needed: the returned
+    update is ``adasum(inner_update)``.  With k > 1, updates apply
+    locally for k-1 steps (workers drift) and the k-th step reduces the
+    CUMULATIVE drift from ``start``, mirroring the reference's
+    ``_is_comm_step`` handling.
+    """
+    if backward_passes_per_step < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+    from horovod_tpu.ops import adasum as AD
+
+    def _adasum(tree):
+        tree, ctx = compression.compress(tree)
+        out = AD.adasum_allreduce(tree, axis_name=axis_name)
+        return compression.decompress(out, ctx)
+
+    if backward_passes_per_step == 1:
+
+        def init_fn(params):
+            return optimizer.init(params)
+
+        def update_fn(grads, state, params=None, **extra):
+            updates, inner = optimizer.update(grads, state, params, **extra)
+            return _adasum(updates), inner
+
+        return optax.GradientTransformation(init_fn, update_fn)
+
+    k = backward_passes_per_step
+
+    def init_fn(params):
+        return _AdasumDeltaState(
+            inner=optimizer.init(params),
+            start=jax.tree_util.tree_map(jnp.asarray, params),
+            counter=jnp.zeros((), jnp.int32),
+        )
+
+    def update_fn(grads, state, params=None, **extra):
+        if params is None:
+            raise ValueError(
+                "DistributedAdasumOptimizer with backward_passes_per_step "
+                "> 1 needs params passed to update()")
+        local_updates, inner = optimizer.update(
+            grads, state.inner, params, **extra)
+        count = state.counter + 1
+        boundary = count >= k
+
+        def do_sync(operands):
+            local_updates, params, start = operands
+            # Cumulative drift since the last sync, including this step's
+            # local update.
+            delta = jax.tree_util.tree_map(
+                lambda p, u, s: p + u - s, params, local_updates, start)
+            global_delta = _adasum(delta)
+            new_start = jax.tree_util.tree_map(
+                lambda s, g: s + g, start, global_delta)
+            updates = jax.tree_util.tree_map(
+                lambda ns, p: ns - p, new_start, params)
+            return updates, new_start
+
+        def skip_sync(operands):
+            local_updates, _params, start = operands
+            return local_updates, start
+
+        updates, start = jax.lax.cond(
+            boundary, do_sync, skip_sync, (local_updates, params, state.start)
+        )
+        counter = jnp.where(boundary, 0, count)
+        return updates, _AdasumDeltaState(
+            inner=inner, start=start, counter=counter)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def DistributedGradientTape(
     fun,
     *,

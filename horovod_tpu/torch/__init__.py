@@ -37,6 +37,25 @@ def join() -> int:
     return _join()
 
 
+def _resolve_parameter_names(param_groups, named_parameters, prefix):
+    """Normalize ``named_parameters`` into a param->name dict; without
+    names, number every parameter (across groups) ``{prefix}.noname.{i}``.
+    Names must be unique and identical on every rank — the coordinator
+    matches tensors by name."""
+    if named_parameters is not None:
+        named_parameters = list(named_parameters)
+    else:
+        named_parameters = [
+            (f"{prefix}.noname.{i}", v)
+            for i, v in enumerate(
+                p for group in param_groups for p in group["params"])
+        ]
+    if len({n for n, _ in named_parameters}) < len(named_parameters):
+        raise ValueError(
+            "named_parameters contains duplicate parameter names")
+    return {v: n for n, v in named_parameters}
+
+
 class _DistributedOptimizer(torch.optim.Optimizer):
     """Wraps a torch optimizer: gradients are allreduced asynchronously as
     autograd accumulates them, and ``step`` waits for all handles.
@@ -53,20 +72,8 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         self._op = op
         self.backward_passes_per_step = backward_passes_per_step
 
-        if named_parameters is not None:
-            named_parameters = list(named_parameters)
-        else:
-            named_parameters = [
-                (f"allreduce.noname.{i}", v)
-                for i, group in enumerate(self.param_groups)
-                for v in group["params"]
-            ]
-        # Names must be unique and identical on every rank (the
-        # coordinator matches tensors by name).
-        if len({n for n, _ in named_parameters}) < len(named_parameters):
-            raise ValueError(
-                "named_parameters contains duplicate parameter names")
-        self._parameter_names = {v: n for n, v in named_parameters}
+        self._parameter_names = _resolve_parameter_names(
+            self.param_groups, named_parameters, "allreduce")
         self._handles: dict = {}
         self._grad_passes: dict = {}
         self._synchronized = False
@@ -143,12 +150,95 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         return super(self.__class__, self).zero_grad(*args, **kwargs)
 
 
+class _DistributedAdasumOptimizer(torch.optim.Optimizer):
+    """Delta-model Adasum: combine LOCAL OPTIMIZER UPDATES, not gradients.
+
+    The published Adasum usage mode (reference
+    ``torch/__init__.py:219-407`` ``_DistributedAdasumOptimizer``,
+    ``tensorflow/__init__.py:313-407``):
+
+        start  = params at the last sync
+        step() = local optimizer update (adaptive scaling included)
+        delta  = params - start        (cumulative over k local steps)
+        global = allreduce(delta, op=Adasum)
+        start += global ; params = start
+
+    The deltas are submitted as async native collectives per parameter
+    (overlapping like the reference's hook-fired allreduces), then
+    synchronized and applied.
+    """
+
+    def __init__(self, params, named_parameters, compression,
+                 backward_passes_per_step=1):
+        super(self.__class__, self).__init__(params)
+        self._compression = compression
+        self.backward_passes_per_step = backward_passes_per_step
+
+        self._parameter_names = _resolve_parameter_names(
+            self.param_groups, named_parameters, "adasum")
+        self._starting_models: dict = {}
+        self._step_count = 0
+
+    def _snapshot_starts(self):
+        for group in self.param_groups:
+            for p in group["params"]:
+                self._starting_models[p] = p.detach().clone()
+
+    def synchronize(self):
+        """No-op for API parity: the delta allreduce happens inside
+        ``step()`` (reference ``torch/__init__.py:350-352``)."""
+
+    @contextmanager
+    def skip_synchronize(self):
+        raise AssertionError(
+            "Skipping synchronization is not supported when using Adasum "
+            "optimizer.")
+        yield  # pragma: no cover
+
+    def step(self, closure=None):
+        loss = closure() if closure is not None else None
+        if self._step_count == 0:
+            self._snapshot_starts()  # start = initial (broadcast) params
+        super(self.__class__, self).step()  # LOCAL update
+        self._step_count += 1
+        if self._step_count % self.backward_passes_per_step != 0:
+            return loss  # workers drift locally until the comm step
+
+        handles = []
+        for group in self.param_groups:
+            for p in group["params"]:
+                start = self._starting_models.get(p)
+                if start is None:  # param added after construction
+                    continue
+                delta = p.detach() - start
+                name = f"adasum.delta.{self._parameter_names.get(p, id(p))}"
+                h = allreduce_async(delta, name=name, op=Adasum,
+                                    compression=self._compression)
+                handles.append((p, start, h))
+        for p, start, h in handles:
+            start.add_(synchronize(h))
+            p.data.copy_(start)
+        return loss
+
+    def zero_grad(self, *args, **kwargs):
+        return super(self.__class__, self).zero_grad(*args, **kwargs)
+
+
 def DistributedOptimizer(optimizer, named_parameters=None,
                          compression=Compression.none,
                          backward_passes_per_step=1, op=Average):
     """Factory mirroring ``hvd.DistributedOptimizer``
     (``torch/__init__.py`` factory): returns an instance of a dynamic
-    subclass of the wrapped optimizer's type."""
+    subclass of the wrapped optimizer's type.  ``op=Adasum`` selects the
+    delta-model optimizer (local update, Adasum-combined parameter
+    deltas) exactly as the reference factory does; with one worker the
+    plain gradient-averaging wrapper is an identity and is used instead.
+    """
+    if op == Adasum and size() > 1:
+        cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
+                   dict(_DistributedAdasumOptimizer.__dict__))
+        return cls(optimizer.param_groups, named_parameters, compression,
+                   backward_passes_per_step)
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
     return cls(optimizer.param_groups, named_parameters, compression,

@@ -95,6 +95,122 @@ class TestDistributedOptimizer:
         assert float(loss) < 1.0
 
 
+class TestDistributedAdasumOptimizer:
+    """Delta-model Adasum (reference tensorflow/__init__.py:313-407,
+    torch/__init__.py:219-407): the LOCAL optimizer update — not the
+    gradient — is Adasum-combined.  Oracle: adasum_reduce_stack over the
+    per-worker deltas."""
+
+    def _worker_deltas(self, params, x, y, lr):
+        """Per-worker sgd deltas for each of the N batch shards."""
+        from horovod_tpu.ops import adasum as AD
+
+        shard = len(x) // N
+        deltas = []
+        for i in range(N):
+            b = (x[i * shard:(i + 1) * shard], y[i * shard:(i + 1) * shard])
+            g = jax.grad(_loss)(params, b)
+            deltas.append(jax.tree_util.tree_map(lambda gg: -lr * gg, g))
+        return {
+            k: AD.adasum_reduce_stack(
+                jnp.stack([d[k] for d in deltas]))
+            for k in params
+        }
+
+    def test_one_step_matches_pairwise_oracle(self):
+        x, y = _data()
+        params = _params()
+        opt = hvd.DistributedAdasumOptimizer(optax.sgd(0.1))
+        step = spmd.make_train_step(_loss, opt, donate=False)
+        p2, _, _ = step(params, opt.init(params), (x, y))
+
+        global_delta = self._worker_deltas(params, x, y, 0.1)
+        for k in params:
+            expect = params[k] + global_delta[k]
+            np.testing.assert_allclose(
+                np.asarray(p2[k]), np.asarray(expect), rtol=1e-5, atol=1e-6)
+
+    def test_adaptive_inner_optimizer(self):
+        """The combined quantity must carry the inner optimizer's adaptive
+        scaling (here: adam), not the raw gradient."""
+        x, y = _data()
+        params = _params()
+        inner = optax.adam(0.05)
+        opt = hvd.DistributedAdasumOptimizer(inner)
+        step = spmd.make_train_step(_loss, opt, donate=False)
+        p2, _, _ = step(params, opt.init(params), (x, y))
+
+        from horovod_tpu.ops import adasum as AD
+
+        shard = len(x) // N
+        deltas = []
+        for i in range(N):
+            b = (x[i * shard:(i + 1) * shard], y[i * shard:(i + 1) * shard])
+            g = jax.grad(_loss)(params, b)
+            u, _ = inner.update(g, inner.init(params), params)
+            deltas.append(u)
+        for k in params:
+            expect = params[k] + AD.adasum_reduce_stack(
+                jnp.stack([d[k] for d in deltas]))
+            np.testing.assert_allclose(
+                np.asarray(p2[k]), np.asarray(expect), rtol=1e-5, atol=1e-6)
+
+    def test_identical_workers_halve_like_adasum(self):
+        """All workers computing the SAME delta must produce that delta
+        (Adasum's a==b case: coefficients sum to 1), not N× it."""
+        x, y = _data()
+        params = _params()
+        # Replicate one shard to every worker so all grads are identical.
+        xs = np.tile(x[:4], (N, 1))
+        ys = np.tile(y[:4], (N, 1))
+        opt = hvd.DistributedAdasumOptimizer(optax.sgd(0.1))
+        step = spmd.make_train_step(_loss, opt, donate=False)
+        p2, _, _ = step(params, opt.init(params), (xs, ys))
+        g = jax.grad(_loss)(params, (xs[:4], ys[:4]))
+        for k in params:
+            expect = params[k] - 0.1 * g[k]
+            np.testing.assert_allclose(
+                np.asarray(p2[k]), np.asarray(expect), rtol=1e-5, atol=1e-6)
+
+    def test_backward_passes_per_step_drift_and_sync(self):
+        """k=2: step 1 applies the local update only (workers drift);
+        step 2 Adasum-combines the CUMULATIVE drift from start."""
+        x, y = _data()
+        params = _params()
+        lr = 0.1
+        opt = hvd.DistributedAdasumOptimizer(
+            optax.sgd(lr), backward_passes_per_step=2)
+        step = spmd.make_train_step(_loss, opt, donate=False)
+        opt_state = opt.init(params)
+        p1, opt_state, _ = step(params, opt_state, (x, y))
+        p2, opt_state, _ = step(p1, opt_state, (x, y))
+
+        # Oracle: simulate each worker's two local sgd steps from start.
+        from horovod_tpu.ops import adasum as AD
+
+        shard = len(x) // N
+        deltas = []
+        for i in range(N):
+            b = (x[i * shard:(i + 1) * shard], y[i * shard:(i + 1) * shard])
+            local = params
+            for _ in range(2):
+                g = jax.grad(_loss)(local, b)
+                local = jax.tree_util.tree_map(
+                    lambda p, gg: p - lr * gg, local, g)
+            deltas.append(jax.tree_util.tree_map(
+                lambda l, s: l - s, local, params))
+        for k in params:
+            expect = params[k] + AD.adasum_reduce_stack(
+                jnp.stack([d[k] for d in deltas]))
+            np.testing.assert_allclose(
+                np.asarray(p2[k]), np.asarray(expect), rtol=1e-5, atol=1e-6)
+
+    def test_bad_k(self):
+        with pytest.raises(ValueError):
+            hvd.DistributedAdasumOptimizer(
+                optax.sgd(0.1), backward_passes_per_step=0)
+
+
 class TestBackwardPassesPerStep:
     def test_accumulation(self):
         """k accumulation steps then one update == one update with the

@@ -116,6 +116,23 @@ class TestDistributedOptimizer:
                 torch.optim.SGD(model.parameters(), lr=0.1),
                 named_parameters=[("p", p) for p in model.parameters()])
 
+    @pytest.mark.parametrize("op_name", ["Average", "Adasum"])
+    def test_default_names_unique_across_group(self, hvd, op_name):
+        """No named_parameters: every param (not every param GROUP) must
+        get its own auto-name, for both wrapper classes — a model with 4
+        params in one group used to collide on 'noname.0'."""
+        model = self._model()
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            op=getattr(hvd_torch, op_name))
+        names = set(opt._parameter_names.values())
+        assert len(names) == sum(1 for _ in model.parameters())
+        x = torch.randn(8, 4)
+        opt.zero_grad()
+        torch.nn.functional.mse_loss(
+            model(x), x.sum(dim=1, keepdim=True)).backward()
+        opt.step()  # must not raise / deadlock
+
     def test_backward_passes_per_step(self, hvd):
         model = self._model()
         opt = hvd_torch.DistributedOptimizer(
@@ -181,7 +198,7 @@ class TestStateBroadcast:
 
 
 class TestTorchMultiProcess:
-    def test_two_process_torch(self, tmp_path):
+    def _spawn(self, tmp_path, scenario, nproc):
         import socket
 
         def free_port():
@@ -194,17 +211,33 @@ class TestTorchMultiProcess:
             "PATH": os.environ.get("PATH", ""),
             "REPO": REPO,
             "PALLAS_AXON_POOL_IPS": "",
-            "HOROVOD_NUM_PROC": "2",
+            "HOROVOD_NUM_PROC": str(nproc),
             "HOROVOD_JAX_PORT": str(free_port()),
             "HOROVOD_NATIVE_PORT": str(free_port()),
         }
+        args = [sys.executable,
+                os.path.join(REPO, "tests", "torch_worker.py")]
+        if scenario:
+            args.append(scenario)
         rc = launch.launch_job(
-            [sys.executable, os.path.join(REPO, "tests", "torch_worker.py")],
-            [HostSpec("localhost", 1)] * 2,
+            args,
+            [HostSpec("localhost", 1)] * nproc,
             env=env,
             output_filename=str(out),
         )
         assert rc == 0, (out / "rank.0.stderr").read_text() + (
-            out / "rank.1.stderr").read_text()
-        for r in (0, 1):
+            out / f"rank.{nproc - 1}.stderr").read_text()
+        for r in range(nproc):
             assert "TORCH-WORKER-OK" in (out / f"rank.{r}.stdout").read_text()
+
+    def test_two_process_torch(self, tmp_path):
+        self._spawn(tmp_path, None, 2)
+
+    def test_adasum_delta_two_process(self, tmp_path):
+        """Delta-model Adasum vs the pairwise oracle, 2 ranks (reference
+        test_adasum_* parity)."""
+        self._spawn(tmp_path, "adasum", 2)
+
+    def test_adasum_delta_four_process(self, tmp_path):
+        """Same at 4 ranks: two VHDD rounds exercise the recursion."""
+        self._spawn(tmp_path, "adasum", 4)

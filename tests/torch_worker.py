@@ -16,9 +16,107 @@ import torch  # noqa: E402
 
 import horovod_tpu.torch as hvd  # noqa: E402
 
+SCENARIO = sys.argv[1] if len(sys.argv) > 1 else "full"
+
 hvd.init()
 rank = hvd.cross_rank()
 nproc = hvd.cross_size()
+
+
+def scenario_adasum():
+    """Delta-model Adasum optimizer vs the pairwise oracle (reference
+    test_adasum_* structure): local SGD update, Adasum-combined parameter
+    delta, verified against adasum_reduce_stack of the gathered per-rank
+    deltas.  Runs at any power-of-two nproc (spawned at 2 and 4)."""
+    from horovod_tpu.ops import adasum as AD
+
+    torch.manual_seed(0)
+    model = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.Tanh(),
+                                torch.nn.Linear(8, 1))
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    lr = 0.05
+    opt = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=lr),
+        named_parameters=model.named_parameters(), op=hvd.Adasum)
+    # op=Adasum must select the DELTA optimizer, not gradient averaging.
+    assert hasattr(opt, "_starting_models"), type(opt).__mro__
+
+    start = [p.detach().clone() for p in model.parameters()]
+    torch.manual_seed(123 + rank)  # different data per rank
+    xb = torch.randn(16, 4)
+    yb = xb.sum(dim=1, keepdim=True)
+    opt.zero_grad()
+    torch.nn.functional.mse_loss(model(xb), yb).backward()
+    grads = [p.grad.detach().clone() for p in model.parameters()]
+    opt.step()
+
+    # Oracle: each rank's local delta is -lr*g (plain SGD); gather them
+    # and reduce with the serial pairwise recursion.
+    for i, (p, s, g) in enumerate(zip(model.parameters(), start, grads)):
+        local_delta = (-lr * g).reshape(1, -1)
+        all_d = hvd.allgather(local_delta, name=f"adasum.oracle.{i}")
+        expect = s.reshape(-1) + torch.from_numpy(
+            np.asarray(AD.adasum_reduce_stack(all_d.numpy())))
+        np.testing.assert_allclose(
+            p.detach().reshape(-1).numpy(), expect.numpy(),
+            rtol=1e-5, atol=1e-6)
+
+    # Replicas must be identical after the sync step.
+    flat = torch.cat([p.detach().reshape(-1) for p in model.parameters()])
+    gathered = hvd.allgather(flat.unsqueeze(0), name="adasum.flat")
+    for r in range(1, nproc):
+        assert torch.allclose(gathered[0], gathered[r], atol=1e-6), r
+
+    # backward_passes_per_step=2: the first step applies only the LOCAL
+    # update (replicas drift apart on different data); the second
+    # Adasum-combines the cumulative drift and re-converges them.
+    opt2 = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=lr),
+        named_parameters=model.named_parameters(), op=hvd.Adasum,
+        backward_passes_per_step=2)
+    torch.manual_seed(500 + rank)
+    for it in range(2):
+        xb = torch.randn(16, 4)
+        yb = xb.sum(dim=1, keepdim=True)
+        opt2.zero_grad()
+        torch.nn.functional.mse_loss(model(xb), yb).backward()
+        opt2.step()
+        flat = torch.cat(
+            [p.detach().reshape(-1) for p in model.parameters()])
+        gathered = hvd.allgather(flat.unsqueeze(0), name=f"adasum.k2.{it}")
+        same = all(torch.allclose(gathered[0], gathered[r], atol=1e-7)
+                   for r in range(1, nproc))
+        if it == 0:
+            assert not same, "ranks must drift on the non-comm step"
+        else:
+            assert same, "comm step must re-converge the replicas"
+
+    # skip_synchronize is meaningless for the delta optimizer.
+    try:
+        with opt.skip_synchronize():
+            pass
+        raise SystemExit("expected AssertionError from skip_synchronize")
+    except AssertionError:
+        pass
+
+    # Default naming (no named_parameters) must produce unique names for
+    # every parameter, not one name per param GROUP.
+    opt3 = hvd.DistributedOptimizer(
+        torch.optim.SGD(model.parameters(), lr=lr), op=hvd.Adasum)
+    assert hasattr(opt3, "_starting_models")
+    opt3.zero_grad()
+    xb = torch.randn(4, 4)
+    torch.nn.functional.mse_loss(model(xb), xb.sum(1, keepdim=True)).backward()
+    opt3.step()  # would deadlock/raise on duplicate names
+
+    hvd.shutdown()
+    print(f"TORCH-WORKER-OK rank={rank}")
+
+
+if SCENARIO == "adasum":
+    scenario_adasum()
+    sys.exit(0)
+
 assert nproc == 2
 
 # cross-rank allreduce value check
