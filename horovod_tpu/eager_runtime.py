@@ -115,11 +115,14 @@ class EagerRuntime:
         except native.NativeError as e:
             raise CollectiveError(str(e)) from e
 
-    def join(self) -> None:
+    def join(self) -> int:
         """Block until all ranks joined (native JOIN accounting; this rank's
-        executor keeps contributing zeros meanwhile)."""
+        executor keeps contributing zeros meanwhile).  Returns the rank
+        that joined LAST, as observed by the coordinator (reference DoJoin
+        contract — the rank holding the most-advanced state)."""
         h = self._rt.enqueue_join()
         self._rt.wait(h)
+        return self._rt.last_joined_rank()
 
     def poll(self, handle: int) -> bool:
         return self._rt.poll(handle)
@@ -160,16 +163,28 @@ class EagerRuntime:
 
             if resp.type == native.ALLREDUCE:
                 op = to_op[resp.op]
-                flat = (np.concatenate([a.ravel() for a in inputs])
-                        if len(inputs) > 1 else inputs[0].ravel())
                 pre = resp.prescale if resp.prescale != 1.0 else None
                 post = resp.postscale if resp.postscale != 1.0 else None
-                red = C._eager_allreduce(flat, op, pre, post)
-                off = 0
-                outs = []
-                for a in inputs:
-                    outs.append(red[off:off + a.size].reshape(a.shape))
-                    off += a.size
+                if op == C.Adasum and len(inputs) > 1:
+                    # Fused Adasum keeps PER-TENSOR coefficients
+                    # (reference adasum.h FusedAllreduce): concatenating
+                    # would collapse the group to one global dot product.
+                    from horovod_tpu.ops import adasum as _ad
+
+                    ins = [a if pre is None else
+                           a * np.asarray(pre, a.dtype) for a in inputs]
+                    outs = _ad.eager_adasum_group(ins)
+                    if post is not None:
+                        outs = [o * np.asarray(post, o.dtype) for o in outs]
+                else:
+                    flat = (np.concatenate([a.ravel() for a in inputs])
+                            if len(inputs) > 1 else inputs[0].ravel())
+                    red = C._eager_allreduce(flat, op, pre, post)
+                    off = 0
+                    outs = []
+                    for a in inputs:
+                        outs.append(red[off:off + a.size].reshape(a.shape))
+                        off += a.size
             elif resp.type == native.ALLGATHER:
                 outs = [C._eager_allgather(inputs[0])]
             elif resp.type == native.BROADCAST:

@@ -58,17 +58,17 @@ def join() -> int:
     With the native runtime this is the reference's true JOIN protocol
     (``EnqueueJoin`` ``operations.cc:919-943``): while blocked here, other
     ranks' allreduces proceed with this rank contributing zeros; the
-    coordinator releases everyone once all ranks joined."""
+    coordinator tracks join ARRIVAL ORDER and releases everyone once all
+    ranks joined, distributing the last-joined rank in the JOIN response.
+
+    Without the native control plane there is no arrival-order observer:
+    the fallback is a plain barrier-style allreduce whose Max-of-rank
+    return is only meaningful single-process (where it is correctly 0)."""
     basics._ctx()
     from horovod_tpu import eager_runtime
 
     rt = eager_runtime.get()
-    my = np.asarray(float(basics.rank()), np.float32)
     if rt is not None:
-        rt.join()
-        # Through the public (native-routed) op so launch order stays
-        # globally consistent with any still-draining async collectives.
-        last = C.allreduce(my, C.Max, name="join.last_rank")
-    else:
-        last = C._eager_allreduce(my, C.Max, None, None)
-    return int(last)
+        return int(rt.join())
+    my = np.asarray(float(basics.rank()), np.float32)
+    return int(C._eager_allreduce(my, C.Max, None, None))

@@ -144,6 +144,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.hvd_wait.restype = ctypes.c_int
     lib.hvd_wait.argtypes = [ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int]
     lib.hvd_cycles.restype = ctypes.c_longlong
+    lib.hvd_last_joined_rank.restype = ctypes.c_int
     lib.hvd_cache_hits.restype = ctypes.c_longlong
     lib.hvd_cache_entries.restype = ctypes.c_longlong
     lib.hvd_set_fusion_bytes.restype = None
@@ -366,6 +367,11 @@ class NativeRuntime:
         if h < 0:
             raise NativeError(STATUS_ABORTED, "join enqueue failed")
         return int(h)
+
+    def last_joined_rank(self) -> int:
+        """Rank that joined LAST in the most recent completed join round
+        (reference DoJoin output); -1 before any round completes."""
+        return int(self._lib.hvd_last_joined_rank())
 
     def poll(self, handle: int) -> bool:
         return bool(self._lib.hvd_poll(handle))

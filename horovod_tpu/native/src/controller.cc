@@ -135,27 +135,36 @@ bool Controller::ComputeResponseList(std::vector<Request> pending,
     constructed = ResponseList::Parse(wire);
 
     out->shutdown = constructed.shutdown;
+    // Park this cycle's slow-path submissions: their responses may arrive
+    // many cycles later (readiness waits for the slowest rank).
+    for (const auto& r : mine.requests)
+      if (r.type != ReqType::JOIN) local_pending_[r.name] = r;
     // Insert fresh single-tensor responses into the cache — every rank does
-    // this in identical bcast order, keeping bit positions aligned.
+    // this in identical bcast order, keeping bit positions aligned.  The
+    // cache KEY is this rank's own submitted request, not the
+    // coordinator's response metadata: allgather/alltoall first dims
+    // legitimately vary per rank (reference response_cache.h:45-102
+    // carries per-rank sizes for the same reason), so keying on the local
+    // request lets those ops ride the fast path too — next cycle's
+    // Lookup compares against what THIS rank will resubmit.  A rank whose
+    // first dim changes misses locally, clearing its bit and forcing a
+    // global renegotiation that re-Puts the entry everywhere in lockstep.
     for (auto& resp : constructed.responses) {
-      // Cache only ops whose metadata is identical on every rank:
-      // allgather/alltoall legitimately vary in dim 0 per rank, so a
-      // cached key built from the coordinator's shape would mismatch on
-      // every other rank and force a divergence round each cycle.
-      if ((resp.type == RespType::ALLREDUCE ||
-           resp.type == RespType::BROADCAST) &&
-          resp.joined_ranks.empty() && resp.tensor_names.size() == 1) {
-        Request key;
-        key.type = static_cast<ReqType>(resp.type);
-        key.op = resp.op;
-        key.dtype = resp.dtype;
-        key.name = resp.tensor_names[0];
-        key.shape = resp.shapes[0];
-        key.root_rank = resp.root_rank;
-        key.prescale = resp.prescale;
-        key.postscale = resp.postscale;
-        cache_.Put(key, resp);
+      bool cacheable_type =
+          resp.type == RespType::ALLREDUCE ||
+          resp.type == RespType::BROADCAST ||
+          resp.type == RespType::ALLGATHER ||
+          resp.type == RespType::ALLTOALL ||
+          resp.type == RespType::REDUCESCATTER;
+      if (cacheable_type && resp.joined_ranks.empty() &&
+          resp.tensor_names.size() == 1) {
+        auto it = local_pending_.find(resp.tensor_names[0]);
+        // Readiness requires every non-joined rank to have submitted, so
+        // the local request exists for every cacheable response; skip
+        // defensively if not (e.g. future op kinds with other semantics).
+        if (it != local_pending_.end()) cache_.Put(it->second, resp);
       }
+      for (const auto& n : resp.tensor_names) local_pending_.erase(n);
       single.push_back(std::move(resp));
     }
   }
@@ -171,7 +180,8 @@ void Controller::CoordinatorIngest(const std::vector<RequestList>& lists,
     shutdown = shutdown || list.shutdown;
     for (const auto& req : list.requests) {
       if (req.type == ReqType::JOIN) {
-        joined_ranks_.insert(list.rank);
+        if (joined_ranks_.insert(list.rank).second)
+          last_joined_rank_ = list.rank;  // arrival order at cycle granularity
         continue;
       }
       auto& entry = message_table_[req.name];
@@ -217,8 +227,12 @@ void Controller::CoordinatorIngest(const std::vector<RequestList>& lists,
     j.type = RespType::JOIN;
     j.tensor_names.push_back("join");
     j.shapes.push_back({});
+    // root_rank carries the LAST rank to join (reference DoJoin contract:
+    // torch/mpi_ops_v2.cc — callers broadcast final state from it).
+    j.root_rank = last_joined_rank_;
     out->responses.push_back(j);
     joined_ranks_.clear();
+    last_joined_rank_ = -1;
   }
 
   out->shutdown = shutdown || stall_abort_;

@@ -77,6 +77,13 @@ class Controller {
 
   SocketComm* comm_;
   ResponseCache cache_;
+  // This rank's requests submitted through the slow path whose responses
+  // have not arrived yet (readiness may lag submission by many cycles
+  // while other ranks catch up).  Response processing uses these as the
+  // cache KEYS — the local metadata, not the coordinator's, is what the
+  // next Lookup compares against (allgather/alltoall first dims vary per
+  // rank).  Background-thread-only.
+  std::unordered_map<std::string, Request> local_pending_;
   std::atomic<int64_t> fusion_bytes_;
   StallInspector stall_;
 
@@ -87,6 +94,11 @@ class Controller {
   };
   std::map<std::string, TableEntry> message_table_;  // ordered => determinism
   std::set<int> joined_ranks_;
+  // Arrival order of joins at negotiation granularity (reference
+  // operations.cc:919-943 tracks the same so hvd.join() can return the
+  // rank holding the most-advanced state); carried to every rank in the
+  // JOIN response's root_rank field.
+  int last_joined_rank_ = -1;
   bool stall_abort_ = false;  // rank 0: stall exceeded the shutdown bound
 };
 

@@ -129,6 +129,9 @@ void Runtime::Dispatch(const Response& resp) {
       return;
     case RespType::JOIN:
       local_join_ = false;
+      // The coordinator stamps the last-joined rank into root_rank; park
+      // it for hvd_last_joined_rank() BEFORE releasing the waiter.
+      last_joined_.store(resp.root_rank);
       queue_.Complete({"join"}, Status::OK());
       return;
     case RespType::BARRIER:

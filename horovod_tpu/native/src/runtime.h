@@ -59,6 +59,9 @@ class Runtime {
   Status Wait(int64_t handle) { return queue_.Wait(handle); }
 
   int64_t cycles() const { return cycles_.load(); }
+  // Rank that joined LAST in the most recent completed join round
+  // (reference DoJoin output tensor); -1 before any round completes.
+  int last_joined() const { return last_joined_.load(); }
   int64_t cache_hits() { return controller_ ? controller_->cache_hits() : 0; }
   int64_t cache_entries() {
     return controller_ ? static_cast<int64_t>(controller_->cache_entries()) : 0;
@@ -93,6 +96,7 @@ class Runtime {
   std::atomic<int64_t> cycles_{0};
   std::atomic<int64_t> cycle_us_{1000};
   std::atomic<int> pending_cache_capacity_{-1};
+  std::atomic<int> last_joined_{-1};
   bool local_join_ = false;  // background-thread-only state
 };
 

@@ -8,6 +8,7 @@ Scenario selected by argv[1]: "full" (default) or "stall".
 
 import os
 import sys
+import time
 
 sys.path.insert(0, os.environ["REPO"])
 
@@ -122,6 +123,26 @@ def scenario_full():
         hvd.allreduce(x, hvd.Sum, name="cached.t")
     assert rt.cache_hits() >= 3, rt.cache_hits()
 
+    # allgather/alltoall response caching: first dims vary per rank, but
+    # the cache key is the LOCAL request, so fixed-shape repeats ride the
+    # bit-vector fast path too (reference response_cache.h:45-102).  The
+    # first iteration negotiates (slow path); all later ones must hit.
+    ag_mine = np.full((rank + 1, 2), float(rank), np.float32)
+    a2a_mine = np.repeat(np.arange(size, dtype=np.float32), 2)
+    hvd.allgather(ag_mine, name="ag.cached")
+    hvd.alltoall(a2a_mine, name="a2a.cached")
+    hits_before = rt.cache_hits()
+    for _ in range(4):
+        out = hvd.allgather(ag_mine, name="ag.cached")
+        assert out.shape == (total, 2), out.shape
+        hvd.alltoall(a2a_mine, name="a2a.cached")
+    # Tolerate a couple of slow-path fallbacks from cycle skew (a rank
+    # popping its submission a cycle before its peer clears the AND bit),
+    # same as the allreduce steady-state assertion above.
+    assert rt.cache_hits() - hits_before >= 5, (
+        "steady-state allgather/alltoall must be cache fast-path",
+        hits_before, rt.cache_hits())
+
     # autotuner knob application: cycle time + cache capacity.  Resize on
     # rank 0 FIRST so the ranks' bit-vector lengths disagree for a few
     # cycles — the padded AllreduceBitsAndOr must self-heal via the
@@ -150,10 +171,12 @@ def scenario_full():
             hvd.allreduce(x, hvd.Sum, name="after.err"), np.full((4,), total))
 
     # Join: rank 0 leaves early; others keep reducing with rank 0
-    # contributing zeros, then join too.
+    # contributing zeros, then join too.  The return value is the rank
+    # the coordinator saw join LAST — rank 0 went first, so it must be
+    # one of the stragglers, never 0.
     if size > 1:
         if rank == 0:
-            hvd.join()
+            last = hvd.join()
         else:
             y = np.ones((3,), np.float32)
             np.testing.assert_allclose(
@@ -161,9 +184,20 @@ def scenario_full():
             np.testing.assert_allclose(
                 hvd.allreduce(y, hvd.Average, name="join.r2"),
                 y * (size - 1) / size)
-            hvd.join()
+            last = hvd.join()
+        assert last != 0, f"rank 0 joined first yet join() returned {last}"
         np.testing.assert_allclose(
             hvd.allreduce(x, hvd.Sum, name="post.join"), np.full((4,), total))
+
+        # Second round with rank 0 joining LAST: every rank must get 0 —
+        # a value the pre-fix Max-of-ranks computation could never yield.
+        if rank == 0:
+            time.sleep(1.0)  # let the coordinator ingest the other joins
+        last = hvd.join()
+        assert last == 0, f"rank 0 joined last yet join() returned {last}"
+        np.testing.assert_allclose(
+            hvd.allreduce(x, hvd.Sum, name="post.join2"),
+            np.full((4,), total))
 
     hvd.barrier()
     hvd.shutdown()
