@@ -12,6 +12,22 @@ import horovod_tpu as hvd
 from horovod_tpu import autotune, callbacks, timeline
 
 
+@pytest.fixture(autouse=True)
+def _restore_hierarchical_env():
+    """The autotuner's _apply writes the HOROVOD_HIERARCHICAL_* env flags
+    while exploring categorical settings; leaking them would flip later
+    test files (make_train_step picks the hierarchical mesh and changes
+    collective semantics)."""
+    keys = ("HOROVOD_HIERARCHICAL_ALLREDUCE", "HOROVOD_HIERARCHICAL_ALLGATHER")
+    saved = {k: os.environ.get(k) for k in keys}
+    yield
+    for k, v in saved.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
 class _Model:
     params = {"w": np.ones(2, np.float32)}
     opt_state = None
