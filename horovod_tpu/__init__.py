@@ -63,6 +63,7 @@ from horovod_tpu.ops.collectives import (
     broadcast_async_,
     grouped_allreduce,
     poll,
+    process_sum,
     reducescatter,
     reducescatter_async,
     synchronize,

@@ -227,12 +227,15 @@ def _jax_train_fn(store, run_id, spec, num_proc):
         if spec.get("verbose") and rank == 0:
             print(f"epoch {epoch}: loss {history[-1]:.4f}")
         if xv is not None:
-            # row-weighted global mean: shards differ by up to one row
+            # row-weighted global mean: shards differ by up to one row.
+            # process_sum, not Sum: the payload is PROCESS-level data
+            # (this process's shard rows), so the chip-weighted eager Sum
+            # would skew the mean when chip counts differ per process.
             part = np.asarray([
                 float(val_loss_fn(params, xv, yv)) * len(xv),
                 float(len(xv)),
             ], np.float32)
-            tot = hvd.allreduce(part, hvd.Sum, name=f"val.{epoch}")
+            tot = hvd.process_sum(part, name=f"val.{epoch}")
             val_history.append(float(tot[0] / tot[1]))
 
     if rank == 0:
@@ -368,9 +371,13 @@ def _torch_train_fn(store, run_id, spec, num_proc):
         if xv is not None:
             with torch.no_grad():
                 vloss = float(loss_fn(model(xv), yv)) * len(xv)
+            # Process-level sum: pre-divide by local_size so the
+            # chip-weighted eager Sum reduces one contribution per
+            # process (see collectives.process_sum).
             part = hvd.allreduce(
                 torch.tensor([vloss, float(len(xv))]), op=hvd.Sum,
-                name=f"val.{epoch}")
+                name=f"val.{epoch}",
+                prescale_factor=1.0 / hvd.local_size())
             val_history.append(float(part[0] / part[1]))
 
     if rank == 0:

@@ -284,22 +284,46 @@ def _to_global(x: np.ndarray):
     )
 
 
+@functools.lru_cache(maxsize=1)
+def _process_local_counts() -> tuple:
+    """Chips per process, ordered by process index.
+
+    This is the weight each process's eager contribution carries: the
+    API's worker count is CHIPS (``basics.size()``), so with
+    ``local_size > 1`` (one process driving several chips) an eager
+    submission stands for every local chip — Sum multiplies by the local
+    count and Average divides by ``size()``, keeping eager and in-graph
+    reductions consistent (the reference has no such seam because a
+    process is exactly one GPU; ``common/basics.py:22-211`` contract)."""
+    counts: dict = {}
+    for d in basics.mesh().devices.flat:
+        counts[d.process_index] = counts.get(d.process_index, 0) + 1
+    return tuple(counts[p] for p in sorted(counts))
+
+
 @functools.lru_cache(maxsize=4096)
-def _compiled_reduce(op: str, nproc: int):
+def _compiled_reduce(op: str, counts: tuple):
     pm = _process_mesh()
     repl = jax.sharding.NamedSharding(pm, jax.sharding.PartitionSpec())
+    nchips = int(sum(counts))
+    weighted = any(c != 1 for c in counts)
 
     def fn(a):
+        if weighted and op in (Sum, Average, Product):
+            w = jnp.asarray(np.asarray(counts), a.dtype).reshape(
+                (-1,) + (1,) * (a.ndim - 1))
         if op == Sum:
-            return a.sum(axis=0)
+            return (a * w).sum(axis=0) if weighted else a.sum(axis=0)
         if op == Average:
-            return a.mean(axis=0)
+            # Promote like jnp.mean (ints divide to float).
+            s = (a * w).sum(axis=0) if weighted else a.sum(axis=0)
+            return s / nchips
         if op == Min:
-            return a.min(axis=0)
+            return a.min(axis=0)  # duplicates don't change min/max
         if op == Max:
             return a.max(axis=0)
         if op == Product:
-            return a.prod(axis=0)
+            return (a ** w).prod(axis=0) if weighted else a.prod(axis=0)
         raise AssertionError(op)
 
     return jax.jit(fn, out_shardings=repl)
@@ -334,20 +358,31 @@ def _pick_program(mesh, axis: str, src: int):
     )
 
 
-def _reducescatter_program(mesh, axis: str, op: str):
+def _reducescatter_program(mesh, axis: str, op: str, counts: tuple = None):
     """Eager reduce-scatter as a true ``lax.psum_scatter`` (each process
     receives only its reduced 1/P slice and each link carries (P-1)/P of
     one tensor — half the all-reduce cost; reference
-    ``ops/nccl_operations.cc:162-354`` intra-node phase)."""
+    ``ops/nccl_operations.cc:162-354`` intra-node phase).
+
+    ``counts``: chips per process (see :func:`_process_local_counts`) —
+    contributions are chip-weighted so Sum/Average match the in-graph
+    (worker-axis) semantics when ``local_size > 1``."""
     from horovod_tpu import spmd
 
     spec = jax.sharding.PartitionSpec(axis)
+    weighted = counts is not None and any(c != 1 for c in counts)
+    denom = int(sum(counts)) if counts else None
 
     def fn(block):  # per-shard: (1, d0, ...)
         t = jnp.squeeze(block, 0)
+        if weighted:
+            w = jnp.asarray(np.asarray(counts), t.dtype)[
+                lax.axis_index(axis)]
+            t = t * w
         out = lax.psum_scatter(t, axis, scatter_dimension=0, tiled=True)
         if op == Average:
-            out = out / jnp.asarray(lax.axis_size(axis), out.dtype)
+            n = denom if denom is not None else lax.axis_size(axis)
+            out = out / jnp.asarray(n, out.dtype)
         return out[None]
 
     return jax.jit(spmd.shard(fn, in_specs=spec, out_specs=spec, mesh=mesh))
@@ -375,7 +410,8 @@ def _compiled_pick(src: int):
 
 @functools.lru_cache(maxsize=16)
 def _compiled_reducescatter(op: str):
-    return _reducescatter_program(_process_mesh(), "proc", op)
+    return _reducescatter_program(_process_mesh(), "proc", op,
+                                  _process_local_counts())
 
 
 @functools.lru_cache(maxsize=1)
@@ -399,14 +435,25 @@ def _eager_allreduce(x, op: str, prescale, postscale) -> np.ndarray:
     if prescale is not None and prescale != 1.0:
         xh = xh * np.asarray(prescale, xh.dtype)
     if basics.cross_size() == 1:
-        out = xh.copy()
+        # Same chip-weighted semantics as the multi-process path: one
+        # process driving N chips submits a value that stands for every
+        # local chip, so Sum is N*x (== the in-graph worker-axis psum)
+        # and Average is N*x/size() == x.  Min/Max/Adasum(identical
+        # contributions) are duplicate-insensitive.
+        ls = basics.local_size()
+        if ls > 1 and op == Sum:
+            out = xh * np.asarray(ls, xh.dtype)
+        elif ls > 1 and op == Product:
+            out = xh ** ls
+        else:
+            out = xh.copy()
     elif op == Adasum:
         from horovod_tpu.ops import adasum as _adasum
 
         out = _adasum.eager_adasum(xh)
     else:
         out = _replicated_to_host(
-            _compiled_reduce(op, basics.cross_size())(_to_global(xh))
+            _compiled_reduce(op, _process_local_counts())(_to_global(xh))
         )
     if postscale is not None and postscale != 1.0:
         out = out * np.asarray(postscale, out.dtype)
@@ -448,9 +495,14 @@ def _eager_reducescatter(x, op: str) -> np.ndarray:
     if xh.shape[0] % P != 0:
         raise ValueError(
             f"reducescatter requires dim0 ({xh.shape[0]}) divisible by the "
-            f"worker count ({P})"
+            f"process count ({P}) on the eager path"
         )
     if P == 1:
+        # Chip-weighted like _eager_allreduce: Sum over N local chips is
+        # N*x; Average is N*x/size() == x.
+        ls = basics.local_size()
+        if ls > 1 and op == Sum:
+            return xh * np.asarray(ls, xh.dtype)
         return xh.copy()
     return _local_shard_to_host(_compiled_reducescatter(op)(_to_global(xh)))[0]
 
@@ -599,6 +651,23 @@ def allreduce(
     if compression is not None:
         out = compression.decompress(out, ctx)
     return out
+
+
+def process_sum(tensor, *, name: Optional[str] = None):
+    """Sum one contribution PER PROCESS (eager path).
+
+    The eager ``Sum`` is chip-weighted — each process's submission stands
+    for all ``local_size()`` chips it drives (see docs/concepts.md).  Use
+    this instead when the payload is process-level data (a shard's row
+    count, a per-process aggregate): the pre-division by the local chip
+    count makes the chip weighting cancel exactly, also with
+    heterogeneous chip counts (Σ ls_p · x_p/ls_p = Σ x_p)."""
+    if _is_traced(tensor):
+        raise ValueError(
+            "process_sum is an eager (host-side) op; in-graph code sums "
+            "per chip with allreduce(op=Sum)")
+    ls = float(basics.local_size()) if basics.is_initialized() else 1.0
+    return allreduce(tensor, Sum, name=name, prescale_factor=1.0 / ls)
 
 
 def grouped_allreduce(tensors: Sequence, op: str = Average, *, axis_name=None, **kw):

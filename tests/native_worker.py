@@ -16,12 +16,16 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+SCENARIO = sys.argv[1] if len(sys.argv) > 1 else "full"
+if SCENARIO == "localsize":
+    # 2 chips per process: the worker-count seam scenario (size() = 2 *
+    # num_processes) — must be configured before hvd.init() builds the mesh.
+    jax.config.update("jax_num_cpu_devices", 2)
+
 import numpy as np  # noqa: E402
 
 import horovod_tpu as hvd  # noqa: E402
 from horovod_tpu import eager_runtime  # noqa: E402
-
-SCENARIO = sys.argv[1] if len(sys.argv) > 1 else "full"
 
 rank = int(os.environ["HOROVOD_RANK"])
 size = int(os.environ.get("HOROVOD_NUM_PROC", os.environ.get("HOROVOD_SIZE")))
@@ -204,7 +208,72 @@ def scenario_full():
     print(f"NATIVE-WORKER-OK rank={rank}")
 
 
+def scenario_localsize():
+    """The eager/in-graph worker-count seam (2 procs x 2 chips each):
+    size() counts CHIPS, so eager reductions must weight each process's
+    contribution by its local chip count — eager Sum/Average must equal
+    the in-graph (worker-axis) collectives and sum/size()."""
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from horovod_tpu import basics, spmd
+    from horovod_tpu.ops import collectives as C
+
+    assert hvd.num_processes() == size
+    assert hvd.local_size() == 2, hvd.local_size()
+    assert hvd.size() == 2 * size, hvd.size()
+
+    x = np.full((3,), float(rank + 1), np.float32)  # process p holds p+1
+    chip_sum = sum(2.0 * (p + 1) for p in range(size))
+
+    out = hvd.allreduce(x, hvd.Sum, name="ls.sum")
+    np.testing.assert_allclose(out, np.full((3,), chip_sum))
+    avg = hvd.allreduce(x, hvd.Average, name="ls.avg")
+    np.testing.assert_allclose(avg, np.full((3,), chip_sum / hvd.size()))
+
+    # In-graph oracle over the full 4-chip mesh: every chip holds its
+    # process's value; in-graph Average must equal the eager result.
+    mesh = basics.mesh()
+    ax = basics.axis_name()
+    sharding = NamedSharding(mesh, P(ax))
+    mine = [d for d in mesh.devices.flat if d.process_index == jax.process_index()]
+    shards = [jax.device_put(x[None], d) for d in mine]
+    garr = jax.make_array_from_single_device_arrays(
+        (hvd.size(), 3), sharding, shards)
+
+    def fn(t):
+        return C.allreduce(jnp.squeeze(t, 0), C.Average)[None]
+
+    ingraph = spmd.run(fn, garr, in_specs=P(ax), out_specs=P(ax))
+    local = np.asarray(ingraph.addressable_shards[0].data)[0]
+    np.testing.assert_allclose(local, avg, rtol=1e-6)
+
+    # Min/Max are insensitive to duplicate contributions.
+    np.testing.assert_allclose(
+        hvd.allreduce(x, hvd.Min, name="ls.min"), np.full((3,), 1.0))
+    np.testing.assert_allclose(
+        hvd.allreduce(x, hvd.Max, name="ls.max"), np.full((3,), float(size)))
+
+    # process_sum: ONE contribution per process (the chip weighting
+    # cancels) — the idiom for process-level payloads like row counts.
+    np.testing.assert_allclose(
+        hvd.process_sum(x, name="ls.psum"),
+        np.full((3,), sum(p + 1 for p in range(size))))
+
+    # reducescatter: chip-weighted Sum, Average divides by size().
+    rs_in = np.tile(x, (size, 1))  # (size, 3): slice p goes to process p
+    rs = hvd.reducescatter(rs_in, hvd.Average, name="ls.rs")
+    np.testing.assert_allclose(
+        rs, np.full((1, 3), chip_sum / hvd.size()).reshape(rs.shape))
+
+    hvd.barrier()
+    hvd.shutdown()
+    print(f"NATIVE-WORKER-OK rank={rank}")
+
+
 if SCENARIO == "stall":
     scenario_stall()
+elif SCENARIO == "localsize":
+    scenario_localsize()
 else:
     scenario_full()

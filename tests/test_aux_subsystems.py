@@ -138,9 +138,12 @@ class TestTensorFlowShim:
     def test_allreduce(self, hvd):
         import horovod_tpu.tensorflow as hvd_tf
 
+        # Sum is chip-weighted (one process speaks for local_size chips);
+        # Average is the identity at one process.
+        ls = hvd_tf.local_size()
         x = tf.constant([1.0, 2.0, 3.0])
         out = hvd_tf.allreduce(x, op=hvd_tf.Sum)
-        np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])
+        np.testing.assert_allclose(out.numpy(), [ls * 1.0, ls * 2.0, ls * 3.0])
         out = hvd_tf.allreduce(x)  # default Average
         np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])
 
@@ -390,12 +393,13 @@ class TestTFFunctionAllreduce:
         def reduced_sum(t):
             return hvd_tf.allreduce(t, op=hvd_tf.Sum, name="tf.fn.t")
 
+        ls = hvd_tf.local_size()
         x = tf.constant([1.0, 2.0, 3.0])
         out = reduced_sum(x)
-        np.testing.assert_allclose(out.numpy(), [1.0, 2.0, 3.0])  # size 1
+        np.testing.assert_allclose(out.numpy(), [ls * v for v in (1., 2., 3.)])
         # re-invocation reuses the same trace + collective name
         out2 = reduced_sum(tf.constant([4.0, 5.0, 6.0]))
-        np.testing.assert_allclose(out2.numpy(), [4.0, 5.0, 6.0])
+        np.testing.assert_allclose(out2.numpy(), [ls * v for v in (4., 5., 6.)])
 
     def test_auto_name_from_symbolic_tensor(self, hvd):
         tf = pytest.importorskip("tensorflow")

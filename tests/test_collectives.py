@@ -153,11 +153,18 @@ class TestInGraphOthers:
 
 
 class TestEager:
-    """Single-process eager semantics: size-1 process group identities."""
+    """Single-process eager semantics.
+
+    Worker count is CHIPS (`hvd.size()` — here the 8 virtual devices of
+    the test mesh), and an eager submission stands for every local chip,
+    so Sum is chip-weighted (local_size ×) while Average/Min/Max are
+    identities — exactly the in-graph worker-axis semantics."""
 
     def test_allreduce_identity(self):
         x = np.random.randn(5, 4).astype(np.float32)
-        np.testing.assert_allclose(hvd.allreduce(x, hvd.Sum), x)
+        ls = hvd.local_size()
+        np.testing.assert_allclose(hvd.allreduce(x, hvd.Sum), ls * x,
+                                   rtol=1e-6)
         np.testing.assert_allclose(hvd.allreduce(x, hvd.Average), x)
 
     def test_allgather_identity(self):
@@ -171,8 +178,9 @@ class TestEager:
     def test_grouped_allreduce(self):
         xs = [np.random.randn(4).astype(np.float32) for _ in range(5)]
         outs = hvd.grouped_allreduce(xs, hvd.Sum)
+        ls = hvd.local_size()
         for a, b in zip(outs, xs):
-            np.testing.assert_allclose(a, b, rtol=1e-6)
+            np.testing.assert_allclose(a, ls * b, rtol=1e-6)
 
     def test_barrier(self):
         hvd.barrier()
@@ -195,7 +203,7 @@ class TestAsyncHandles:
             assert time.time() < deadline
             time.sleep(0.001)
         out = hvd.synchronize(h)
-        np.testing.assert_allclose(out, x, rtol=1e-6)
+        np.testing.assert_allclose(out, hvd.local_size() * x, rtol=1e-6)
 
     def test_handle_single_use(self):
         h = hvd.allreduce_async(np.ones(2, np.float32))
@@ -207,7 +215,8 @@ class TestAsyncHandles:
         xs = [np.random.randn(3).astype(np.float32) for _ in range(4)]
         handles = [hvd.allreduce_async(x, hvd.Sum, name=f"t{i}") for i, x in enumerate(xs)]
         for h, x in zip(handles, xs):
-            np.testing.assert_allclose(hvd.synchronize(h), x, rtol=1e-6)
+            np.testing.assert_allclose(
+                hvd.synchronize(h), hvd.local_size() * x, rtol=1e-6)
 
     def test_broadcast_allgather_alltoall_async(self):
         x = np.random.randn(8, 2).astype(np.float32)

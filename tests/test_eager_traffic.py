@@ -131,13 +131,17 @@ class TestSingleProcessFallbacks:
     exercise the local-identity paths and input validation."""
 
     def test_reducescatter_eager_single(self, hvd):
+        # Chip-weighted Sum: the single process speaks for all its chips.
         x = np.arange(8, dtype=np.float32)
-        np.testing.assert_allclose(hvd.reducescatter(x, hvd.Sum), x)
+        np.testing.assert_allclose(
+            hvd.reducescatter(x, hvd.Sum), hvd.local_size() * x)
+        np.testing.assert_allclose(hvd.reducescatter(x, hvd.Average), x)
 
     def test_reducescatter_async_roundtrip(self, hvd):
         x = np.arange(8, dtype=np.float32)
         h = hvd.reducescatter_async(x, hvd.Sum)
-        np.testing.assert_allclose(hvd.synchronize(h), x)
+        np.testing.assert_allclose(
+            hvd.synchronize(h), hvd.local_size() * x)
 
     def test_reducescatter_rejects_bad_op(self, hvd):
         with pytest.raises(ValueError):

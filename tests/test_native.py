@@ -58,7 +58,9 @@ class TestSingleProcessRuntime:
         before = rt.cycles()
         out = hvd.allreduce(np.arange(6, dtype=np.float32), hvd.Sum,
                             name="nat.t1")
-        np.testing.assert_allclose(out, np.arange(6, dtype=np.float32))
+        # Chip-weighted Sum: the submission stands for every local chip.
+        np.testing.assert_allclose(
+            out, hvd.local_size() * np.arange(6, dtype=np.float32))
         assert rt.cycles() > before
 
     def test_fused_async_group(self, hvd):
@@ -68,8 +70,9 @@ class TestSingleProcessRuntime:
             for i in range(4)
         ]
         for i, h in enumerate(hs):
-            np.testing.assert_allclose(hvd.synchronize(h),
-                                       np.full((5,), float(i)))
+            np.testing.assert_allclose(
+                hvd.synchronize(h),
+                np.full((5,), float(i * hvd.local_size())))
 
     def test_duplicate_name_rejected(self, hvd):
         h = hvd.allreduce_async(np.ones(3), hvd.Sum, name="nat.dup")
@@ -112,7 +115,8 @@ class TestResponseWire:
         out = hvd.allreduce(np.full((2, 3), 2.0, np.float32), hvd.Sum,
                             name="nat.scaled", prescale_factor=0.5,
                             postscale_factor=4.0)
-        np.testing.assert_allclose(out, np.full((2, 3), 4.0))
+        np.testing.assert_allclose(
+            out, np.full((2, 3), 4.0 * hvd.local_size()))
 
 
 def _spawn_workers(tmp_path, scenario, extra_env=None, nproc=2):
@@ -146,6 +150,17 @@ class TestMultiProcess:
             out / "rank.1.stderr").read_text()
         assert "NATIVE-WORKER-OK rank=0" in r0
         assert "NATIVE-WORKER-OK rank=1" in r1
+
+    def test_worker_count_seam_two_chips_per_process(self, tmp_path):
+        """2 processes x 2 virtual chips each: eager Sum/Average must be
+        CHIP-level (weight per-process contributions by local_size,
+        divide Average by size()) and match the in-graph collectives —
+        the eager/in-graph worker-count seam."""
+        rc, out = _spawn_workers(tmp_path, "localsize")
+        assert rc == 0, (out / "rank.0.stderr").read_text() + (
+            out / "rank.1.stderr").read_text()
+        for r in (0, 1):
+            assert "NATIVE-WORKER-OK" in (out / f"rank.{r}.stdout").read_text()
 
     def test_stall_inspector_warns(self, tmp_path):
         rc, out = _spawn_workers(
