@@ -40,7 +40,12 @@ def main() -> None:
     ap.add_argument("--seq", type=int, default=2048)
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--attention", default="flash",
-                    choices=["reference", "flash"])
+                    choices=["reference", "flash", "ring", "ring_reference"])
+    ap.add_argument("--sp", type=int, default=0,
+                    help="ring attention: sequence-parallel axis size "
+                         "(0 = all chips). sp=1 measures the ring "
+                         "plumbing + flash-chunk path against plain "
+                         "flash on identical shapes.")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize layers in backward (jax.checkpoint)")
     ap.add_argument("--remat-policy", default="full",
@@ -68,28 +73,59 @@ def main() -> None:
         n_experts=args.n_experts,
     )
     params = T.init_params(jax.random.PRNGKey(0), cfg)
-    opt = hvd.DistributedOptimizer(optax.adamw(3e-4))
+    if args.attention.startswith("ring"):
+        # Ring runs under a (dp, sp) shard_map; gradients are pmean'd
+        # over both axes in the step, so the inner optimizer is plain.
+        opt = optax.adamw(3e-4)
+    else:
+        opt = hvd.DistributedOptimizer(optax.adamw(3e-4))
     opt_state = opt.init(params)
 
-    mesh, axis = hvd.mesh(), hvd.AXIS
+    n = hvd.size()
+    ring = args.attention.startswith("ring")
+    if ring:
+        # Sequence-parallel: the sp axis must be BOUND (shard_map) so K/V
+        # shards can ppermute around the ring through the flash kernels.
+        # Gradients are pmean'd explicitly (the optimizer is plain optax).
+        from horovod_tpu.parallel.meshes import MeshSpec, make_mesh
 
-    def _step(params, opt_state, tokens):
-        batch = {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+        sp = args.sp or n
+        dp = n // sp
+        mesh = make_mesh(MeshSpec(dp=dp, sp=sp))
+        data_axes = ("dp", "sp")
+        batch_spec = P("dp", "sp")
+        rows = args.batch_size * dp
+    else:
+        mesh = hvd.mesh()
+        data_axes = (hvd.AXIS,)
+        batch_spec = P(hvd.AXIS)
+        rows = args.batch_size * n
+
+    def _step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(
             lambda p: T.loss_fn(p, batch, cfg))(params)
+        if ring:
+            grads = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, data_axes), grads)
         updates, opt_state = opt.update(grads, opt_state, params)
         return (optax.apply_updates(params, updates), opt_state,
-                jax.lax.pmean(loss, axis))
+                jax.lax.pmean(loss, data_axes))
 
     step = jax.jit(spmd.shard(
-        _step, in_specs=(P(), P(), P(axis)), out_specs=(P(), P(), P()),
-        mesh=mesh), donate_argnums=(0, 1))
-
-    n = hvd.size()
-    tokens = jax.device_put(
-        jnp.asarray(np.random.randint(
-            0, args.vocab, (args.batch_size * n, args.seq)), jnp.int32),
-        NamedSharding(mesh, P(axis)))
+        _step, in_specs=(P(), P(), batch_spec),
+        out_specs=(P(), P(), P()), mesh=mesh), donate_argnums=(0, 1))
+    # Targets are the FULL-sequence next-token shift, computed before
+    # sharding: a per-shard roll inside the step would wrap around each
+    # sp chunk, silently training a different objective on the ring path.
+    tok_host = np.random.randint(0, args.vocab, (rows, args.seq))
+    tokens = {
+        "tokens": jax.device_put(
+            jnp.asarray(tok_host, jnp.int32),
+            NamedSharding(mesh, batch_spec)),
+        "targets": jax.device_put(
+            jnp.asarray(np.roll(tok_host, -1, axis=1), jnp.int32),
+            NamedSharding(mesh, batch_spec)),
+    }
 
     step = step.lower(params, opt_state, tokens).compile()
     # Analytic FLOPs (XLA's cost analysis counts a lax.scan body ONCE, so
@@ -98,7 +134,10 @@ def main() -> None:
     n_matmul = sum(
         int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params)
     ) - int(np.prod(params["embed"].shape))  # embed lookup does no matmul
-    B, S = args.batch_size, args.seq
+    # Per-chip FLOPs: global batch rows / n chips (for ring, the sequence
+    # is sharded too, so per-chip work is global work / n either way).
+    B = rows / n
+    S = args.seq
     dense_flops = 6 * n_matmul * B * S
     attn_flops = 6 * args.n_layers * B * S * S * args.d_model  # causal
     # MFU convention (PaLM appendix B): model FLOPs only — remat's
@@ -127,7 +166,7 @@ def main() -> None:
         times.append((time.perf_counter() - t0) / args.steps_per_iter)
 
     med = float(np.median(times))
-    tokens_per_step = args.batch_size * args.seq  # per chip
+    tokens_per_step = rows * args.seq / n  # per chip
     result = {
         "metric": (f"TransformerLM d{args.d_model} L{args.n_layers} "
                    f"seq{args.seq}"

@@ -46,7 +46,9 @@ class TransformerConfig:
     # "reference" = O(S^2) XLA softmax-attention; "flash" = the Pallas
     # fused kernel (horovod_tpu.ops.attention); "ring" = sequence-parallel
     # ring attention over the ``sp`` mesh axis (requires running under
-    # shard_map with sp bound and sequence sharded over it).
+    # shard_map with sp bound and sequence sharded over it; chunks run the
+    # flash kernel).  "ring_reference" keeps the masked-XLA chunk math —
+    # the second oracle and the benchmarking control for the kernel path.
     attention_impl: str = "reference"
     # Rematerialize each layer in the backward pass (jax.checkpoint):
     # activations are recomputed instead of stored, trading ~1/3 more
@@ -188,7 +190,7 @@ def _attention(x, p, cfg: TransformerConfig):
     k = jnp.einsum("bsd,dhk->bshk", x, p["wk"].astype(cfg.dtype))
     v = jnp.einsum("bsd,dhk->bshk", x, p["wv"].astype(cfg.dtype))
     pos_offset = 0
-    if cfg.attention_impl in ("ring", "ulysses"):
+    if cfg.attention_impl in ("ring", "ring_reference", "ulysses"):
         # Sequence is sharded over sp: this shard's tokens start at
         # sp_index * S_local in the global sequence.
         pos_offset = lax.axis_index("sp") * S
@@ -200,6 +202,9 @@ def _attention(x, p, cfg: TransformerConfig):
     vh = jnp.moveaxis(v, 2, 1)
     if cfg.attention_impl == "ring":
         oh = attn.ring_attention(qh, kh, vh, axis_name="sp", causal=True)
+    elif cfg.attention_impl == "ring_reference":
+        oh = attn.ring_attention(qh, kh, vh, axis_name="sp", causal=True,
+                                 impl="reference")
     elif cfg.attention_impl == "ulysses":
         oh = attn.ulysses_attention(qh, kh, vh, axis_name="sp", causal=True)
     elif cfg.attention_impl == "flash":
@@ -209,7 +214,7 @@ def _attention(x, p, cfg: TransformerConfig):
     else:
         raise ValueError(
             f"unknown attention_impl {cfg.attention_impl!r}; expected "
-            "'reference', 'flash', 'ring' or 'ulysses'")
+            "'reference', 'flash', 'ring', 'ring_reference' or 'ulysses'")
     o = jnp.moveaxis(oh, 1, 2).astype(cfg.dtype)  # (B, S, H, Dh)
     return jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(cfg.dtype))
 
