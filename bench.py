@@ -42,6 +42,10 @@ def main() -> None:
     ap.add_argument("--num-iters", type=int, default=10)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--fp16-allreduce", action="store_true")
+    ap.add_argument("--stem", default="conv7", choices=["conv7", "s2d"],
+                    help="ResNet stem: canonical 7x7/2 conv, or 2x2 "
+                         "space-to-depth + 4x4 conv (same function class, "
+                         "4x the MXU input-channel occupancy)")
     args = ap.parse_args()
 
     import horovod_tpu as hvd
@@ -53,7 +57,11 @@ def main() -> None:
     models_mod = inception if args.model == "InceptionV3" else resnet
     if args.model == "InceptionV3" and args.image_size == 224:
         args.image_size = 299  # Inception's native resolution
-    model = models_mod.create(args.model, num_classes=1000)
+    if args.model == "InceptionV3":
+        model = models_mod.create(args.model, num_classes=1000)
+    else:
+        model = models_mod.create(args.model, num_classes=1000,
+                                  stem=args.stem)
     rng = jax.random.PRNGKey(42)
     variables = models_mod.init_variables(model, rng, args.image_size, batch=2)
     params, batch_stats = variables["params"], variables["batch_stats"]
