@@ -181,6 +181,31 @@ class TestSparkRunPath:
         assert sc.cancelled == sc.job_groups
 
 
+class TestSparkDispatch:
+    def test_run_dispatches_to_spark_branch(self, monkeypatch):
+        """hvd_spark.run() itself (not just _spark_run) must take the
+        Spark branch when a pyspark module with an active context is
+        importable — covers the dispatch glue: module import, active-
+        context lookup, argument forwarding."""
+        import sys
+        import types
+
+        sc = FakeSparkContext()
+        fake_pyspark = types.ModuleType("pyspark")
+
+        class _SC:
+            _active_spark_context = sc
+
+        fake_pyspark.SparkContext = _SC
+        monkeypatch.setitem(sys.modules, "pyspark", fake_pyspark)
+
+        out = hvd_spark.run(_fn_report, ("dispatch",), num_proc=2,
+                            verbose=0)
+        assert [o["rank"] for o in out] == ["0", "1"]
+        assert all(o["tag"] == "dispatch" for o in out)
+        assert sc.job_groups, "must have gone through _spark_run"
+
+
 class TestRankAssignment:
     def test_host_contiguous(self):
         tasks = [
