@@ -60,7 +60,15 @@ def allreduce(tensor, average=None, op=None, name=None,
     with the collective name captured at trace time from the symbolic
     tensor (identical across ranks since the traced program is), so
     out-of-order runtime execution of independent allreduces is matched
-    by name in the native coordinator."""
+    by name in the native coordinator.
+
+    DIFFERENTIABLE on both paths: the dense op carries a
+    ``tf.custom_gradient`` whose backward is an allreduce of the
+    upstream gradient with the same op (the reference registers exactly
+    this, ``tensorflow/mpi_ops.py:110-121`` ``_allreduce_grad``), so
+    ``tf.GradientTape`` flows through ``hvd.allreduce`` calls inside a
+    loss — eagerly AND under ``tf.function`` — instead of silently
+    detaching at the numpy bridge."""
     if op is None:
         op = Average if (average is None or average) else Sum
     if isinstance(tensor, tf.IndexedSlices):
@@ -85,22 +93,49 @@ def allreduce(tensor, average=None, op=None, name=None,
                 C.allgather(_to_np(tensor.indices), name=f"{nm}.indices"))
             return tf.IndexedSlices(values, indices,
                                     dense_shape=tensor.dense_shape)
-    if tf.inside_function():
+
+    in_fn = tf.inside_function()
+    if in_fn:
         cname = name or "tf." + tensor.name.replace(":", ".")
+    else:
+        cname = name
+    # Allreduce is linear, so the VJP of Sum/Average is the same op on
+    # the cotangent (scaled by the linear pre/post factors); the
+    # reference's _allreduce_grad uses a plain sum-allreduce for the
+    # nonlinear ops too, mirrored here.
+    grad_op = op if op in (Average, Sum) else Sum
+    scale = prescale_factor * postscale_factor
 
-        def _bridge(t):
-            out = C.allreduce(t.numpy(), op, name=cname,
-                              prescale_factor=prescale_factor,
-                              postscale_factor=postscale_factor)
-            return tf.convert_to_tensor(out)
+    def _run(t, the_op, nm, pre, post):
+        if in_fn:
+            def _bridge(tt):
+                return tf.convert_to_tensor(C.allreduce(
+                    tt.numpy(), the_op, name=nm,
+                    prescale_factor=pre, postscale_factor=post))
 
-        result = tf.py_function(_bridge, [tensor], Tout=tensor.dtype)
-        result.set_shape(tensor.shape)
-        return result
-    out = C.allreduce(_to_np(tensor), op, name=name,
-                      prescale_factor=prescale_factor,
-                      postscale_factor=postscale_factor)
-    return tf.convert_to_tensor(out)
+            r = tf.py_function(_bridge, [t], Tout=t.dtype)
+            r.set_shape(t.shape)
+            return r
+        return tf.convert_to_tensor(C.allreduce(
+            _to_np(t), the_op, name=nm,
+            prescale_factor=pre, postscale_factor=post))
+
+    @tf.custom_gradient
+    def _fn(t):
+        result = _run(t, op, cname, prescale_factor, postscale_factor)
+
+        def grad(dy):
+            # A sparse cotangent (e.g. the loss gathered rows of the
+            # reduced tensor) densifies first, as TF does implicitly for
+            # registered op gradients.
+            if isinstance(dy, tf.IndexedSlices):
+                dy = tf.convert_to_tensor(dy)
+            gname = f"{cname}.grad" if cname else None
+            return _run(dy, grad_op, gname, scale, 1.0)
+
+        return result, grad
+
+    return _fn(tensor)
 
 
 def allgather(tensor, name=None):

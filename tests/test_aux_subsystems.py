@@ -416,15 +416,72 @@ class TestTFFunctionAllreduce:
         tf = pytest.importorskip("tensorflow")
         import horovod_tpu.tensorflow as hvd_tf
 
-        # tf.py_function is differentiable-opaque; the supported pattern
-        # (reference DistributedGradientTape) reduces GRADIENTS, so check
-        # that path composes with tf.function compute.
+        # The reference DistributedGradientTape pattern (reduce GRADIENTS)
+        # composing with tf.function compute.
         v = tf.Variable([1.0, 2.0])
         with tf.GradientTape() as tape:
             loss = tf.reduce_sum(v * v)
         grads = tape.gradient(loss, [v])
         reduced = hvd_tf.allreduce(grads[0], op=hvd_tf.Average)
         np.testing.assert_allclose(reduced.numpy(), [2.0, 4.0])
+
+    def test_tape_flows_through_eager_allreduce(self, hvd):
+        """hvd.allreduce INSIDE a taped loss must be differentiable
+        (reference tensorflow/mpi_ops.py:110-121 _allreduce_grad): the
+        custom gradient is an allreduce of the upstream gradient — the
+        numpy bridge must not silently detach the tape."""
+        tf = pytest.importorskip("tensorflow")
+        import horovod_tpu.tensorflow as hvd_tf
+
+        ls = hvd_tf.local_size()
+        v = tf.Variable([1.0, 2.0])
+        with tf.GradientTape() as tape:
+            y = hvd_tf.allreduce(v * v, op=hvd_tf.Sum, name="tape.e")
+            loss = tf.reduce_sum(y)
+        (g,) = tape.gradient(loss, [v])
+        # y = ls * v^2 (chip-weighted Sum) so dL/dv = ls * 2v — and the
+        # backward allreduce(dy, Sum) = ls * dy delivers exactly that:
+        # the chip-weighted Sum is its own VJP.
+        np.testing.assert_allclose(g.numpy(), [ls * 2.0, ls * 4.0])
+
+    def test_tape_flows_through_function_allreduce(self, hvd):
+        """Same through tf.function: the py_function bridge carries the
+        custom gradient."""
+        tf = pytest.importorskip("tensorflow")
+        import horovod_tpu.tensorflow as hvd_tf
+
+        ls = hvd_tf.local_size()
+        v = tf.Variable([3.0])
+
+        @tf.function
+        def loss_fn():
+            y = hvd_tf.allreduce(v * v, op=hvd_tf.Average, name="tape.f")
+            return tf.reduce_sum(y)
+
+        with tf.GradientTape() as tape:
+            loss = loss_fn()
+        (g,) = tape.gradient(loss, [v])
+        # Average is the identity at one process (for any chip count):
+        # grad(Average) is Average — also the identity — so g = 2v
+        # exactly.  A backward that leaked the chip-weighted Sum would
+        # return ls * 2v and fail this on the 8-virtual-chip test mesh.
+        np.testing.assert_allclose(g.numpy(), [6.0])
+        assert ls > 1, "test mesh must have >1 chip to discriminate"
+
+    def test_sparse_cotangent_through_allreduce(self, hvd):
+        """A loss that GATHERS rows of the reduced tensor produces an
+        IndexedSlices cotangent; the backward must densify it instead of
+        handing a dtype=object array to the native runtime."""
+        tf = pytest.importorskip("tensorflow")
+        import horovod_tpu.tensorflow as hvd_tf
+
+        v = tf.Variable([[1.0, 2.0], [3.0, 4.0]])
+        with tf.GradientTape() as tape:
+            y = hvd_tf.allreduce(v, op=hvd_tf.Average, name="tape.sp")
+            loss = tf.reduce_sum(tf.gather(y, [0]))
+        (g,) = tape.gradient(loss, [v])
+        g = tf.convert_to_tensor(g)
+        np.testing.assert_allclose(g.numpy(), [[1.0, 1.0], [0.0, 0.0]])
 
 
 class TestTFMultiProcess:
