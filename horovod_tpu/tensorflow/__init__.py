@@ -95,56 +95,133 @@ def allreduce(tensor, average=None, op=None, name=None,
                                     dense_shape=tensor.dense_shape)
 
     in_fn = tf.inside_function()
-    if in_fn:
-        cname = name or "tf." + tensor.name.replace(":", ".")
-    else:
-        cname = name
+    cname = _auto_name(tensor, name, in_fn)
     # Allreduce is linear, so the VJP of Sum/Average is the same op on
     # the cotangent (scaled by the linear pre/post factors); the
     # reference's _allreduce_grad uses a plain sum-allreduce for the
-    # nonlinear ops too, mirrored here.
+    # nonlinear ops too, mirrored here.  The allreduce forward is
+    # chip-weighted (docs/concepts.md), so its same-op backward IS the
+    # true VJP — unlike the process-level gather/broadcast below.
     grad_op = op if op in (Average, Sum) else Sum
     scale = prescale_factor * postscale_factor
 
-    def _run(t, the_op, nm, pre, post):
-        if in_fn:
-            def _bridge(tt):
-                return tf.convert_to_tensor(C.allreduce(
-                    tt.numpy(), the_op, name=nm,
-                    prescale_factor=pre, postscale_factor=post))
-
-            r = tf.py_function(_bridge, [t], Tout=t.dtype)
-            r.set_shape(t.shape)
-            return r
-        return tf.convert_to_tensor(C.allreduce(
-            _to_np(t), the_op, name=nm,
-            prescale_factor=pre, postscale_factor=post))
+    def _run(the_op, nm, pre, post):
+        return lambda a: C.allreduce(a, the_op, name=nm,
+                                     prescale_factor=pre,
+                                     postscale_factor=post)
 
     @tf.custom_gradient
     def _fn(t):
-        result = _run(t, op, cname, prescale_factor, postscale_factor)
+        result = _bridge_call(
+            _run(op, cname, prescale_factor, postscale_factor),
+            [t], t.shape, t.dtype, in_fn)
 
         def grad(dy):
-            # A sparse cotangent (e.g. the loss gathered rows of the
-            # reduced tensor) densifies first, as TF does implicitly for
-            # registered op gradients.
-            if isinstance(dy, tf.IndexedSlices):
-                dy = tf.convert_to_tensor(dy)
+            dy = _densify(dy)
             gname = f"{cname}.grad" if cname else None
-            return _run(dy, grad_op, gname, scale, 1.0)
+            return _bridge_call(_run(grad_op, gname, scale, 1.0),
+                                [dy], dy.shape, dy.dtype, in_fn)
 
         return result, grad
 
-    return _fn(tensor)
+    # Variables convert BEFORE _fn so custom_gradient doesn't demand a
+    # variables= grad signature.
+    return _fn(tf.convert_to_tensor(tensor))
+
+
+def _auto_name(tensor, name, in_fn):
+    """Trace-time deterministic collective name (identical across ranks
+    since the traced programs are); eagerly None defers to the runtime's
+    program-order auto-naming."""
+    return name or ("tf." + tensor.name.replace(":", ".") if in_fn else None)
+
+
+def _densify(dy):
+    """Sparse cotangents (a loss that gathered rows) densify before the
+    backward collective, as TF does implicitly for registered op grads."""
+    return tf.convert_to_tensor(dy) if isinstance(dy, tf.IndexedSlices) else dy
+
+
+def _bridge_call(fn_np, inputs, out_shape, dtype, in_fn):
+    """Run a host-side collective on numpy values; under a tf.function
+    trace the call embeds as a ``tf.py_function`` with the static shape
+    re-attached."""
+    if in_fn:
+        r = tf.py_function(
+            lambda *tt: tf.convert_to_tensor(
+                fn_np(*[x.numpy() for x in tt])),
+            inputs, Tout=dtype)
+        r.set_shape(out_shape)
+        return r
+    return tf.convert_to_tensor(fn_np(*[_to_np(x) for x in inputs]))
 
 
 def allgather(tensor, name=None):
-    return tf.convert_to_tensor(C.allgather(_to_np(tensor), name=name))
+    """Concatenate across processes on dim 0; DIFFERENTIABLE like the
+    reference's registered gradient (``tensorflow/mpi_ops.py:143-166``
+    ``_allgather_grad``): the backward sums the cotangent across
+    processes and returns this process's row slice.  The sum is a
+    :func:`~horovod_tpu.ops.collectives.process_sum` — the gather is a
+    process-level concat (one contribution per process), so its VJP must
+    not pick up the chip weighting (tape gradients stay finite-
+    difference-correct for the loss this process computed)."""
+    in_fn = tf.inside_function()
+    nm = _auto_name(tensor, name, in_fn)
+
+    @tf.custom_gradient
+    def _fn(t):
+        r = _bridge_call(lambda a: C.allgather(a, name=nm), [t],
+                         [None] + list(t.shape[1:]), t.dtype, in_fn)
+
+        def grad(dy):
+            dy = _densify(dy)
+            gname = f"{nm}.grad" if nm else None
+
+            def _g(dd, tt):
+                g = C.process_sum(dd, name=gname)
+                rows = np.asarray([tt.shape[0]], np.int64)
+                sizes = C.allgather(
+                    rows, name=f"{gname}.sizes" if gname else None)
+                off = int(sizes[:process_rank()].sum())
+                return g[off:off + int(rows[0])]
+
+            return _bridge_call(_g, [dy, t], t.shape, dy.dtype, in_fn)
+
+        return r, grad
+
+    return _fn(tf.convert_to_tensor(tensor))
 
 
 def broadcast(tensor, root_rank=0, name=None):
-    return tf.convert_to_tensor(
-        C.broadcast(_to_np(tensor), root_rank, name=name))
+    """Broadcast from ``root_rank``; DIFFERENTIABLE like the reference's
+    registered gradient (``tensorflow/mpi_ops.py:186-201``
+    ``_broadcast_grad``): the backward sums the cotangent across
+    processes (process-level, like the forward — see :func:`allgather`)
+    to the root and is zero elsewhere."""
+    in_fn = tf.inside_function()
+    nm = _auto_name(tensor, name, in_fn)
+
+    @tf.custom_gradient
+    def _fn(t):
+        r = _bridge_call(lambda a: C.broadcast(a, root_rank, name=nm),
+                         [t], t.shape, t.dtype, in_fn)
+
+        def grad(dy):
+            dy = _densify(dy)
+            gname = f"{nm}.grad" if nm else None
+
+            def _g(dd):
+                g = C.process_sum(dd, name=gname)
+                # root_rank is a worker (chip) rank; this process owns it
+                # iff it falls in [rank(), rank() + local_size()).
+                owns = rank() <= root_rank < rank() + local_size()
+                return g if owns else np.zeros_like(g)
+
+            return _bridge_call(_g, [dy], t.shape, dy.dtype, in_fn)
+
+        return r, grad
+
+    return _fn(tf.convert_to_tensor(tensor))
 
 
 def broadcast_variables(variables, root_rank=0):

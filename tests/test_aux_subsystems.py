@@ -483,6 +483,43 @@ class TestTFFunctionAllreduce:
         g = tf.convert_to_tensor(g)
         np.testing.assert_allclose(g.numpy(), [[1.0, 1.0], [0.0, 0.0]])
 
+    def test_tape_flows_through_allgather_and_broadcast(self, hvd):
+        """allgather/broadcast carry the reference's registered gradients
+        (mpi_ops.py:143-166, 186-201): process-level sum of the
+        cotangent, slice own rows / zero on non-root.  Unlike allreduce
+        (whose forward is chip-weighted), these forwards are process-
+        level, so the tape gradient must be finite-difference-correct —
+        NO local_size factor."""
+        tf = pytest.importorskip("tensorflow")
+        import horovod_tpu.tensorflow as hvd_tf
+
+        assert hvd_tf.local_size() > 1  # else this can't catch chip leaks
+        v = tf.Variable([[1.0, 2.0]])
+        with tf.GradientTape() as tape:
+            y = hvd_tf.allgather(v, name="tape.ag")
+            loss = tf.reduce_sum(y * 3.0)
+        (g,) = tape.gradient(loss, [v])
+        # d(3*sum(v))/dv == 3 exactly (allgather is the identity at one
+        # process; a chip-weighted backward would return 3*local_size).
+        np.testing.assert_allclose(g.numpy(), [[3.0, 3.0]])
+
+        w = tf.Variable([5.0])
+        with tf.GradientTape() as tape:
+            y = hvd_tf.broadcast(w, 0, name="tape.bc")
+            loss = tf.reduce_sum(y * 2.0)
+        (g,) = tape.gradient(loss, [w])
+        np.testing.assert_allclose(g.numpy(), [2.0])
+
+        @tf.function
+        def fn_loss():
+            y = hvd_tf.allgather(v, name="tape.ag.fn")
+            return tf.reduce_sum(y)
+
+        with tf.GradientTape() as tape:
+            loss = fn_loss()
+        (g,) = tape.gradient(loss, [v])
+        np.testing.assert_allclose(g.numpy(), [[1.0, 1.0]])
+
 
 class TestTFMultiProcess:
     def test_two_process_tf(self, tmp_path):
