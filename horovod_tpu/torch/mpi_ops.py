@@ -104,8 +104,59 @@ def allreduce_async(tensor, average=None, name=None, op=None,
     return _register(jh, post)
 
 
-def allreduce(tensor, average=None, name=None, op=None, **kw) -> torch.Tensor:
-    return synchronize(allreduce_async(tensor, average, name, op, **kw))
+class _AllreduceGrad(torch.autograd.Function):
+    """Autograd support for the sync allreduce (reference
+    ``torch/mpi_ops.py:158-170`` ``HorovodAllreduce``).
+
+    The eager forward is chip-weighted (docs/concepts.md):
+    ``y = Σ_p ls_p·x_p`` (Sum) or the same over ``size()`` (Average), so
+    the true VJP for process q is ``ls_q · Σ_p g_p`` — a process-level
+    sum of cotangents scaled by the LOCAL chip count (and by
+    ``1/size()`` for Average).  On homogeneous meshes this equals the
+    same-op allreduce of the gradient; expressed this way it stays exact
+    with heterogeneous per-process chip counts too."""
+
+    @staticmethod
+    def forward(ctx, tensor, op, name, prescale_factor, postscale_factor,
+                compression):
+        ctx.grad_op = op
+        ctx.name = name
+        ctx.scale = prescale_factor * postscale_factor
+        return synchronize(allreduce_async(
+            tensor.detach(), op=op, name=name,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, compression=compression))
+
+    @staticmethod
+    def backward(ctx, grad):
+        from horovod_tpu import basics
+
+        gname = f"{ctx.name}.grad" if ctx.name else None
+        g = C.process_sum(_to_numpy(grad), name=gname)
+        g = g * np.asarray(basics.local_size() * ctx.scale, g.dtype)
+        if ctx.grad_op == Average:
+            g = g / np.asarray(basics.size(), g.dtype)
+        return (_from_numpy(g).reshape(grad.shape),
+                None, None, None, None, None)
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0,
+              compression=None) -> torch.Tensor:
+    if isinstance(tensor, torch.Tensor) and tensor.requires_grad:
+        resolved = _resolve_op(average, op)
+        if resolved not in (Average, Sum):
+            # Min/Max/Product/Adasum have no meaningful linear VJP; a
+            # silent Sum backward would train the wrong objective.
+            raise RuntimeError(
+                f"allreduce(op={resolved}) is not differentiable; call "
+                "it on a detached tensor")
+        return _AllreduceGrad.apply(
+            tensor, resolved, name,
+            prescale_factor, postscale_factor, compression)
+    return synchronize(allreduce_async(
+        tensor, average, name, op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, compression=compression))
 
 
 def allreduce_async_(tensor, average=None, name=None, op=None, **kw) -> int:
@@ -146,7 +197,35 @@ def allgather_async(tensor, name=None) -> int:
     return _register(jh, lambda a: _from_numpy(np.asarray(a)))
 
 
+class _AllgatherGrad(torch.autograd.Function):
+    """Reference ``HorovodAllgather`` autograd: backward sums the
+    cotangent across processes and slices this process's rows.  The
+    gather is process-level (one contribution per process), so the sum
+    is a process_sum — no chip weighting (gradients stay finite-
+    difference-correct)."""
+
+    @staticmethod
+    def forward(ctx, tensor, name):
+        ctx.name = name
+        ctx.rows = int(tensor.shape[0])
+        return synchronize(allgather_async(tensor.detach(), name))
+
+    @staticmethod
+    def backward(ctx, grad):
+        from horovod_tpu import basics
+
+        gname = f"{ctx.name}.grad" if ctx.name else None
+        g = C.process_sum(_to_numpy(grad), name=gname)
+        rows = np.asarray([ctx.rows], np.int64)
+        sizes = C.allgather(rows,
+                            name=f"{gname}.sizes" if gname else None)
+        off = int(sizes[:basics.process_rank()].sum())
+        return _from_numpy(g[off:off + ctx.rows]), None
+
+
 def allgather(tensor, name=None) -> torch.Tensor:
+    if isinstance(tensor, torch.Tensor) and tensor.requires_grad:
+        return _AllgatherGrad.apply(tensor, name)
     return synchronize(allgather_async(tensor, name))
 
 
@@ -158,7 +237,33 @@ def broadcast_async(tensor, root_rank, name=None) -> int:
         jh, lambda a: _from_numpy(np.asarray(a)).reshape(shape))
 
 
+class _BroadcastGrad(torch.autograd.Function):
+    """Reference ``HorovodBroadcast`` autograd: backward process-sums the
+    cotangent to the root and is zero elsewhere."""
+
+    @staticmethod
+    def forward(ctx, tensor, root_rank, name):
+        ctx.name = name
+        ctx.root_rank = root_rank
+        return synchronize(broadcast_async(tensor.detach(), root_rank, name))
+
+    @staticmethod
+    def backward(ctx, grad):
+        from horovod_tpu import basics
+
+        gname = f"{ctx.name}.grad" if ctx.name else None
+        g = C.process_sum(_to_numpy(grad), name=gname)
+        # root_rank is a worker (chip) rank; this process owns it iff it
+        # falls in [rank(), rank() + local_size()).
+        lo = basics.rank()
+        if not (lo <= ctx.root_rank < lo + basics.local_size()):
+            g = np.zeros_like(g)
+        return _from_numpy(g).reshape(grad.shape), None, None
+
+
 def broadcast(tensor, root_rank, name=None) -> torch.Tensor:
+    if isinstance(tensor, torch.Tensor) and tensor.requires_grad:
+        return _BroadcastGrad.apply(tensor, root_rank, name)
     return synchronize(broadcast_async(tensor, root_rank, name))
 
 

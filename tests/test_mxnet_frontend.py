@@ -149,13 +149,11 @@ class TestOps:
         np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0])  # size 1
 
     def test_allreduce_inplace(self, hvd, hvd_mx):
-        import horovod_tpu as hvd_core
-
         x = FakeNDArray([3.0, 4.0])
         ret = hvd_mx.allreduce_(x, average=False)
         assert ret is x
         # average=False is a chip-weighted Sum (docs/concepts.md).
-        ls = hvd_core.local_size()
+        ls = hvd.local_size()
         np.testing.assert_allclose(x.asnumpy(), [3.0 * ls, 4.0 * ls])
 
     def test_broadcast_inplace(self, hvd, hvd_mx):

@@ -81,6 +81,32 @@ class TestTorchOps:
         assert torch.equal(out, hvd_torch.local_size() * x)
 
 
+class TestTorchAutograd:
+    """The sync ops are autograd-differentiable (reference
+    torch/mpi_ops.py:158-170 HorovodAllreduce/Allgather/Broadcast)."""
+
+    def test_backward_through_allreduce(self, hvd):
+        ls = hvd_torch.local_size()
+        v = torch.tensor([1.0, 2.0], requires_grad=True)
+        y = hvd_torch.allreduce(v * v, op=hvd_torch.Sum, name="tg.ar")
+        y.sum().backward()
+        # y = ls*v^2 (chip-weighted Sum); same-op backward is its VJP.
+        assert torch.allclose(v.grad, ls * 2.0 * torch.tensor([1.0, 2.0]))
+
+    def test_backward_through_allgather(self, hvd):
+        v = torch.ones(2, 3, requires_grad=True)
+        y = hvd_torch.allgather(v, name="tg.ag")
+        (y * 3.0).sum().backward()
+        # Process-level concat: FD-correct gradient, no chip factor.
+        assert torch.allclose(v.grad, torch.full((2, 3), 3.0))
+
+    def test_backward_through_broadcast(self, hvd):
+        w = torch.tensor([5.0], requires_grad=True)
+        z = hvd_torch.broadcast(w, 0, name="tg.bc")
+        (z * 2.0).sum().backward()
+        assert torch.allclose(w.grad, torch.tensor([2.0]))
+
+
 class TestDistributedOptimizer:
     def _model(self):
         torch.manual_seed(0)
