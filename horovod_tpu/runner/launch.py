@@ -138,7 +138,14 @@ def launch_job(
         import time
 
         failure.set()
-        time.sleep(0.5)  # let the per-rank watchers deliver the group kills
+        # Stay alive until the per-rank watchers finish their TERM ->
+        # (grace) -> KILL escalation: ranks may swallow SIGTERM (JAX
+        # installs a preemption notifier that catches it), so dying after
+        # a token sleep would leave them orphaned mid-escalation.
+        deadline = (time.time() + safe_shell_exec.GRACEFUL_TERMINATION_TIME_S
+                    + 2.0)
+        while time.time() < deadline and any(rc is None for rc in exit_codes):
+            time.sleep(0.2)
         prev = prev_handlers.get(signum)
         if callable(prev):
             prev(signum, frame)

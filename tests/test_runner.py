@@ -338,6 +338,66 @@ class TestLaunch:
         assert "ok" in (out / "rank.1.stdout").read_text()
 
 
+    def test_sigterm_kills_term_swallowing_ranks(self, tmp_path):
+        """SIGTERM to the launcher must reap ranks that CATCH SIGTERM
+        (JAX installs a preemption notifier that swallows it): the
+        launcher has to stay alive through the watchers' TERM -> grace ->
+        KILL escalation instead of dying after a token sleep."""
+        import signal
+        import subprocess
+        import time
+
+        script = tmp_path / "stubborn.py"
+        script.write_text(
+            "import signal, time\n"
+            "signal.signal(signal.SIGTERM, lambda *a: None)\n"
+            "print('ready', flush=True)\n"
+            "time.sleep(600)\n"
+        )
+        driver = tmp_path / "driver.py"
+        driver.write_text(textwrap.dedent(f"""
+            import os, sys
+            sys.path.insert(0, os.environ["REPO"])
+            from horovod_tpu.runner import launch
+            from horovod_tpu.runner.hosts import HostSpec
+            launch.launch_job(
+                [sys.executable, {str(script)!r}],
+                [HostSpec("localhost", 1)] * 2,
+                env={{"PATH": os.environ.get("PATH", ""),
+                     "PALLAS_AXON_POOL_IPS": ""}},
+                output_filename={str(tmp_path / "out")!r})
+        """))
+        env = dict(os.environ)
+        env["REPO"] = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.Popen([sys.executable, str(driver)], env=env)
+        # wait for both ranks to be up
+        deadline = time.time() + 60
+        outdir = tmp_path / "out"
+        while time.time() < deadline:
+            try:
+                if all("ready" in (outdir / f"rank.{r}.stdout").read_text()
+                       for r in (0, 1)):
+                    break
+            except OSError:
+                pass
+            time.sleep(0.3)
+        else:
+            proc.kill()
+            raise AssertionError("ranks never came up")
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
+        # after the escalation window, no stubborn.py processes survive
+        time.sleep(1.0)
+        left = subprocess.run(
+            ["pgrep", "-f", "stubborn.py"], capture_output=True
+        ).stdout.decode().split()
+        left = [p for p in left
+                if subprocess.run(["ps", "-o", "comm=", "-p", p],
+                                  capture_output=True
+                                  ).stdout.decode().strip() == "python"]
+        assert not left, f"orphaned rank processes: {left}"
+
     def test_ssh_secret_rides_stdin_not_argv(self):
         """The per-job HMAC key must never appear on a remote command line
         (visible via /proc/<pid>/cmdline to any local user)."""
