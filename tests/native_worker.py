@@ -147,6 +147,19 @@ def scenario_full():
         "steady-state allgather/alltoall must be cache fast-path",
         hits_before, rt.cache_hits())
 
+    # Invalidation: a changed first dim must MISS locally (the cache key
+    # is this rank's own request), renegotiate globally, and produce the
+    # correct new concatenation — then the refreshed entry caches again.
+    grown = np.full((rank + 3, 2), float(rank), np.float32)
+    out = hvd.allgather(grown, name="ag.cached")
+    assert out.shape == (sum(r + 3 for r in range(size)), 2), out.shape
+    hits_before = rt.cache_hits()
+    for _ in range(3):
+        out = hvd.allgather(grown, name="ag.cached")
+        assert out.shape == (sum(r + 3 for r in range(size)), 2)
+    assert rt.cache_hits() - hits_before >= 1, (
+        "re-Put entry must fast-path again", rt.cache_hits())
+
     # autotuner knob application: cycle time + cache capacity.  Resize on
     # rank 0 FIRST so the ranks' bit-vector lengths disagree for a few
     # cycles — the padded AllreduceBitsAndOr must self-heal via the
