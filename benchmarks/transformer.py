@@ -54,6 +54,10 @@ def main() -> None:
                          "outputs, recompute elementwise only")
     ap.add_argument("--n-experts", type=int, default=0,
                     help="MoE experts per layer (0 = dense MLP)")
+    ap.add_argument("--kv-heads", type=int, default=0,
+                    help="grouped-query attention: K/V heads "
+                         "(0 = n_heads); the ring rotates shards this "
+                         "many heads wide")
     ap.add_argument("--num-iters", type=int, default=5)
     ap.add_argument("--steps-per-iter", type=int, default=5)
     args = ap.parse_args()
@@ -71,6 +75,7 @@ def main() -> None:
         attention_impl=args.attention, remat=args.remat,
         remat_policy=args.remat_policy,
         n_experts=args.n_experts,
+        n_kv_heads=args.kv_heads,
     )
     params = T.init_params(jax.random.PRNGKey(0), cfg)
     if args.attention.startswith("ring"):

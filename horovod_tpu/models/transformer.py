@@ -41,6 +41,10 @@ class TransformerConfig:
     d_ff: int = 2048
     max_seq: int = 1024
     n_experts: int = 0  # 0/1 = dense MLP
+    # Grouped-query attention: K/V heads (0 = n_heads, i.e. MHA).  With
+    # ring attention the rotating K/V shards shrink by n_heads/n_kv_heads
+    # — the long-context ICI-bandwidth lever (beyond-reference extension).
+    n_kv_heads: int = 0
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
     # "reference" = O(S^2) XLA softmax-attention; "flash" = the Pallas
@@ -65,6 +69,12 @@ class TransformerConfig:
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads or self.n_heads
+        assert self.n_heads % kv == 0, (self.n_heads, kv)
+        return kv
 
 
 # --- parameters --------------------------------------------------------------
@@ -91,8 +101,8 @@ def init_params(rng, cfg: TransformerConfig) -> Dict:
         "ln1": jnp.ones((L, D), jnp.float32),
         "ln2": jnp.ones((L, D), jnp.float32),
         "wq": norm_init(keys[0], (L, D, H, Dh), s_d),
-        "wk": norm_init(keys[1], (L, D, H, Dh), s_d),
-        "wv": norm_init(keys[2], (L, D, H, Dh), s_d),
+        "wk": norm_init(keys[1], (L, D, cfg.kv_heads, Dh), s_d),
+        "wv": norm_init(keys[2], (L, D, cfg.kv_heads, Dh), s_d),
         "wo": norm_init(keys[3], (L, H, Dh, D), s_d),
     }
     if E > 1:
@@ -198,9 +208,10 @@ def _attention(x, p, cfg: TransformerConfig):
     from horovod_tpu.ops import attention as attn
 
     qh = jnp.moveaxis(q, 2, 1)  # (B, H, S, Dh)
-    kh = jnp.moveaxis(k, 2, 1)
+    kh = jnp.moveaxis(k, 2, 1)  # (B, H_kv, S, Dh) under GQA
     vh = jnp.moveaxis(v, 2, 1)
     if cfg.attention_impl == "ring":
+        # GQA shards stay small through the ring; expansion is per-chunk.
         oh = attn.ring_attention(qh, kh, vh, axis_name="sp", causal=True)
     elif cfg.attention_impl == "ring_reference":
         oh = attn.ring_attention(qh, kh, vh, axis_name="sp", causal=True,
@@ -208,9 +219,12 @@ def _attention(x, p, cfg: TransformerConfig):
     elif cfg.attention_impl == "ulysses":
         oh = attn.ulysses_attention(qh, kh, vh, axis_name="sp", causal=True)
     elif cfg.attention_impl == "flash":
-        oh = attn.flash_attention(qh, kh, vh, True)
+        oh = attn.flash_attention(qh, attn.expand_kv(kh, cfg.n_heads),
+                                  attn.expand_kv(vh, cfg.n_heads), True)
     elif cfg.attention_impl == "reference":
-        oh = attn.reference_attention(qh, kh, vh, causal=True)
+        oh = attn.reference_attention(qh, attn.expand_kv(kh, cfg.n_heads),
+                                      attn.expand_kv(vh, cfg.n_heads),
+                                      causal=True)
     else:
         raise ValueError(
             f"unknown attention_impl {cfg.attention_impl!r}; expected "

@@ -61,6 +61,24 @@ def _sm_scale(q, sm_scale):
     return 1.0 / np.sqrt(q.shape[-1]) if sm_scale is None else sm_scale
 
 
+def expand_kv(kv, n_heads: int):
+    """Grouped-query attention: repeat K/V heads up to ``n_heads``.
+
+    The kernels are MHA; GQA expands at the call site with
+    ``jnp.repeat`` — whose VJP is exactly the per-group sum, so
+    gradients w.r.t. the shared KV heads are exact under autodiff.  The
+    bandwidth win is preserved where it matters: ring attention rotates
+    the UNEXPANDED (B, H_kv, S, D) shards around the ICI ring and
+    expands per chunk, so ppermute traffic shrinks by H/H_kv."""
+    H_kv = kv.shape[1]
+    if H_kv == n_heads:
+        return kv
+    if n_heads % H_kv != 0:
+        raise ValueError(
+            f"n_heads ({n_heads}) must be a multiple of kv heads ({H_kv})")
+    return jnp.repeat(kv, n_heads // H_kv, axis=1)
+
+
 def _float0_like(x):
     """Cotangent for an integer-dtype primal (custom_vjp convention)."""
     return np.zeros(np.shape(x), dtype=jax.dtypes.float0)
@@ -645,6 +663,10 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
     as a second oracle and by shapes that can't tile — though the flash
     path falls back internally too).  Differentiable end-to-end; the VJP
     rides the transposed ``ppermute``s back around the ring.
+
+    GQA: pass k/v with ``H_kv < H`` heads (``H % H_kv == 0``) — the ring
+    rotates the small shards (ICI traffic ÷ H/H_kv) and each chunk
+    expands to full heads before the kernel.
     """
     P = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
@@ -655,9 +677,14 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
     use_flash = impl == "flash"
 
     def step(carry, s_idx):
-        o, lse, ks, vs = carry
+        o, lse, ks_kv, vs_kv = carry
         src = (me - s_idx) % P  # which shard's K/V we hold this step
         last = s_idx == P - 1
+        # GQA: the carry rotates the small (B, H_kv, T, D) shards; the
+        # chunk compute expands to full heads (jnp.repeat — VJP is the
+        # group-sum, so the transposed ring carries exact KV grads).
+        ks = expand_kv(ks_kv, H)
+        vs = expand_kv(vs_kv, H)
         if use_flash:
             if causal:
                 shift = ((src - me) * T).astype(jnp.int32)
@@ -680,9 +707,9 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
         o = (o * jnp.exp(lse - lse_new)[..., None]
              + o_c * jnp.exp(lse_c - lse_new)[..., None])
         if not last:  # the final rotation's result is never read
-            ks = lax.ppermute(ks, axis_name, perm)
-            vs = lax.ppermute(vs, axis_name, perm)
-        return o, lse_new, ks, vs
+            ks_kv = lax.ppermute(ks_kv, axis_name, perm)
+            vs_kv = lax.ppermute(vs_kv, axis_name, perm)
+        return o, lse_new, ks_kv, vs_kv
 
     # Derive the initial carry from q so it inherits q's varying-over-axis
     # type under shard_map (a plain literal would mismatch the carry-out).
@@ -723,11 +750,20 @@ def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
             f"ulysses_attention needs heads ({H}) divisible by the "
             f"'{axis_name}' axis size ({P}); use ring_attention otherwise")
 
-    def seq_to_heads(x):  # (B,H,S_local,D) -> (B,H/P,S_global,D)
+    def seq_to_heads(x):  # (B,h,S_local,D) -> (B,h/P,S_global,D)
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    # GQA: reshard K/V at their small head count when it still divides
+    # the axis (all_to_all moves H_kv/P heads per link), expanding to
+    # full heads only after the reshard; otherwise expand first.
+    if k.shape[1] % P == 0:
+        kh = expand_kv(seq_to_heads(k), H // P)
+        vh = expand_kv(seq_to_heads(v), H // P)
+    else:
+        kh = seq_to_heads(expand_kv(k, H))
+        vh = seq_to_heads(expand_kv(v, H))
+    qh = seq_to_heads(q)
     if impl == "flash":
         oh = flash_attention(qh, kh, vh, causal, sm_scale=sm_scale)
     else:
