@@ -255,6 +255,20 @@ def _moe_mlp(x, p, cfg: TransformerConfig):
     return y * gate[..., None].astype(cfg.dtype)
 
 
+def _mlp_block(x, p, cfg: TransformerConfig):
+    """Residual MLP half of a layer (shared by forward, the pipeline, and
+    the decode step so the three can never drift apart)."""
+    m = _rmsnorm(x, p["ln2"])
+    if cfg.n_experts > 1:
+        return x + _moe_mlp(m, p, cfg)
+    return x + _dense_mlp(m, p, cfg)
+
+
+def _layer_body(x, p, cfg: TransformerConfig):
+    x = x + _attention(_rmsnorm(x, p["ln1"]), p, cfg)
+    return _mlp_block(x, p, cfg)
+
+
 def _remat(layer, cfg: TransformerConfig):
     if cfg.remat_policy == "full":
         return jax.checkpoint(layer)
@@ -270,14 +284,7 @@ def forward(params: Dict, tokens, cfg: TransformerConfig):
     x = params["embed"].astype(cfg.dtype)[tokens]
 
     def layer(x, p):
-        h = _attention(_rmsnorm(x, p["ln1"]), p, cfg)
-        x = x + h
-        m = _rmsnorm(x, p["ln2"])
-        if cfg.n_experts > 1:
-            x = x + _moe_mlp(m, p, cfg)
-        else:
-            x = x + _dense_mlp(m, p, cfg)
-        return x, None
+        return _layer_body(x, p, cfg), None
 
     if cfg.remat:
         layer = _remat(layer, cfg)
@@ -296,6 +303,122 @@ def loss_fn(params: Dict, batch: Dict, cfg: TransformerConfig):
         logits, batch["targets"][..., None], axis=-1
     ).squeeze(-1)
     return jnp.mean(logz - gold)
+
+
+# --- autoregressive decoding (KV cache) ---------------------------------------
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int = 0) -> Dict:
+    """Per-layer KV cache for autoregressive decoding.
+
+    Shapes are STATIC — ``(L, B, H_kv, T, Dh)`` in ``cfg.dtype`` with a
+    traced write position — so the decode step compiles once and every
+    token reuses the executable (the XLA-friendly formulation; no
+    growing arrays).  GQA (``n_kv_heads``) shrinks the cache by
+    ``n_heads / kv_heads`` — the serving-memory lever."""
+    T = max_len or cfg.max_seq
+    return {
+        "k": jnp.zeros((cfg.n_layers, batch, cfg.kv_heads, T, cfg.head_dim),
+                       cfg.dtype),
+        "v": jnp.zeros((cfg.n_layers, batch, cfg.kv_heads, T, cfg.head_dim),
+                       cfg.dtype),
+        "pos": jnp.zeros((), jnp.int32),
+    }
+
+
+def _attention_decode(x, p, cfg: TransformerConfig, k_cache, v_cache, pos):
+    """One-token attention against the cache: write this position's K/V
+    at ``pos``, attend q over positions <= pos (static-shape mask)."""
+    from horovod_tpu.ops import attention as attn
+
+    q = jnp.einsum("bsd,dhk->bshk", x, p["wq"].astype(cfg.dtype))
+    k_t = jnp.einsum("bsd,dhk->bshk", x, p["wk"].astype(cfg.dtype))
+    v_t = jnp.einsum("bsd,dhk->bshk", x, p["wv"].astype(cfg.dtype))
+    q, k_t = _rope(q, k_t, cfg.rope_theta, pos)
+    k_cache = lax.dynamic_update_slice_in_dim(
+        k_cache, jnp.moveaxis(k_t, 2, 1).astype(k_cache.dtype), pos, axis=2)
+    v_cache = lax.dynamic_update_slice_in_dim(
+        v_cache, jnp.moveaxis(v_t, 2, 1).astype(v_cache.dtype), pos, axis=2)
+
+    qh = jnp.moveaxis(q, 2, 1)                      # (B, H, 1, Dh)
+    kh = attn.expand_kv(k_cache, cfg.n_heads)       # (B, H, T, Dh)
+    vh = attn.expand_kv(v_cache, cfg.n_heads)
+    s = jnp.einsum("bhqd,bhtd->bhqt", qh.astype(jnp.float32),
+                   kh.astype(jnp.float32)) / np.sqrt(cfg.head_dim)
+    T = kh.shape[2]
+    mask = (lax.broadcasted_iota(jnp.int32, (T,), 0) <= pos)
+    s = jnp.where(mask[None, None, None, :], s, -1e30)
+    w = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqt,bhtd->bhqd", w, vh.astype(jnp.float32))
+    o = jnp.moveaxis(o.astype(cfg.dtype), 1, 2)     # (B, 1, H, Dh)
+    out = jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(cfg.dtype))
+    return out, k_cache, v_cache
+
+
+def decode_step(params: Dict, tokens_t, cache: Dict, cfg: TransformerConfig):
+    """One autoregressive step.
+
+    ``tokens_t``: (B,) int32 — the token at position ``cache["pos"]``.
+    Returns ``(logits (B, V) float32, updated cache)``; the logits match
+    :func:`forward`'s at that position exactly (teacher-forcing
+    equivalence, ``tests/test_models.py``).  The reference has no decode
+    path (it is a training framework); this completes the serving story
+    of docs/inference.md with a TPU-idiomatic static-shape cache.
+
+    CONTRACT: at most ``max_len`` (the cache's static T) calls per
+    cache — past capacity, ``dynamic_update_slice`` clamps the write to
+    the last slot and output silently degrades.  Eager misuse raises;
+    under jit the position is traced, so callers must size the cache
+    (``init_cache(max_len=prompt + steps)``, as greedy_decode does)."""
+    pos = cache["pos"]
+    T_cache = cache["k"].shape[3]
+    if not isinstance(pos, jax.core.Tracer) and int(pos) >= T_cache:
+        raise ValueError(
+            f"decode_step past cache capacity (pos {int(pos)} >= "
+            f"{T_cache}); init_cache with a larger max_len")
+    x = params["embed"].astype(cfg.dtype)[tokens_t][:, None]  # (B, 1, D)
+
+    def layer(x, inp):
+        p, k_c, v_c = inp
+        h, k_new, v_new = _attention_decode(
+            _rmsnorm(x, p["ln1"]), p, cfg, k_c, v_c, pos)
+        return _mlp_block(x + h, p, cfg), (k_new, v_new)
+
+    x, (k_all, v_all) = lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"]))
+    x = _rmsnorm(x, params["ln_f"])
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["head"].astype(cfg.dtype)).astype(jnp.float32)
+    return logits[:, 0], {"k": k_all, "v": v_all, "pos": pos + 1}
+
+
+def greedy_decode(params: Dict, prompt, steps: int, cfg: TransformerConfig):
+    """Extend a (B, S0) prompt by ``steps`` greedy tokens -> (B, steps).
+
+    Prefill feeds the prompt token-by-token through the same compiled
+    decode step (correctness-first; a chunked prefill is a pure
+    composition of :func:`forward` attention over the cache)."""
+    B, S0 = prompt.shape
+    cache = init_cache(cfg, B, S0 + steps)
+
+    def prefill(carry, t):
+        cache, _ = carry
+        tok = lax.dynamic_index_in_dim(prompt, t, axis=1, keepdims=False)
+        logits, cache = decode_step(params, tok, cache, cfg)
+        return (cache, logits), None
+
+    zero_logits = jnp.zeros((B, cfg.vocab_size), jnp.float32)
+    (cache, logits), _ = lax.scan(
+        prefill, (cache, zero_logits), jnp.arange(S0))
+
+    def gen(carry, _):
+        cache, logits = carry
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits, cache = decode_step(params, tok, cache, cfg)
+        return (cache, logits), tok
+
+    _, toks = lax.scan(gen, (cache, logits), None, length=steps)
+    return jnp.moveaxis(toks, 0, 1)
 
 
 # --- true pipeline parallelism ------------------------------------------------
@@ -334,14 +457,7 @@ def pipelined_forward(params: Dict, tokens, cfg: TransformerConfig, *,
         params["layers"])
 
     def layer(x, p):
-        h = _attention(_rmsnorm(x, p["ln1"]), p, cfg)
-        x = x + h
-        m = _rmsnorm(x, p["ln2"])
-        if cfg.n_experts > 1:
-            x = x + _moe_mlp(m, p, cfg)
-        else:
-            x = x + _dense_mlp(m, p, cfg)
-        return x, None
+        return _layer_body(x, p, cfg), None
 
     if cfg.remat:
         layer = _remat(layer, cfg)
