@@ -194,22 +194,33 @@ def _rope(q, k, theta: float, pos_offset=0):
     return rot(q), rot(k)
 
 
-def _attention(x, p, cfg: TransformerConfig):
-    B, S, D = x.shape
+def _qkv_proj(x, p, cfg: TransformerConfig, pos_offset=0):
+    """Project to per-head Q/K/V with RoPE applied -> head-major
+    ``(B, H, S, Dh)`` / ``(B, H_kv, S, Dh)`` (shared by the training
+    attention, prefill, and decode paths so the math cannot drift)."""
     q = jnp.einsum("bsd,dhk->bshk", x, p["wq"].astype(cfg.dtype))
     k = jnp.einsum("bsd,dhk->bshk", x, p["wk"].astype(cfg.dtype))
     v = jnp.einsum("bsd,dhk->bshk", x, p["wv"].astype(cfg.dtype))
+    q, k = _rope(q, k, cfg.rope_theta, pos_offset)
+    return (jnp.moveaxis(q, 2, 1), jnp.moveaxis(k, 2, 1),
+            jnp.moveaxis(v, 2, 1))
+
+
+def _out_proj(oh, p, cfg: TransformerConfig):
+    o = jnp.moveaxis(oh, 1, 2).astype(cfg.dtype)  # (B, S, H, Dh)
+    return jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(cfg.dtype))
+
+
+def _attention(x, p, cfg: TransformerConfig):
+    B, S, D = x.shape
     pos_offset = 0
     if cfg.attention_impl in ("ring", "ring_reference", "ulysses"):
         # Sequence is sharded over sp: this shard's tokens start at
         # sp_index * S_local in the global sequence.
         pos_offset = lax.axis_index("sp") * S
-    q, k = _rope(q, k, cfg.rope_theta, pos_offset)
     from horovod_tpu.ops import attention as attn
 
-    qh = jnp.moveaxis(q, 2, 1)  # (B, H, S, Dh)
-    kh = jnp.moveaxis(k, 2, 1)  # (B, H_kv, S, Dh) under GQA
-    vh = jnp.moveaxis(v, 2, 1)
+    qh, kh, vh = _qkv_proj(x, p, cfg, pos_offset)
     if cfg.attention_impl == "ring":
         # GQA shards stay small through the ring; expansion is per-chunk.
         oh = attn.ring_attention(qh, kh, vh, axis_name="sp", causal=True)
@@ -229,8 +240,7 @@ def _attention(x, p, cfg: TransformerConfig):
         raise ValueError(
             f"unknown attention_impl {cfg.attention_impl!r}; expected "
             "'reference', 'flash', 'ring', 'ring_reference' or 'ulysses'")
-    o = jnp.moveaxis(oh, 1, 2).astype(cfg.dtype)  # (B, S, H, Dh)
-    return jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(cfg.dtype))
+    return _out_proj(oh, p, cfg)
 
 
 def _dense_mlp(x, p, cfg: TransformerConfig):
@@ -331,16 +341,12 @@ def _attention_decode(x, p, cfg: TransformerConfig, k_cache, v_cache, pos):
     at ``pos``, attend q over positions <= pos (static-shape mask)."""
     from horovod_tpu.ops import attention as attn
 
-    q = jnp.einsum("bsd,dhk->bshk", x, p["wq"].astype(cfg.dtype))
-    k_t = jnp.einsum("bsd,dhk->bshk", x, p["wk"].astype(cfg.dtype))
-    v_t = jnp.einsum("bsd,dhk->bshk", x, p["wv"].astype(cfg.dtype))
-    q, k_t = _rope(q, k_t, cfg.rope_theta, pos)
+    qh, k_t, v_t = _qkv_proj(x, p, cfg, pos)        # qh: (B, H, 1, Dh)
     k_cache = lax.dynamic_update_slice_in_dim(
-        k_cache, jnp.moveaxis(k_t, 2, 1).astype(k_cache.dtype), pos, axis=2)
+        k_cache, k_t.astype(k_cache.dtype), pos, axis=2)
     v_cache = lax.dynamic_update_slice_in_dim(
-        v_cache, jnp.moveaxis(v_t, 2, 1).astype(v_cache.dtype), pos, axis=2)
+        v_cache, v_t.astype(v_cache.dtype), pos, axis=2)
 
-    qh = jnp.moveaxis(q, 2, 1)                      # (B, H, 1, Dh)
     kh = attn.expand_kv(k_cache, cfg.n_heads)       # (B, H, T, Dh)
     vh = attn.expand_kv(v_cache, cfg.n_heads)
     s = jnp.einsum("bhqd,bhtd->bhqt", qh.astype(jnp.float32),
@@ -350,9 +356,7 @@ def _attention_decode(x, p, cfg: TransformerConfig, k_cache, v_cache, pos):
     s = jnp.where(mask[None, None, None, :], s, -1e30)
     w = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhqt,bhtd->bhqd", w, vh.astype(jnp.float32))
-    o = jnp.moveaxis(o.astype(cfg.dtype), 1, 2)     # (B, 1, H, Dh)
-    out = jnp.einsum("bshk,hkd->bsd", o, p["wo"].astype(cfg.dtype))
-    return out, k_cache, v_cache
+    return _out_proj(o.astype(cfg.dtype), p, cfg), k_cache, v_cache
 
 
 def decode_step(params: Dict, tokens_t, cache: Dict, cfg: TransformerConfig):
@@ -392,24 +396,70 @@ def decode_step(params: Dict, tokens_t, cache: Dict, cfg: TransformerConfig):
     return logits[:, 0], {"k": k_all, "v": v_all, "pos": pos + 1}
 
 
+def _attention_prefill(x, p, cfg: TransformerConfig):
+    """Full-sequence attention that ALSO returns the (unexpanded,
+    post-RoPE) per-layer K/V for cache filling.  Shares the projection
+    math with :func:`_attention` via ``_qkv_proj``/``_out_proj`` and
+    honors ``attention_impl='reference'``; the sequence-parallel impls
+    need a bound mesh axis, so they prefill through the flash kernel
+    (which falls back to fused XLA for untileable prompts)."""
+    from horovod_tpu.ops import attention as attn
+
+    qh, kh, vh = _qkv_proj(x, p, cfg, 0)  # kh/vh: (B, H_kv, S0, Dh)
+    if cfg.attention_impl == "reference":
+        oh = attn.reference_attention(
+            qh, attn.expand_kv(kh, cfg.n_heads),
+            attn.expand_kv(vh, cfg.n_heads), causal=True)
+    else:
+        oh = attn.flash_attention(qh, attn.expand_kv(kh, cfg.n_heads),
+                                  attn.expand_kv(vh, cfg.n_heads), True)
+    return _out_proj(oh, p, cfg), kh, vh
+
+
+def prefill(params: Dict, prompt, cache: Dict, cfg: TransformerConfig):
+    """Fill a FRESH cache with a (B, S0) prompt in ONE forward pass
+    (the serving-shape prefill: batched MXU work instead of S0 serial
+    decode steps) and return ``(last-position logits (B, V), cache)``
+    with ``pos = S0``.  Continue with :func:`decode_step`."""
+    pos = cache["pos"]
+    if not isinstance(pos, jax.core.Tracer) and int(pos) != 0:
+        raise ValueError("prefill requires a fresh cache (pos == 0)")
+    S0 = prompt.shape[1]
+    T_cache = cache["k"].shape[3]
+    if S0 > T_cache:  # shapes are static, so this raises under jit too
+        raise ValueError(
+            f"prompt ({S0} tokens) exceeds cache capacity ({T_cache}); "
+            "init_cache with a larger max_len")
+    x = params["embed"].astype(cfg.dtype)[prompt]
+
+    def layer(x, p):
+        h, kh, vh = _attention_prefill(_rmsnorm(x, p["ln1"]), p, cfg)
+        return _mlp_block(x + h, p, cfg), (kh, vh)
+
+    x, (k_all, v_all) = lax.scan(layer, x, params["layers"])
+    # Only the last position's logits are needed: slice BEFORE the
+    # (B, S0, V) head projection.
+    x = _rmsnorm(x[:, -1:], params["ln_f"])
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["head"].astype(cfg.dtype)).astype(jnp.float32)
+    cache = {
+        "k": lax.dynamic_update_slice_in_dim(
+            cache["k"], k_all.astype(cache["k"].dtype), 0, axis=3),
+        "v": lax.dynamic_update_slice_in_dim(
+            cache["v"], v_all.astype(cache["v"].dtype), 0, axis=3),
+        "pos": pos + S0,
+    }
+    return logits[:, 0], cache
+
+
 def greedy_decode(params: Dict, prompt, steps: int, cfg: TransformerConfig):
     """Extend a (B, S0) prompt by ``steps`` greedy tokens -> (B, steps).
 
-    Prefill feeds the prompt token-by-token through the same compiled
-    decode step (correctness-first; a chunked prefill is a pure
-    composition of :func:`forward` attention over the cache)."""
+    One batched :func:`prefill` forward fills the cache, then ``steps``
+    compiled :func:`decode_step` calls generate."""
     B, S0 = prompt.shape
     cache = init_cache(cfg, B, S0 + steps)
-
-    def prefill(carry, t):
-        cache, _ = carry
-        tok = lax.dynamic_index_in_dim(prompt, t, axis=1, keepdims=False)
-        logits, cache = decode_step(params, tok, cache, cfg)
-        return (cache, logits), None
-
-    zero_logits = jnp.zeros((B, cfg.vocab_size), jnp.float32)
-    (cache, logits), _ = lax.scan(
-        prefill, (cache, zero_logits), jnp.arange(S0))
+    logits, cache = prefill(params, prompt, cache, cfg)
 
     def gen(carry, _):
         cache, logits = carry

@@ -172,6 +172,42 @@ class TestDecode:
             seq = np.concatenate([seq, nxt], axis=1)
         np.testing.assert_array_equal(np.asarray(out), seq[:, 4:])
 
+    @pytest.mark.parametrize("kv_heads", [0, 2])
+    def test_prefill_then_decode_matches_forward(self, kv_heads):
+        """prefill fills the cache in one pass; subsequent decode steps
+        must continue exactly where forward() would."""
+        cfg = self._cfg(n_kv_heads=kv_heads)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0, 64)
+        full = T.forward(params, tokens, cfg)
+
+        cache = T.init_cache(cfg, batch=2, max_len=10)
+        logits, cache = T.prefill(params, tokens[:, :6], cache, cfg)
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, 5]),
+                                   atol=2e-4, rtol=2e-4)
+        assert int(cache["pos"]) == 6
+        for t in range(6, 10):
+            logits, cache = T.decode_step(params, tokens[:, t], cache, cfg)
+            np.testing.assert_allclose(
+                np.asarray(logits), np.asarray(full[:, t]),
+                atol=2e-4, rtol=2e-4)
+
+    def test_prefill_requires_fresh_cache(self):
+        cfg = self._cfg()
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        cache = T.init_cache(cfg, batch=1, max_len=8)
+        toks = jnp.zeros((1, 2), jnp.int32)
+        _, cache = T.prefill(params, toks, cache, cfg)
+        with pytest.raises(ValueError, match="fresh"):
+            T.prefill(params, toks, cache, cfg)
+
+    def test_prefill_capacity_checked(self):
+        cfg = self._cfg()
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        cache = T.init_cache(cfg, batch=1, max_len=4)
+        with pytest.raises(ValueError, match="larger max_len"):
+            T.prefill(params, jnp.zeros((1, 6), jnp.int32), cache, cfg)
+
     def test_gqa_cache_is_smaller(self):
         big = T.init_cache(self._cfg(), batch=1)
         small = T.init_cache(self._cfg(n_kv_heads=1), batch=1)
