@@ -452,23 +452,44 @@ def prefill(params: Dict, prompt, cache: Dict, cfg: TransformerConfig):
     return logits[:, 0], cache
 
 
-def greedy_decode(params: Dict, prompt, steps: int, cfg: TransformerConfig):
-    """Extend a (B, S0) prompt by ``steps`` greedy tokens -> (B, steps).
+def sample_decode(params: Dict, prompt, steps: int, cfg: TransformerConfig,
+                  *, rng, temperature: float = 1.0, top_k: int = 0):
+    """Extend a (B, S0) prompt by ``steps`` SAMPLED tokens -> (B, steps).
 
     One batched :func:`prefill` forward fills the cache, then ``steps``
-    compiled :func:`decode_step` calls generate."""
+    compiled :func:`decode_step` calls generate.  ``temperature`` scales
+    the logits; ``top_k > 0`` restricts sampling to the k most likely
+    tokens (clamped to the vocabulary).  ``temperature=0`` is greedy
+    (:func:`greedy_decode` is exactly that case)."""
     B, S0 = prompt.shape
     cache = init_cache(cfg, B, S0 + steps)
     logits, cache = prefill(params, prompt, cache, cfg)
 
-    def gen(carry, _):
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits / temperature
+        if top_k > 0:
+            k = min(top_k, cfg.vocab_size)
+            kth = lax.top_k(scaled, k)[0][:, -1:]
+            scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+    def gen(carry, key):
         cache, logits = carry
-        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tok = pick(logits, key)
         logits, cache = decode_step(params, tok, cache, cfg)
         return (cache, logits), tok
 
-    _, toks = lax.scan(gen, (cache, logits), None, length=steps)
+    keys = jax.random.split(rng, steps)
+    _, toks = lax.scan(gen, (cache, logits), keys)
     return jnp.moveaxis(toks, 0, 1)
+
+
+def greedy_decode(params: Dict, prompt, steps: int, cfg: TransformerConfig):
+    """Extend a (B, S0) prompt by ``steps`` greedy tokens -> (B, steps)."""
+    return sample_decode(params, prompt, steps, cfg,
+                         rng=jax.random.PRNGKey(0), temperature=0.0)
 
 
 # --- true pipeline parallelism ------------------------------------------------

@@ -208,6 +208,26 @@ class TestDecode:
         with pytest.raises(ValueError, match="larger max_len"):
             T.prefill(params, jnp.zeros((1, 6), jnp.int32), cache, cfg)
 
+    def test_sample_decode_temperature_zero_is_greedy(self):
+        cfg = self._cfg()
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 4), 0, 64)
+        greedy = T.greedy_decode(params, prompt, 4, cfg)
+        sampled = T.sample_decode(params, prompt, 4, cfg,
+                                  rng=jax.random.PRNGKey(9),
+                                  temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(greedy),
+                                      np.asarray(sampled))
+        # top-k sampling stays within vocab and is deterministic per key
+        s1 = T.sample_decode(params, prompt, 4, cfg,
+                             rng=jax.random.PRNGKey(3), temperature=1.0,
+                             top_k=4)
+        s2 = T.sample_decode(params, prompt, 4, cfg,
+                             rng=jax.random.PRNGKey(3), temperature=1.0,
+                             top_k=4)
+        np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+        assert np.asarray(s1).max() < 64 and np.asarray(s1).min() >= 0
+
     def test_gqa_cache_is_smaller(self):
         big = T.init_cache(self._cfg(), batch=1)
         small = T.init_cache(self._cfg(n_kv_heads=1), batch=1)
