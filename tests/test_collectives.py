@@ -167,6 +167,23 @@ class TestEager:
                                    rtol=1e-6)
         np.testing.assert_allclose(hvd.allreduce(x, hvd.Average), x)
 
+    def test_weighted_product_min_max(self):
+        """Chip-weighted contract for the remaining reduce ops: Product
+        raises to the local chip count; Min/Max are duplicate-
+        insensitive identities at one process."""
+        ls = hvd.local_size()
+        x = np.asarray([0.5, 1.0, 2.0], np.float32)
+        np.testing.assert_allclose(
+            hvd.allreduce(x, hvd.Product), x ** ls, rtol=1e-6)
+        np.testing.assert_allclose(hvd.allreduce(x, hvd.Min), x)
+        np.testing.assert_allclose(hvd.allreduce(x, hvd.Max), x)
+
+    def test_process_sum_identity(self):
+        """process_sum: exactly one contribution per process regardless
+        of chip count."""
+        x = np.random.randn(4).astype(np.float32)
+        np.testing.assert_allclose(hvd.process_sum(x), x, rtol=1e-6)
+
     def test_allgather_identity(self):
         x = np.random.randn(3, 2).astype(np.float32)
         np.testing.assert_allclose(hvd.allgather(x), x)
