@@ -347,6 +347,38 @@ class TestGroupedQueryAttention:
         with pytest.raises(ValueError, match="multiple"):
             A.expand_kv(jnp.zeros((1, 3, 8, 4)), 4)
 
+    def test_ring_gqa_permutes_small_shards(self):
+        """The central GQA traffic claim, checked at the HLO level: the
+        ring's collective-permute must move the UNEXPANDED (H_kv-wide)
+        K/V shards, not the repeated full-head tensors."""
+        h, h_kv, s, d = 4, 2, N * 8, 16
+        q = jnp.zeros((1, h, s // N, d), jnp.float32)
+        kv = jnp.zeros((1, h_kv, s // N, d), jnp.float32)
+
+        def inner(qs, ks, vs):
+            return A.ring_attention(qs, ks, vs, axis_name=hvd.AXIS,
+                                    causal=True)
+
+        f = spmd.shard(
+            inner,
+            in_specs=(P(None, None, hvd.AXIS, None),) * 3,
+            out_specs=P(None, None, hvd.AXIS, None),
+        )
+        # Per-shard shapes inside shard_map: K/V are (1, h_kv, s/N, d).
+        hlo = jax.jit(f).lower(
+            jnp.zeros((1, h, s, d), jnp.float32),
+            jnp.zeros((1, h_kv, s, d), jnp.float32),
+            jnp.zeros((1, h_kv, s, d), jnp.float32),
+        ).compile().as_text()
+        small = f"f32[1,{h_kv},{s // N},{d}]"
+        big = f"f32[1,{h},{s // N},{d}]"
+        permutes = [l for l in hlo.splitlines() if "collective-permute" in l
+                    and "start" not in l]
+        assert permutes, "ring must emit collective-permutes"
+        assert all(small in l for l in permutes), permutes[:2]
+        assert not any(big in l for l in permutes), (
+            "ppermute must carry the unexpanded H_kv shards", permutes[:2])
+
 
 class TestUlyssesAttention:
     def _run(self, q, k, v, causal, impl="reference"):
