@@ -689,6 +689,15 @@ def grouped_allreduce(tensors: Sequence, op: str = Average, *, axis_name=None, *
             rt, "allreduce", tensors, None, reduce_op=_native_reduce_op(op)
         )
         return _native_wait_tree(rt, treedef, pairs)
+    if op == Adasum:
+        # Concatenating a bucket and running one Adasum would change the
+        # math (one global pairwise coefficient instead of one per
+        # tensor); the group kernel shares the log2(P) communication
+        # rounds while keeping per-tensor coefficients (the reference's
+        # FusedAllreduce semantics, adasum.h:194-338).
+        from horovod_tpu.ops import adasum as _adasum
+
+        return _adasum.eager_adasum_group([np.asarray(t) for t in tensors])
     from horovod_tpu.ops import fusion
 
     return fusion.fused_eager_allreduce(tensors, op)

@@ -334,6 +334,18 @@ def _reduce_group(arrs, op, compression):
             for r, p in zip(reduced, pairs)]
 
 
+def _adasum_reduce_deltas(arrs, compression):
+    """Adasum-allreduce a group of parameter deltas.  Per-tensor pairwise
+    coefficients are guaranteed by :func:`~horovod_tpu.ops.collectives.
+    grouped_allreduce` (native path: the controller fuses the group and
+    the executor runs ``eager_adasum_group``; direct path: the group
+    kernel shares the log2(P) rounds — reference ``adasum.h:194-338``
+    FusedAllreduce semantics)."""
+    return [np.asarray(r)
+            for r in _reduce_group([np.asarray(a) for a in arrs],
+                                   C.Adasum, compression)]
+
+
 def distributed_optimizer_class(base_cls, op=Average, compression=None,
                                 backward_passes_per_step=1):
     """Subclass ``base_cls`` so ``apply_gradients`` averages gradients
@@ -389,11 +401,78 @@ def distributed_optimizer_class(base_cls, op=Average, compression=None,
     return _Wrapped
 
 
+def distributed_adasum_optimizer_class(base_cls, compression=None,
+                                       backward_passes_per_step=1):
+    """Delta-model Adasum subclass of ``base_cls`` — the published Adasum
+    usage mode (reference ``_DistributedAdasumOptimizer``,
+    ``tensorflow/__init__.py:313-407``): each worker applies its own
+    optimizer step (so the delta carries the optimizer's adaptive
+    scaling), and the cumulative parameter delta since the last sync is
+    Adasum-combined and written back:
+
+        start  = params at the last sync
+        apply_gradients() -> LOCAL update (k times for bpps=k)
+        delta  = params - start
+        start += adasum_allreduce(delta) ; params = start
+
+    Matches the optax ``DistributedAdasumOptimizer`` (``optim.py:151``)
+    and the torch factory dispatch (``torch/__init__.py:153-243``)
+    step-for-step."""
+
+    bpps = int(backward_passes_per_step)
+    if bpps < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+
+    class _Wrapped(base_cls):
+        _hvd_wrapped = True
+        _hvd_adasum = True
+
+        def apply_gradients(self, grads_and_vars, **kwargs):
+            gv = list(grads_and_vars)
+            variables = [v for _, v in gv]
+            # plain __dict__ storage: keras 3 optimizers TRACK attribute
+            # assignments (see distributed_optimizer_class above)
+            state = self.__dict__.setdefault(
+                "_hvd_adasum_state", {"start": None, "passes": 0})
+            if state["start"] is None:
+                # params at the last sync = the broadcast initial model
+                state["start"] = [v.numpy().copy() for v in variables]
+            if len(state["start"]) != len(variables):
+                raise ValueError(
+                    "apply_gradients called with a different variable set "
+                    "mid-sync window")
+            result = super().apply_gradients(gv, **kwargs)  # LOCAL update
+            state["passes"] += 1
+            if state["passes"] % bpps != 0:
+                return result  # workers drift locally until the comm step
+            deltas = [v.numpy() - s
+                      for v, s in zip(variables, state["start"])]
+            combined = _adasum_reduce_deltas(deltas, compression)
+            new_start = [s + np.asarray(g, dtype=s.dtype)
+                         for s, g in zip(state["start"], combined)]
+            for v, ns in zip(variables, new_start):
+                v.assign(tf.convert_to_tensor(ns))
+            state["start"] = new_start
+            return result
+
+    _Wrapped.__name__ = base_cls.__name__
+    return _Wrapped
+
+
 def DistributedOptimizer(optimizer, compression=None, op=Average,
                          backward_passes_per_step=1):
     """Wrap a keras optimizer so apply_gradients averages gradients
-    across workers first (reference factory, 410-471)."""
-    cls = distributed_optimizer_class(
-        optimizer.__class__, op=op, compression=compression,
-        backward_passes_per_step=backward_passes_per_step)
+    across workers first (reference factory, 410-471).  ``op=Adasum``
+    selects the delta-model optimizer (local update, Adasum-combined
+    parameter deltas) exactly as the reference factory does
+    (``tensorflow/__init__.py:410-471`` dispatching to
+    ``_DistributedAdasumOptimizer``)."""
+    if op == Adasum:
+        cls = distributed_adasum_optimizer_class(
+            optimizer.__class__, compression=compression,
+            backward_passes_per_step=backward_passes_per_step)
+    else:
+        cls = distributed_optimizer_class(
+            optimizer.__class__, op=op, compression=compression,
+            backward_passes_per_step=backward_passes_per_step)
     return cls.from_config(optimizer.get_config())

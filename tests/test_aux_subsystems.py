@@ -522,7 +522,7 @@ class TestTFFunctionAllreduce:
 
 
 class TestTFMultiProcess:
-    def test_two_process_tf(self, tmp_path):
+    def _spawn(self, tmp_path, scenario, nproc):
         import socket
         import sys
 
@@ -541,20 +541,47 @@ class TestTFMultiProcess:
             "PATH": os.environ.get("PATH", ""),
             "REPO": REPO,
             "PALLAS_AXON_POOL_IPS": "",
-            "HOROVOD_NUM_PROC": "2",
+            "HOROVOD_NUM_PROC": str(nproc),
             "HOROVOD_JAX_PORT": str(free_port()),
             "HOROVOD_NATIVE_PORT": str(free_port()),
         }
+        args = [sys.executable, os.path.join(REPO, "tests", "tf_worker.py")]
+        if scenario:
+            args.append(scenario)
         rc = launch.launch_job(
-            [sys.executable, os.path.join(REPO, "tests", "tf_worker.py")],
-            [HostSpec("localhost", 1)] * 2,
+            args,
+            [HostSpec("localhost", 1)] * nproc,
             env=env,
             output_filename=str(out),
         )
         assert rc == 0, (out / "rank.0.stderr").read_text() + (
-            out / "rank.1.stderr").read_text()
-        for r in (0, 1):
+            out / f"rank.{nproc - 1}.stderr").read_text()
+        for r in range(nproc):
             assert "TF-WORKER-OK" in (out / f"rank.{r}.stdout").read_text()
+
+    def test_two_process_tf(self, tmp_path):
+        self._spawn(tmp_path, None, 2)
+
+    def test_tf_adasum_delta_two_process(self, tmp_path):
+        """TF delta-model Adasum vs the pairwise oracle, 2 ranks
+        (reference _DistributedAdasumOptimizer,
+        tensorflow/__init__.py:313-407)."""
+        self._spawn(tmp_path, "adasum", 2)
+
+
+class TestTFAdasumDispatch:
+    def test_factory_dispatch_and_single_process_identity(self, hvd):
+        import horovod_tpu.tensorflow as hvd_tf
+
+        opt = hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(0.1), op=hvd_tf.Adasum)
+        assert getattr(opt, "_hvd_adasum", False), type(opt).__mro__
+        # With one process the Adasum-combined delta IS the local delta,
+        # so one step must equal the unwrapped optimizer's step.
+        v = tf.Variable([1.0, 2.0])
+        g = tf.constant([0.5, -1.0])
+        opt.apply_gradients([(g, v)])
+        np.testing.assert_allclose(v.numpy(), [0.95, 2.1], rtol=1e-6)
 
 
 class TestSparseAllreduce:

@@ -16,9 +16,88 @@ import tensorflow as tf  # noqa: E402
 
 import horovod_tpu.tensorflow as hvd  # noqa: E402
 
+SCENARIO = sys.argv[1] if len(sys.argv) > 1 else "full"
+
 hvd.init()
 rank = hvd.process_rank()
 nproc = hvd.num_processes()
+
+
+def scenario_adasum():
+    """Delta-model Adasum optimizer vs the pairwise oracle (mirrors the
+    torch_worker adasum scenario; reference test_adasum_* structure):
+    local SGD update, Adasum-combined parameter delta, verified against
+    adasum_reduce_stack of the gathered per-rank deltas."""
+    from horovod_tpu.ops import adasum as AD
+
+    tf.random.set_seed(0)
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(8, activation="tanh", input_shape=(4,)),
+        tf.keras.layers.Dense(1),
+    ])
+    hvd.broadcast_variables(model.variables, root_rank=0)
+    lr = 0.05
+    opt = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(lr), op=hvd.Adasum)
+    # op=Adasum must select the DELTA optimizer, not gradient averaging.
+    assert getattr(opt, "_hvd_adasum", False), type(opt).__mro__
+
+    variables = model.trainable_variables
+    start = [v.numpy().copy() for v in variables]
+    x = tf.random.stateless_normal((16, 4), seed=[123 + rank, 1])
+    y = tf.reduce_sum(x, axis=1, keepdims=True)
+    with tf.GradientTape() as tape:
+        loss = tf.reduce_mean((model(x) - y) ** 2)
+    grads = tape.gradient(loss, variables)  # plain tape: LOCAL grads
+    opt.apply_gradients(zip(grads, variables))
+
+    # Oracle: each rank's local delta is -lr*g (plain SGD); gather and
+    # reduce with the serial pairwise recursion.
+    for i, (v, s, g) in enumerate(zip(variables, start, grads)):
+        local_delta = (-lr * g.numpy()).reshape(1, -1)
+        all_d = hvd.allgather(tf.constant(local_delta),
+                              name=f"adasum.oracle.{i}").numpy()
+        expect = s.reshape(-1) + np.asarray(AD.adasum_reduce_stack(all_d))
+        np.testing.assert_allclose(
+            v.numpy().reshape(-1), expect, rtol=1e-5, atol=1e-6)
+
+    # Replicas must be identical after the sync step.
+    flat = np.concatenate([v.numpy().ravel() for v in variables])
+    gathered = hvd.allgather(tf.constant(flat[None, :])).numpy()
+    for r in range(1, nproc):
+        assert np.allclose(gathered[0], gathered[r], atol=1e-6), r
+
+    # backward_passes_per_step=2: the first step applies only the LOCAL
+    # update (replicas drift on different data); the second Adasum-
+    # combines the cumulative drift and re-converges them.
+    opt2 = hvd.DistributedOptimizer(
+        tf.keras.optimizers.SGD(lr), op=hvd.Adasum,
+        backward_passes_per_step=2)
+    for it in range(2):
+        x = tf.random.stateless_normal((16, 4), seed=[500 + rank, it])
+        y = tf.reduce_sum(x, axis=1, keepdims=True)
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean((model(x) - y) ** 2)
+        grads = tape.gradient(loss, variables)
+        opt2.apply_gradients(zip(grads, variables))
+        flat = np.concatenate([v.numpy().ravel() for v in variables])
+        gathered = hvd.allgather(
+            tf.constant(flat[None, :]), name=f"adasum.k2.{it}").numpy()
+        same = all(np.allclose(gathered[0], gathered[r], atol=1e-7)
+                   for r in range(1, nproc))
+        if it == 0:
+            assert not same, "ranks must drift on the non-comm step"
+        else:
+            assert same, "comm step must re-converge the replicas"
+
+    hvd.shutdown()
+    print(f"TF-WORKER-OK rank={rank}")
+
+
+if SCENARIO == "adasum":
+    scenario_adasum()
+    sys.exit(0)
+
 assert nproc == 2
 
 # dense eager allreduce
