@@ -218,6 +218,11 @@ class EagerRuntime:
     def cache_entries(self) -> int:
         return self._rt.cache_entries()
 
+    def joined_count(self) -> int:
+        """Coordinator-observed count of currently-joined ranks (0 on
+        non-coordinator ranks)."""
+        return self._rt.joined_count()
+
     def set_fusion_bytes(self, nbytes: int) -> None:
         """Adjust the native fusion planner's threshold (autotuner knob —
         reference ParameterManager -> TensorFusionThresholdBytes)."""

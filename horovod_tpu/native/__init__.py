@@ -145,6 +145,7 @@ def _declare(lib: ctypes.CDLL) -> None:
     lib.hvd_wait.argtypes = [ctypes.c_longlong, ctypes.c_char_p, ctypes.c_int]
     lib.hvd_cycles.restype = ctypes.c_longlong
     lib.hvd_last_joined_rank.restype = ctypes.c_int
+    lib.hvd_joined_count.restype = ctypes.c_int
     lib.hvd_cache_hits.restype = ctypes.c_longlong
     lib.hvd_cache_entries.restype = ctypes.c_longlong
     lib.hvd_set_fusion_bytes.restype = None
@@ -372,6 +373,11 @@ class NativeRuntime:
         """Rank that joined LAST in the most recent completed join round
         (reference DoJoin output); -1 before any round completes."""
         return int(self._lib.hvd_last_joined_rank())
+
+    def joined_count(self) -> int:
+        """Coordinator-observed count of currently-joined ranks (always 0
+        on non-coordinator ranks) — an event gauge for join ordering."""
+        return int(self._lib.hvd_joined_count())
 
     def poll(self, handle: int) -> bool:
         return bool(self._lib.hvd_poll(handle))

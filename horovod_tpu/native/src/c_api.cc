@@ -94,6 +94,7 @@ int hvd_wait(long long handle, char* err_buf, int err_len) {
 
 long long hvd_cycles() { return Runtime::Get().cycles(); }
 int hvd_last_joined_rank() { return Runtime::Get().last_joined(); }
+int hvd_joined_count() { return Runtime::Get().joined_count(); }
 long long hvd_cache_hits() { return Runtime::Get().cache_hits(); }
 long long hvd_cache_entries() { return Runtime::Get().cache_entries(); }
 void hvd_set_fusion_bytes(long long b) { Runtime::Get().set_fusion_bytes(b); }

@@ -2,17 +2,24 @@
 
 #include <arpa/inet.h>
 #include <errno.h>
+#include <fcntl.h>
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
+#include <stdlib.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <unistd.h>
 
 #include <algorithm>
 #include <chrono>
 #include <cstring>
+#include <random>
 #include <thread>
+
+#include "logging.h"
 
 namespace hvd {
 
@@ -51,6 +58,194 @@ void SetNoDelay(int fd) {
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
 }
 
+// ms == 0 restores blocking I/O (tv {0,0} disables the socket timeouts).
+void SetIoTimeoutMs(int fd, int64_t ms) {
+  timeval tv{};
+  tv.tv_sec = static_cast<time_t>(ms / 1000);
+  tv.tv_usec = static_cast<suseconds_t>((ms % 1000) * 1000);
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+}
+
+bool SendVerdict(int fd, bool accepted) {
+  uint8_t v = accepted ? 1 : 0;
+  ssize_t n;
+  do {
+    n = ::send(fd, &v, 1, MSG_NOSIGNAL);
+  } while (n < 0 && errno == EINTR);
+  return n == 1;
+}
+
+// --- connect-time authentication ------------------------------------------
+//
+// The rendezvous KV signs every payload with the per-job
+// HOROVOD_SECRET_KEY (runner/secret.py), but these sockets previously
+// accepted any connecting process — an inconsistent trust model for the
+// same deployment.  Every connection now performs a mutual HMAC-SHA256
+// challenge-response keyed by the same job secret (reference trust model:
+// run/common/util/secret.py usage in gloo_run), so a stray or malicious
+// local process can neither impersonate a rank nor a coordinator.
+// SHA-256 per FIPS 180-4; no external crypto dependency.
+
+struct Sha256 {
+  uint32_t h[8];
+  uint64_t len = 0;
+  uint8_t buf[64];
+  size_t buffered = 0;
+
+  Sha256() {
+    static const uint32_t init[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372,
+                                     0xa54ff53a, 0x510e527f, 0x9b05688c,
+                                     0x1f83d9ab, 0x5be0cd19};
+    std::memcpy(h, init, sizeof(h));
+  }
+
+  static uint32_t Rotr(uint32_t x, int n) { return (x >> n) | (x << (32 - n)); }
+
+  void Block(const uint8_t* p) {
+    static const uint32_t k[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; ++i)
+      w[i] = (uint32_t(p[4 * i]) << 24) | (uint32_t(p[4 * i + 1]) << 16) |
+             (uint32_t(p[4 * i + 2]) << 8) | uint32_t(p[4 * i + 3]);
+    for (int i = 16; i < 64; ++i) {
+      uint32_t s0 = Rotr(w[i - 15], 7) ^ Rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = Rotr(w[i - 2], 17) ^ Rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; ++i) {
+      uint32_t s1 = Rotr(e, 6) ^ Rotr(e, 11) ^ Rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + s1 + ch + k[i] + w[i];
+      uint32_t s0 = Rotr(a, 2) ^ Rotr(a, 13) ^ Rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = s0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void Update(const void* data, size_t n) {
+    const uint8_t* p = static_cast<const uint8_t*>(data);
+    len += n;
+    while (n > 0) {
+      size_t take = std::min(n, sizeof(buf) - buffered);
+      std::memcpy(buf + buffered, p, take);
+      buffered += take;
+      p += take;
+      n -= take;
+      if (buffered == 64) {
+        Block(buf);
+        buffered = 0;
+      }
+    }
+  }
+
+  void Final(uint8_t out[32]) {
+    uint64_t bits = len * 8;
+    uint8_t pad = 0x80;
+    Update(&pad, 1);
+    uint8_t zero = 0;
+    while (buffered != 56) Update(&zero, 1);
+    uint8_t lb[8];
+    for (int i = 0; i < 8; ++i) lb[i] = uint8_t(bits >> (56 - 8 * i));
+    Update(lb, 8);
+    for (int i = 0; i < 8; ++i) {
+      out[4 * i] = uint8_t(h[i] >> 24);
+      out[4 * i + 1] = uint8_t(h[i] >> 16);
+      out[4 * i + 2] = uint8_t(h[i] >> 8);
+      out[4 * i + 3] = uint8_t(h[i]);
+    }
+  }
+};
+
+void HmacSha256(const std::string& key, const uint8_t* msg, size_t msg_len,
+                uint8_t out[32]) {
+  uint8_t k[64] = {0};
+  if (key.size() > 64) {
+    Sha256 kh;
+    kh.Update(key.data(), key.size());
+    kh.Final(k);
+  } else {
+    std::memcpy(k, key.data(), key.size());
+  }
+  uint8_t ipad[64], opad[64];
+  for (int i = 0; i < 64; ++i) {
+    ipad[i] = k[i] ^ 0x36;
+    opad[i] = k[i] ^ 0x5c;
+  }
+  uint8_t inner[32];
+  Sha256 hi;
+  hi.Update(ipad, 64);
+  hi.Update(msg, msg_len);
+  hi.Final(inner);
+  Sha256 ho;
+  ho.Update(opad, 64);
+  ho.Update(inner, 32);
+  ho.Final(out);
+}
+
+bool ConstTimeEqual(const uint8_t* a, const uint8_t* b, size_t n) {
+  uint8_t acc = 0;
+  for (size_t i = 0; i < n; ++i) acc |= a[i] ^ b[i];
+  return acc == 0;
+}
+
+void FillNonce(uint8_t out[32]) {
+  int fd = ::open("/dev/urandom", O_RDONLY);
+  if (fd >= 0) {
+    size_t got = 0;
+    while (got < 32) {
+      ssize_t n = ::read(fd, out + got, 32 - got);
+      if (n <= 0) {
+        if (n < 0 && errno == EINTR) continue;
+        break;
+      }
+      got += static_cast<size_t>(n);
+    }
+    ::close(fd);
+    if (got == 32) return;
+  }
+  std::random_device rd;
+  for (int i = 0; i < 32; i += 4) {
+    uint32_t v = rd();
+    std::memcpy(out + i, &v, 4);
+  }
+}
+
+std::string JobSecret() {
+  const char* s = ::getenv("HOROVOD_SECRET_KEY");
+  return s ? std::string(s) : std::string();
+}
+
+// Handshake wire (client -> coordinator first):
+//   auth mode:    magic=kMagicAuth(4) rank(4) client_nonce(32)
+//     coord  ->   server_nonce(32) HMAC(S, "hvd-coord" || client_nonce)(32)
+//     client ->   HMAC(S, "hvd-rank" || rank_le(4) || server_nonce)(32)
+//   no-auth mode: magic=kMagicPlain(4) rank(4)   (only when neither side
+//     has HOROVOD_SECRET_KEY — standalone/debug use)
+constexpr uint32_t kMagicAuth = 0x48764131;   // "Hv A1"
+constexpr uint32_t kMagicPlain = 0x48764130;  // "Hv A0"
+constexpr char kCoordTag[] = "hvd-coord";
+constexpr char kRankTag[] = "hvd-rank";
+
 }  // namespace
 
 SocketComm::~SocketComm() { Shutdown(); }
@@ -74,6 +269,8 @@ bool SocketComm::Init(int rank, int size, const std::string& addr, int port,
   auto deadline =
       std::chrono::steady_clock::now() + std::chrono::duration<double>(timeout_sec);
 
+  const std::string secret = JobSecret();
+
   if (rank == 0) {
     listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
     if (listen_fd_ < 0) {
@@ -95,21 +292,97 @@ bool SocketComm::Init(int rank, int size, const std::string& addr, int port,
       return false;
     }
     peer_fds_.assign(size, -1);
-    for (int i = 1; i < size; ++i) {
+    int connected = 0;
+    // A connection failing the handshake is dropped and the loop keeps
+    // accepting: a stray or wrong-key process must not be able to take a
+    // legitimate rank's slot OR abort the job's bootstrap.
+    while (connected < size - 1) {
+      auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+          deadline - std::chrono::steady_clock::now());
+      if (left.count() <= 0) {
+        *err = "coordinator: timed out waiting for " +
+               std::to_string(size - 1 - connected) + " rank(s)";
+        return false;
+      }
+      pollfd pfd{listen_fd_, POLLIN, 0};
+      int pr = ::poll(&pfd, 1, static_cast<int>(std::min<int64_t>(
+                                   left.count(), 1000)));
+      if (pr < 0 && errno != EINTR) {
+        *err = std::string("poll(): ") + strerror(errno);
+        return false;
+      }
+      if (pr <= 0 || !(pfd.revents & POLLIN)) continue;
       int fd = ::accept(listen_fd_, nullptr, nullptr);
-      if (fd < 0) {
-        *err = std::string("accept(): ") + strerror(errno);
-        return false;
-      }
+      if (fd < 0) continue;
       SetNoDelay(fd);
+      // Per-connection handshake deadline: a connection that goes silent
+      // mid-handshake (port scanner, health probe) must time out and be
+      // dropped, not block the accept loop past the bootstrap deadline.
+      SetIoTimeoutMs(fd, std::max<int64_t>(
+                             1, std::min<int64_t>(left.count(), 10000)));
+      uint32_t magic = 0;
       int32_t peer_rank = -1;
-      if (!RecvAll(fd, &peer_rank, 4) || peer_rank < 1 || peer_rank >= size ||
-          peer_fds_[peer_rank] != -1) {
-        *err = "coordinator: bad rank handshake";
+      if (!RecvAll(fd, &magic, 4) || !RecvAll(fd, &peer_rank, 4)) {
         ::close(fd);
-        return false;
+        continue;
       }
+      const bool peer_auth = magic == kMagicAuth;
+      if ((!peer_auth && magic != kMagicPlain) ||
+          (secret.empty() != !peer_auth)) {
+        HVD_LOG(Warning) << "rejecting connection: "
+                      << (peer_auth ? "unauthenticated coordinator cannot "
+                                      "verify an authenticating client"
+                                    : "client did not authenticate");
+        SendVerdict(fd, false);
+        ::close(fd);
+        continue;
+      }
+      if (peer_auth) {
+        uint8_t client_nonce[32], server_nonce[32], reply[64], proof[32];
+        if (!RecvAll(fd, client_nonce, 32)) {
+          ::close(fd);
+          continue;
+        }
+        FillNonce(server_nonce);
+        // reply = server_nonce || HMAC(S, "hvd-coord" || client_nonce)
+        std::vector<uint8_t> msg(sizeof(kCoordTag) - 1 + 32);
+        std::memcpy(msg.data(), kCoordTag, sizeof(kCoordTag) - 1);
+        std::memcpy(msg.data() + sizeof(kCoordTag) - 1, client_nonce, 32);
+        std::memcpy(reply, server_nonce, 32);
+        HmacSha256(secret, msg.data(), msg.size(), reply + 32);
+        if (!SendAll(fd, reply, 64) || !RecvAll(fd, proof, 32)) {
+          ::close(fd);
+          continue;
+        }
+        std::vector<uint8_t> expect_msg(sizeof(kRankTag) - 1 + 4 + 32);
+        std::memcpy(expect_msg.data(), kRankTag, sizeof(kRankTag) - 1);
+        std::memcpy(expect_msg.data() + sizeof(kRankTag) - 1, &peer_rank, 4);
+        std::memcpy(expect_msg.data() + sizeof(kRankTag) - 1 + 4,
+                    server_nonce, 32);
+        uint8_t expect[32];
+        HmacSha256(secret, expect_msg.data(), expect_msg.size(), expect);
+        if (!ConstTimeEqual(proof, expect, 32)) {
+          HVD_LOG(Warning) << "rejecting connection claiming rank " << peer_rank
+                        << ": HMAC challenge failed (secret key mismatch?)";
+          SendVerdict(fd, false);
+          ::close(fd);
+          continue;
+        }
+      }
+      if (peer_rank < 1 || peer_rank >= size || peer_fds_[peer_rank] != -1) {
+        HVD_LOG(Warning) << "rejecting connection: bad or duplicate rank "
+                      << peer_rank;
+        SendVerdict(fd, false);
+        ::close(fd);
+        continue;
+      }
+      if (!SendVerdict(fd, true)) {
+        ::close(fd);
+        continue;
+      }
+      SetIoTimeoutMs(fd, 0);  // steady-state negotiation blocks indefinitely
       peer_fds_[peer_rank] = fd;
+      ++connected;
     }
   } else {
     // Resolve coordinator address.
@@ -138,12 +411,77 @@ bool SocketComm::Init(int rank, int size, const std::string& addr, int port,
     }
     ::freeaddrinfo(res);
     SetNoDelay(fd);
+    // Handshake must respect the bootstrap deadline: a coordinator that
+    // accepted but went silent must not block past connect_timeout_sec.
+    {
+      auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+          deadline - std::chrono::steady_clock::now());
+      SetIoTimeoutMs(fd, std::max<int64_t>(1, left.count()));
+    }
+    const uint32_t magic = secret.empty() ? kMagicPlain : kMagicAuth;
     int32_t my_rank = rank;
-    if (!SendAll(fd, &my_rank, 4)) {
+    uint8_t hello[40];
+    std::memcpy(hello, &magic, 4);
+    std::memcpy(hello + 4, &my_rank, 4);
+    size_t hello_len = 8;
+    uint8_t client_nonce[32];
+    if (!secret.empty()) {
+      FillNonce(client_nonce);
+      std::memcpy(hello + 8, client_nonce, 32);
+      hello_len = 40;
+    }
+    if (!SendAll(fd, hello, hello_len)) {
       *err = "rank handshake send failed";
       ::close(fd);
       return false;
     }
+    if (!secret.empty()) {
+      // Verify the coordinator knows the job secret BEFORE trusting any
+      // negotiation state from it, then prove our own rank claim.
+      uint8_t reply[64];
+      if (!RecvAll(fd, reply, 64)) {
+        *err = "coordinator closed during authentication (secret key "
+               "mismatch, or the coordinator does not authenticate?)";
+        ::close(fd);
+        return false;
+      }
+      std::vector<uint8_t> msg(sizeof(kCoordTag) - 1 + 32);
+      std::memcpy(msg.data(), kCoordTag, sizeof(kCoordTag) - 1);
+      std::memcpy(msg.data() + sizeof(kCoordTag) - 1, client_nonce, 32);
+      uint8_t expect[32];
+      HmacSha256(secret, msg.data(), msg.size(), expect);
+      if (!ConstTimeEqual(reply + 32, expect, 32)) {
+        *err = "coordinator failed the HMAC challenge (HOROVOD_SECRET_KEY "
+               "mismatch): refusing to join this control plane";
+        ::close(fd);
+        return false;
+      }
+      std::vector<uint8_t> proof_msg(sizeof(kRankTag) - 1 + 4 + 32);
+      std::memcpy(proof_msg.data(), kRankTag, sizeof(kRankTag) - 1);
+      std::memcpy(proof_msg.data() + sizeof(kRankTag) - 1, &my_rank, 4);
+      std::memcpy(proof_msg.data() + sizeof(kRankTag) - 1 + 4, reply, 32);
+      uint8_t proof[32];
+      HmacSha256(secret, proof_msg.data(), proof_msg.size(), proof);
+      if (!SendAll(fd, proof, 32)) {
+        *err = "authentication proof send failed";
+        ::close(fd);
+        return false;
+      }
+    }
+    // Explicit accept/reject verdict in BOTH modes, so a rejected client
+    // (auth-policy mismatch, wrong key, duplicate rank) learns at init()
+    // time instead of failing later with an unrelated negotiation error.
+    uint8_t verdict = 0;
+    if (!RecvAll(fd, &verdict, 1) || verdict != 1) {
+      *err = secret.empty()
+                 ? "coordinator rejected this connection (does the job "
+                   "require HOROVOD_SECRET_KEY?)"
+                 : "coordinator rejected this connection (secret key "
+                   "mismatch or duplicate rank)";
+      ::close(fd);
+      return false;
+    }
+    SetIoTimeoutMs(fd, 0);  // steady-state negotiation blocks indefinitely
     peer_fds_.assign(1, fd);
   }
   return true;

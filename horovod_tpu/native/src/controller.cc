@@ -180,8 +180,10 @@ void Controller::CoordinatorIngest(const std::vector<RequestList>& lists,
     shutdown = shutdown || list.shutdown;
     for (const auto& req : list.requests) {
       if (req.type == ReqType::JOIN) {
-        if (joined_ranks_.insert(list.rank).second)
+        if (joined_ranks_.insert(list.rank).second) {
           last_joined_rank_ = list.rank;  // arrival order at cycle granularity
+          joined_count_.store(static_cast<int>(joined_ranks_.size()));
+        }
         continue;
       }
       auto& entry = message_table_[req.name];
@@ -233,6 +235,7 @@ void Controller::CoordinatorIngest(const std::vector<RequestList>& lists,
     out->responses.push_back(j);
     joined_ranks_.clear();
     last_joined_rank_ = -1;
+    joined_count_.store(0);
   }
 
   out->shutdown = shutdown || stall_abort_;

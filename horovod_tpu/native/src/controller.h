@@ -56,6 +56,11 @@ class Controller {
 
   int64_t cache_hits() const { return cache_.hits(); }
   size_t cache_entries() const { return cache_.NumEntries(); }
+  // Coordinator-observed count of currently-joined ranks (0 on workers).
+  // Lets rank 0 wait on "the stragglers have demonstrably joined" as an
+  // event instead of a sleep (tests) — updated on the background thread,
+  // read from the application thread.
+  int joined_count() const { return joined_count_.load(); }
   // Written from the application thread (autotuner), read by the
   // background thread's Fuse() — atomic for data-race freedom.  Cross-rank
   // consistency is the caller's contract: apply only behind a barrier
@@ -99,6 +104,7 @@ class Controller {
   // rank holding the most-advanced state); carried to every rank in the
   // JOIN response's root_rank field.
   int last_joined_rank_ = -1;
+  std::atomic<int> joined_count_{0};
   bool stall_abort_ = false;  // rank 0: stall exceeded the shutdown bound
 };
 

@@ -62,6 +62,8 @@ class Runtime {
   // Rank that joined LAST in the most recent completed join round
   // (reference DoJoin output tensor); -1 before any round completes.
   int last_joined() const { return last_joined_.load(); }
+  // Coordinator-observed currently-joined rank count (0 on workers).
+  int joined_count() { return controller_ ? controller_->joined_count() : 0; }
   int64_t cache_hits() { return controller_ ? controller_->cache_hits() : 0; }
   int64_t cache_entries() {
     return controller_ ? static_cast<int64_t>(controller_->cache_entries()) : 0;

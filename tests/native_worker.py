@@ -122,43 +122,58 @@ def scenario_full():
     np.testing.assert_allclose(
         out, np.asarray(adasum_mod.adasum_reduce_stack(stacked)), rtol=1e-6)
 
+    # De-flaked cache assertions: cycle skew (a rank popping its
+    # submission a cycle before its peer sets the cache bit) forces
+    # occasional slow-path fallbacks under host load, so fixed repeat
+    # counts flake.  Instead run repeats in LOCKSTEP until every rank has
+    # accumulated the wanted hit count — the exit condition is itself a
+    # collective (Min over per-rank hit deltas, fresh name per iteration
+    # so it never pollutes the hit counter), so all ranks execute the
+    # same iteration count and the assertion holds at any scheduling
+    # latency.
+    def lockstep_until_hits(tag, want, body):
+        base = rt.cache_hits()
+        for i in range(200):
+            body()
+            mine = np.array([float(rt.cache_hits() - base)], np.float32)
+            agreed = hvd.allreduce(mine, hvd.Min, name=f"{tag}.cond.{i}")
+            if agreed[0] >= want:
+                return
+        raise AssertionError(
+            f"{tag}: cache fast path never reached {want} hits on every "
+            f"rank (local delta {rt.cache_hits() - base})")
+
     # response-cache steady state: repeats of the same name fast-path
-    for _ in range(5):
-        hvd.allreduce(x, hvd.Sum, name="cached.t")
-    assert rt.cache_hits() >= 3, rt.cache_hits()
+    lockstep_until_hits(
+        "cached", 3,
+        lambda: hvd.allreduce(x, hvd.Sum, name="cached.t"))
 
     # allgather/alltoall response caching: first dims vary per rank, but
     # the cache key is the LOCAL request, so fixed-shape repeats ride the
     # bit-vector fast path too (reference response_cache.h:45-102).  The
-    # first iteration negotiates (slow path); all later ones must hit.
+    # first iteration negotiates (slow path); later ones must hit.
     ag_mine = np.full((rank + 1, 2), float(rank), np.float32)
     a2a_mine = np.repeat(np.arange(size, dtype=np.float32), 2)
-    hvd.allgather(ag_mine, name="ag.cached")
-    hvd.alltoall(a2a_mine, name="a2a.cached")
-    hits_before = rt.cache_hits()
-    for _ in range(4):
+
+    def gather_body():
         out = hvd.allgather(ag_mine, name="ag.cached")
         assert out.shape == (total, 2), out.shape
         hvd.alltoall(a2a_mine, name="a2a.cached")
-    # Tolerate slow-path fallbacks from cycle skew (a rank popping its
-    # submission a cycle before its peer clears the AND bit) — worse
-    # under full-suite host load, so require only half the 8 repeats.
-    assert rt.cache_hits() - hits_before >= 4, (
-        "steady-state allgather/alltoall must be cache fast-path",
-        hits_before, rt.cache_hits())
+
+    gather_body()  # first negotiation (slow path)
+    lockstep_until_hits("agcache", 4, gather_body)
 
     # Invalidation: a changed first dim must MISS locally (the cache key
     # is this rank's own request), renegotiate globally, and produce the
     # correct new concatenation — then the refreshed entry caches again.
     grown = np.full((rank + 3, 2), float(rank), np.float32)
-    out = hvd.allgather(grown, name="ag.cached")
-    assert out.shape == (sum(r + 3 for r in range(size)), 2), out.shape
-    hits_before = rt.cache_hits()
-    for _ in range(3):
+
+    def grown_body():
         out = hvd.allgather(grown, name="ag.cached")
-        assert out.shape == (sum(r + 3 for r in range(size)), 2)
-    assert rt.cache_hits() - hits_before >= 1, (
-        "re-Put entry must fast-path again", rt.cache_hits())
+        assert out.shape == (sum(r + 3 for r in range(size)), 2), out.shape
+
+    grown_body()  # renegotiation with the new first dim
+    lockstep_until_hits("agrow", 1, grown_body)
 
     # autotuner knob application: cycle time + cache capacity.  Resize on
     # rank 0 FIRST so the ranks' bit-vector lengths disagree for a few
@@ -208,11 +223,17 @@ def scenario_full():
 
         # Second round with rank 0 joining LAST: every rank must get 0 —
         # a value the pre-fix Max-of-ranks computation could never yield.
-        # Generous sleep: under full-suite host load the other ranks'
-        # join submissions may take hundreds of ms to reach the
-        # coordinator, and rank 0 must demonstrably arrive after them.
+        # Event, not sleep: rank 0 hosts the coordinator, so it can wait
+        # until the controller has SEEN every other rank's join before
+        # submitting its own — deterministically last at any scheduling
+        # latency (the joined_count gauge exists for exactly this).
         if rank == 0:
-            time.sleep(2.5)
+            deadline = time.time() + 120
+            while rt.joined_count() < size - 1:
+                assert time.time() < deadline, (
+                    "stragglers' joins never reached the coordinator",
+                    rt.joined_count())
+                time.sleep(0.005)
         last = hvd.join()
         assert last == 0, f"rank 0 joined last yet join() returned {last}"
         np.testing.assert_allclose(

@@ -162,6 +162,84 @@ class TestMultiProcess:
         for r in (0, 1):
             assert "NATIVE-WORKER-OK" in (out / f"rank.{r}.stdout").read_text()
 
+    def test_wrong_secret_key_rejected(self, tmp_path):
+        """The control-plane sockets perform a mutual HMAC challenge keyed
+        by the job's HOROVOD_SECRET_KEY (the trust model the rendezvous KV
+        already uses — reference run/common/util/secret.py): a client with
+        the wrong key must be refused, and must itself refuse the
+        coordinator before trusting any negotiation state."""
+        port = _free_port()
+        script = (
+            "import sys\n"
+            "from horovod_tpu import native\n"
+            "rt = native.NativeRuntime()\n"
+            "rank = int(sys.argv[1])\n"
+            "try:\n"
+            f"    rt.init(rank, 2, '127.0.0.1', {port},"
+            " connect_timeout_sec=15.0)\n"
+            "except RuntimeError as e:\n"
+            "    print(f'INIT-FAILED rank={rank}: {e}')\n"
+            "    sys.exit(3)\n"
+            "print(f'INIT-OK rank={rank}')\n"
+            "rt.shutdown()\n"
+        )
+        env = {
+            "PATH": os.environ.get("PATH", ""),
+            "PYTHONPATH": REPO,
+            "PALLAS_AXON_POOL_IPS": "",
+        }
+        coord = subprocess.Popen(
+            [sys.executable, "-c", script, "0"],
+            env={**env, "HOROVOD_SECRET_KEY": "a" * 32},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        intruder = subprocess.run(
+            [sys.executable, "-c", script, "1"],
+            env={**env, "HOROVOD_SECRET_KEY": "b" * 32},
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            timeout=120)
+        # The wrong-key client detects the mismatch ITSELF (mutual auth)
+        # and refuses to join.
+        assert intruder.returncode == 3, intruder.stdout
+        assert "HMAC challenge" in intruder.stdout, intruder.stdout
+        # The coordinator never accepted it as rank 1: with nobody else
+        # dialing in, bootstrap times out instead of proceeding with an
+        # impostor.
+        out, _ = coord.communicate(timeout=120)
+        assert coord.returncode == 3, out
+        assert "timed out waiting for" in out, out
+
+    def test_same_secret_key_accepted(self, tmp_path):
+        """Positive control for the HMAC handshake: both sides holding the
+        job secret bootstrap normally (every launcher-spawned test also
+        covers this — the launcher always exports HOROVOD_SECRET_KEY)."""
+        port = _free_port()
+        script = (
+            "import sys\n"
+            "from horovod_tpu import native\n"
+            "rt = native.NativeRuntime()\n"
+            "rank = int(sys.argv[1])\n"
+            f"rt.init(rank, 2, '127.0.0.1', {port},"
+            " connect_timeout_sec=60.0)\n"
+            "print(f'INIT-OK rank={rank}')\n"
+            "rt.shutdown()\n"
+        )
+        env = {
+            "PATH": os.environ.get("PATH", ""),
+            "PYTHONPATH": REPO,
+            "PALLAS_AXON_POOL_IPS": "",
+            "HOROVOD_SECRET_KEY": "c" * 32,
+        }
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", script, str(r)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            for r in (0, 1)
+        ]
+        for p in procs:
+            out, _ = p.communicate(timeout=120)
+            assert p.returncode == 0, out
+            assert "INIT-OK" in out, out
+
     def test_stall_inspector_warns(self, tmp_path):
         rc, out = _spawn_workers(
             tmp_path, "stall",
