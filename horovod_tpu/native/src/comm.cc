@@ -76,6 +76,38 @@ bool SendVerdict(int fd, bool accepted) {
   return n == 1;
 }
 
+// RecvAll with an ABSOLUTE deadline (poll + nonblocking-style recv
+// budgeting): per-recv SO_RCVTIMEO alone would let a peer dribble one
+// byte per timeout window and hold the coordinator's single-threaded
+// accept loop far past its handshake budget.
+bool RecvAllBy(int fd, void* buf, size_t len,
+               std::chrono::steady_clock::time_point deadline) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (len > 0) {
+    auto left = std::chrono::duration_cast<std::chrono::milliseconds>(
+        deadline - std::chrono::steady_clock::now());
+    if (left.count() <= 0) return false;
+    pollfd pfd{fd, POLLIN, 0};
+    int pr = ::poll(&pfd, 1,
+                    static_cast<int>(std::min<int64_t>(left.count(), 1000)));
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (pr == 0 || !(pfd.revents & (POLLIN | POLLHUP | POLLERR))) continue;
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR || errno == EAGAIN ||
+                    errno == EWOULDBLOCK))
+        continue;
+      return false;
+    }
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
 // --- connect-time authentication ------------------------------------------
 //
 // The rendezvous KV signs every payload with the per-job
@@ -315,14 +347,20 @@ bool SocketComm::Init(int rank, int size, const std::string& addr, int port,
       int fd = ::accept(listen_fd_, nullptr, nullptr);
       if (fd < 0) continue;
       SetNoDelay(fd);
-      // Per-connection handshake deadline: a connection that goes silent
-      // mid-handshake (port scanner, health probe) must time out and be
-      // dropped, not block the accept loop past the bootstrap deadline.
-      SetIoTimeoutMs(fd, std::max<int64_t>(
-                             1, std::min<int64_t>(left.count(), 10000)));
+      // ABSOLUTE per-connection handshake deadline: a connection that
+      // goes silent or dribbles bytes (port scanner, health probe, slow-
+      // loris) is dropped after a small fixed budget — it can neither
+      // block the accept loop past the bootstrap deadline nor hold it
+      // one recv-timeout at a time.  Legitimate handshakes complete in
+      // microseconds; 2s absorbs scheduler hiccups.
+      auto hs_deadline =
+          std::chrono::steady_clock::now() +
+          std::chrono::milliseconds(std::min<int64_t>(left.count(), 2000));
+      SetIoTimeoutMs(fd, 2000);  // bounds the verdict/reply sends too
       uint32_t magic = 0;
       int32_t peer_rank = -1;
-      if (!RecvAll(fd, &magic, 4) || !RecvAll(fd, &peer_rank, 4)) {
+      if (!RecvAllBy(fd, &magic, 4, hs_deadline) ||
+          !RecvAllBy(fd, &peer_rank, 4, hs_deadline)) {
         ::close(fd);
         continue;
       }
@@ -339,7 +377,7 @@ bool SocketComm::Init(int rank, int size, const std::string& addr, int port,
       }
       if (peer_auth) {
         uint8_t client_nonce[32], server_nonce[32], reply[64], proof[32];
-        if (!RecvAll(fd, client_nonce, 32)) {
+        if (!RecvAllBy(fd, client_nonce, 32, hs_deadline)) {
           ::close(fd);
           continue;
         }
@@ -350,7 +388,8 @@ bool SocketComm::Init(int rank, int size, const std::string& addr, int port,
         std::memcpy(msg.data() + sizeof(kCoordTag) - 1, client_nonce, 32);
         std::memcpy(reply, server_nonce, 32);
         HmacSha256(secret, msg.data(), msg.size(), reply + 32);
-        if (!SendAll(fd, reply, 64) || !RecvAll(fd, proof, 32)) {
+        if (!SendAll(fd, reply, 64) ||
+            !RecvAllBy(fd, proof, 32, hs_deadline)) {
           ::close(fd);
           continue;
         }

@@ -17,5 +17,6 @@ from horovod_tpu.ops.attention import (  # noqa: F401
 )
 from horovod_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_apply,
+    pipeline_value_and_grad,
     stack_to_stages,
 )

@@ -87,6 +87,137 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
                     axis_name)
 
 
+def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
+                            targets, loss_fn: Callable, *,
+                            axis_name: str = "pp",
+                            schedule: str = "gpipe"):
+    """Microbatched pipeline training step: total loss and THIS stage's
+    parameter gradients.
+
+    Args:
+      stage_fn: ``(stage_params, x) -> y`` (shape-preserving, like
+        :func:`pipeline_apply`).
+      stage_params: this device's stage parameters (pp-sharded slice).
+      microbatches: ``(M, mb, ...)``, replicated over ``axis_name``.
+      targets: ``(M, ...)`` per-microbatch loss targets, replicated.
+      loss_fn: ``(y, target) -> scalar`` per-microbatch loss; the returned
+        loss is the SUM over microbatches (scale inside ``loss_fn`` for a
+        mean).
+      schedule: ``"gpipe"`` or ``"1f1b"``.
+
+    Returns:
+      ``(loss, stage_grads)`` — loss replicated over the axis,
+      ``stage_grads`` matching ``stage_params`` (per-stage, i.e. still
+      pp-sharded from the caller's viewpoint).
+
+    Schedules:
+
+    * ``"gpipe"`` — forward all M microbatches through
+      :func:`pipeline_apply`, then let autodiff reverse the scan.  Simple,
+      but the in-flight activation footprint grows with **M** (autodiff
+      saves every tick's residuals; ``jax.checkpoint`` on ``stage_fn``
+      reduces it to M stage-inputs).
+    * ``"1f1b"`` — interleaved forward/backward wavefronts in ONE scan:
+      at tick t, stage s runs the forward for microbatch ``t - s`` while
+      the backward wave (cotangents flowing stage P-1 → 0 via the reverse
+      ``ppermute``) runs microbatch ``t - (2P-2-s)``; the last stage
+      starts a microbatch's backward on the same tick its forward
+      completes (the 1F1B discipline — a microbatch drains before more
+      fill in).  Each stage keeps a ring buffer of the **stage inputs**
+      of in-flight microbatches only — at most ``2(P-1)`` of them, bound
+      by the pipeline depth and INDEPENDENT of M — and rematerializes the
+      stage forward inside ``jax.vjp`` at backward time (the trade the
+      1F1B papers make on activation-scarce hardware; same remat the
+      gpipe path needs ``jax.checkpoint`` for).  Raising M to amortize
+      the ``2(P-1)/(M+2P-2)`` bubble therefore no longer raises memory.
+    """
+    P = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+
+    if schedule == "gpipe":
+        def total_loss(params):
+            outs = pipeline_apply(stage_fn, params, microbatches,
+                                  axis_name=axis_name)
+            losses = jax.vmap(loss_fn)(outs, targets)
+            return jnp.sum(losses)
+
+        return jax.value_and_grad(total_loss)(stage_params)
+    if schedule != "1f1b":
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+
+    right = [(i, (i + 1) % P) for i in range(P)]
+    left = [((i + 1) % P, i) for i in range(P)]
+    # Ring of in-flight stage inputs + one scratch slot that invalid-tick
+    # writes land in (so they can never clobber a live entry).
+    R = min(2 * P - 1, M)
+    mb_shape = microbatches.shape[1:]
+    dtype = microbatches.dtype
+    T = M + 2 * P - 2
+    is_last = s == P - 1
+
+    def tick(carry, t):
+        fwd_in, bwd_in, xbuf, gacc, lacc = carry
+
+        # ---- forward wave: F(s, m) at tick t = s + m -------------------
+        m_f = t - s
+        f_valid = (m_f >= 0) & (m_f < M)
+        mb = lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(m_f, 0, M - 1), keepdims=False)
+        x_in = jnp.where(f_valid, jnp.where(s == 0, mb, fwd_in),
+                         jnp.zeros(mb_shape, dtype))
+        slot = jnp.where(f_valid, jnp.clip(m_f, 0, M - 1) % R, R)
+        xbuf = lax.dynamic_update_index_in_dim(xbuf, x_in, slot, axis=0)
+        y = stage_fn(stage_params, x_in)
+
+        # ---- backward wave: B(s, m) at tick t = (2P-2-s) + m -----------
+        m_b = t - (2 * P - 2 - s)
+        b_valid = (m_b >= 0) & (m_b < M)
+        x_b = lax.dynamic_index_in_dim(
+            xbuf, jnp.where(b_valid, jnp.clip(m_b, 0, M - 1) % R, R),
+            keepdims=False)
+        y_b, pull = jax.vjp(stage_fn, stage_params, x_b)
+        tgt = lax.dynamic_index_in_dim(
+            targets, jnp.clip(m_b, 0, M - 1), keepdims=False)
+        loss_b, gy_loss = jax.value_and_grad(loss_fn)(y_b, tgt)
+        # Cotangent source: the last stage seeds from its own loss; other
+        # stages consume what their right neighbour emitted last tick.
+        gy = jnp.where(b_valid, jnp.where(is_last, gy_loss, bwd_in),
+                       jnp.zeros_like(y_b))
+        gparams, gx = pull(gy)
+        # Double-where guard: zeroing gy is not enough when stage_fn's
+        # partials are non-finite at the zero fill/drain input (0 * inf =
+        # nan would poison the accumulator), so mask the pullback outputs
+        # on validity too.
+        gparams = jax.tree_util.tree_map(
+            lambda g: jnp.where(b_valid, g, jnp.zeros_like(g)), gparams)
+        gx = jnp.where(b_valid, gx, jnp.zeros_like(gx))
+        gacc = jax.tree_util.tree_map(lambda a, g: a + g, gacc, gparams)
+        lacc = lacc + jnp.where(b_valid & is_last, loss_b, 0.0)
+
+        return (lax.ppermute(y, axis_name, right),
+                lax.ppermute(gx, axis_name, left),
+                xbuf, gacc, lacc), None
+
+    # Device-varying zeros (see pipeline_apply): every carry leaf becomes
+    # varying-over-pp inside the scan (permuted wires, per-stage grads),
+    # so the initial carry must be too.
+    def vzeros(shape, dt):
+        return jnp.zeros(shape, dt) + (s * 0).astype(dt)
+
+    fwd0 = vzeros(mb_shape, dtype)
+    bwd0 = vzeros(mb_shape, dtype)
+    xbuf0 = vzeros((R + 1,) + mb_shape, dtype)
+    gacc0 = jax.tree_util.tree_map(
+        lambda p: vzeros(p.shape, p.dtype), stage_params)
+    lacc0 = vzeros((), jnp.float32)
+
+    (_, _, _, gacc, lacc), _ = lax.scan(
+        tick, (fwd0, bwd0, xbuf0, gacc0, lacc0), jnp.arange(T))
+    # Only stage P-1 accumulated loss; psum broadcasts it to the axis.
+    return lax.psum(lacc, axis_name), gacc
+
+
 def stack_to_stages(stacked, n_stages: int):
     """Reshape a ``(n_layers, ...)`` scanned-layer pytree to
     ``(n_stages, n_layers/n_stages, ...)`` so axis 0 can be sharded over

@@ -96,6 +96,107 @@ class TestPipelineApply:
             pipeline.stack_to_stages(w_all, 4)
 
 
+def _loss_fn(y, tgt):
+    return jnp.sum((y - tgt) ** 2)
+
+
+class TestPipeline1F1B:
+    def _run_schedule(self, schedule, p, layers, m, mb=2, d=8):
+        w_all = jax.random.normal(jax.random.PRNGKey(0), (layers, d, d)) * 0.3
+        x = jax.random.normal(jax.random.PRNGKey(1), (m, mb, d))
+        tgt = jax.random.normal(jax.random.PRNGKey(2), (m, mb, d)) * 0.1
+        staged = pipeline.stack_to_stages(w_all, p)
+        mesh = _mesh(p)
+
+        def inner(wst, xs, ts):
+            loss, g = pipeline.pipeline_value_and_grad(
+                _stage_fn, wst[0], xs, ts, _loss_fn, axis_name="pp",
+                schedule=schedule)
+            return loss, g[None]
+
+        fn = jax.jit(jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P("pp"), P(), P()),
+            out_specs=(P(), P("pp")),
+        ))
+        loss, g = fn(staged, x, tgt)
+        return w_all, x, tgt, float(loss), np.asarray(g).reshape(w_all.shape)
+
+    @pytest.mark.parametrize("p,layers,m", [(4, 8, 6), (2, 6, 5), (8, 8, 3)])
+    def test_1f1b_exact_vs_sequential_and_gpipe(self, p, layers, m):
+        """1F1B loss and EVERY stage gradient must match both the GPipe
+        schedule and plain sequential autodiff."""
+        w_all, x, tgt, loss_1, g_1 = self._run_schedule("1f1b", p, layers, m)
+
+        def loss_seq(w_all):
+            outs = jax.vmap(lambda xb: _sequential(w_all, xb))(x)
+            return jnp.sum(jax.vmap(_loss_fn)(outs, tgt))
+
+        l_ref, g_ref = jax.value_and_grad(loss_seq)(w_all)
+        np.testing.assert_allclose(loss_1, float(l_ref), rtol=1e-5)
+        np.testing.assert_allclose(g_1, np.asarray(g_ref),
+                                   atol=1e-4, rtol=1e-4)
+
+        _, _, _, loss_g, g_g = self._run_schedule("gpipe", p, layers, m)
+        np.testing.assert_allclose(loss_1, loss_g, rtol=1e-5)
+        np.testing.assert_allclose(g_1, g_g, atol=1e-4, rtol=1e-4)
+
+    def test_unknown_schedule_raises(self):
+        mesh = _mesh(2)
+        w = jnp.zeros((2, 1, 4, 4))
+        x = jnp.zeros((2, 1, 4))
+        t = jnp.zeros((2, 1, 4))
+        with pytest.raises(ValueError, match="schedule"):
+            jax.shard_map(
+                lambda wst, xs, ts: pipeline.pipeline_value_and_grad(
+                    _stage_fn, wst[0], xs, ts, _loss_fn, axis_name="pp",
+                    schedule="bogus"),
+                mesh=mesh, in_specs=(P("pp"), P(), P()),
+                out_specs=(P(), P("pp")),
+            )(w, x, t)
+
+    def test_1f1b_memory_independent_of_m(self):
+        """The 1F1B claim, MEASURED: raising M (16 vs 4) must leave the
+        1F1B temp footprint ~flat (in-flight state is bounded by 2(P-1)
+        stage inputs), while GPipe's autodiff footprint grows with M.
+        Uses XLA's compiled memory analysis at M=16, P=4."""
+        p, layers, mb, d = 4, 8, 8, 64
+
+        def compiled_temp_bytes(schedule, m):
+            w_all = jnp.zeros((layers, d, d))
+            x = jnp.zeros((m, mb, d))
+            tgt = jnp.zeros((m, mb, d))
+            staged = pipeline.stack_to_stages(w_all, p)
+            mesh = _mesh(p)
+
+            def inner(wst, xs, ts):
+                loss, g = pipeline.pipeline_value_and_grad(
+                    _stage_fn, wst[0], xs, ts, _loss_fn, axis_name="pp",
+                    schedule=schedule)
+                return loss, g[None]
+
+            fn = jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=(P("pp"), P(), P()),
+                out_specs=(P(), P("pp"))))
+            c = fn.lower(staged, x, tgt).compile()
+            return c.memory_analysis().temp_size_in_bytes
+
+        gpipe_4 = compiled_temp_bytes("gpipe", 4)
+        gpipe_16 = compiled_temp_bytes("gpipe", 16)
+        f1b_4 = compiled_temp_bytes("1f1b", 4)
+        f1b_16 = compiled_temp_bytes("1f1b", 16)
+
+        # GPipe: autodiff saves every tick's residuals -> grows with M.
+        assert gpipe_16 > gpipe_4 * 2, (gpipe_4, gpipe_16)
+        # 1F1B: in-flight state bounded by pipeline depth, not M.  Allow
+        # slack for the (M-proportional) microbatch INPUT buffers that any
+        # schedule carries.
+        assert f1b_16 < f1b_4 * 2, (f1b_4, f1b_16)
+        # And at the benchmark point (M=16, P=4) 1F1B must be the smaller
+        # footprint.
+        assert f1b_16 < gpipe_16, (f1b_16, gpipe_16)
+
+
 class TestPipelinedTransformerAPI:
     def _setup(self, p=4):
         from horovod_tpu.models import transformer as T
