@@ -41,6 +41,22 @@ class TransformerConfig:
     d_ff: int = 2048
     max_seq: int = 1024
     n_experts: int = 0  # 0/1 = dense MLP
+    # MoE dispatch: "switch" = sparse capacity-factor token dispatch
+    # (horovod_tpu.ops.moe — each token computes ONE expert; under
+    # shard_map with moe_axis bound, one all_to_all each way and only
+    # RESIDENT experts compute, so the ep axis shards compute).  "dense"
+    # = evaluate every expert and combine with the routing one-hot (the
+    # exact oracle; dropless, O(E) FLOPs — right for tiny E and for
+    # decoding).
+    moe_impl: str = "switch"
+    # Per-expert capacity multiplier for switch dispatch: each expert
+    # accepts ceil(cf * T / E) tokens per step; overflow tokens pass
+    # through the residual only (standard Switch training behavior).
+    capacity_factor: float = 2.0
+    # Mesh axis for expert parallelism when running under shard_map
+    # (None = single-device sparse dispatch; the GSPMD/jit path shards
+    # the expert axis via param_specs instead).
+    moe_axis: Optional[str] = None
     # Grouped-query attention: K/V heads (0 = n_heads, i.e. MHA).  With
     # ring attention the rotating K/V shards shrink by n_heads/n_kv_heads
     # — the long-context ICI-bandwidth lever (beyond-reference extension).
@@ -249,10 +265,11 @@ def _dense_mlp(x, p, cfg: TransformerConfig):
     return jnp.einsum("bsf,fd->bsd", jax.nn.silu(g) * u, p["w_down"].astype(cfg.dtype))
 
 
-def _moe_mlp(x, p, cfg: TransformerConfig):
+def _moe_mlp_dense(x, p, cfg: TransformerConfig):
     """Top-1 MoE, dense dispatch: compute routing probs, evaluate every
-    expert, combine with the routing one-hot.  Exact; trades FLOPs for
-    zero dynamic shapes — the XLA-friendly formulation at small E."""
+    expert, combine with the routing one-hot.  Exact and dropless — the
+    oracle for the sparse path, and the right choice for decoding (a
+    handful of tokens) and tiny E."""
     logits = jnp.einsum("bsd,de->bse", x, p["router"].astype(cfg.dtype))
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     top = jnp.argmax(probs, axis=-1)  # (B, S)
@@ -265,12 +282,30 @@ def _moe_mlp(x, p, cfg: TransformerConfig):
     return y * gate[..., None].astype(cfg.dtype)
 
 
-def _mlp_block(x, p, cfg: TransformerConfig):
+def _moe_mlp(x, p, cfg: TransformerConfig, impl: Optional[str] = None):
+    """Mixture-of-experts FFN; ``impl`` overrides ``cfg.moe_impl`` (the
+    decode path forces "dense": per-step token counts are tiny and the
+    capacity-drop pattern is a training-time behavior)."""
+    impl = impl or cfg.moe_impl
+    if impl == "dense":
+        return _moe_mlp_dense(x, p, cfg)
+    if impl != "switch":
+        raise ValueError(f"unknown moe_impl {impl!r}; "
+                         "expected 'switch' or 'dense'")
+    from horovod_tpu.ops import moe
+
+    return moe.switch_moe(
+        x, p["router"], p["w_gate"].astype(cfg.dtype),
+        p["w_up"].astype(cfg.dtype), p["w_down"].astype(cfg.dtype),
+        capacity_factor=cfg.capacity_factor, axis_name=cfg.moe_axis)
+
+
+def _mlp_block(x, p, cfg: TransformerConfig, moe_impl: Optional[str] = None):
     """Residual MLP half of a layer (shared by forward, the pipeline, and
     the decode step so the three can never drift apart)."""
     m = _rmsnorm(x, p["ln2"])
     if cfg.n_experts > 1:
-        return x + _moe_mlp(m, p, cfg)
+        return x + _moe_mlp(m, p, cfg, impl=moe_impl)
     return x + _dense_mlp(m, p, cfg)
 
 
@@ -386,7 +421,7 @@ def decode_step(params: Dict, tokens_t, cache: Dict, cfg: TransformerConfig):
         p, k_c, v_c = inp
         h, k_new, v_new = _attention_decode(
             _rmsnorm(x, p["ln1"]), p, cfg, k_c, v_c, pos)
-        return _mlp_block(x + h, p, cfg), (k_new, v_new)
+        return _mlp_block(x + h, p, cfg, moe_impl="dense"), (k_new, v_new)
 
     x, (k_all, v_all) = lax.scan(
         layer, x, (params["layers"], cache["k"], cache["v"]))
@@ -434,7 +469,7 @@ def prefill(params: Dict, prompt, cache: Dict, cfg: TransformerConfig):
 
     def layer(x, p):
         h, kh, vh = _attention_prefill(_rmsnorm(x, p["ln1"]), p, cfg)
-        return _mlp_block(x + h, p, cfg), (kh, vh)
+        return _mlp_block(x + h, p, cfg, moe_impl="dense"), (kh, vh)
 
     x, (k_all, v_all) = lax.scan(layer, x, params["layers"])
     # Only the last position's logits are needed: slice BEFORE the

@@ -477,8 +477,12 @@ bool SocketComm::Init(int rank, int size, const std::string& addr, int port,
     if (!secret.empty()) {
       // Verify the coordinator knows the job secret BEFORE trusting any
       // negotiation state from it, then prove our own rank claim.
+      // RecvAllBy: absolute deadline — a byte-dribbling squatter on the
+      // coordinator port must not hold this rank past the bootstrap
+      // deadline (mirror of the coordinator-side hardening).
       uint8_t reply[64];
-      if (!RecvAll(fd, reply, 64)) {
+      if (!RecvAllBy(fd, reply, 64, std::chrono::time_point_cast<
+              std::chrono::steady_clock::duration>(deadline))) {
         *err = "coordinator closed during authentication (secret key "
                "mismatch, or the coordinator does not authenticate?)";
         ::close(fd);
@@ -511,7 +515,8 @@ bool SocketComm::Init(int rank, int size, const std::string& addr, int port,
     // (auth-policy mismatch, wrong key, duplicate rank) learns at init()
     // time instead of failing later with an unrelated negotiation error.
     uint8_t verdict = 0;
-    if (!RecvAll(fd, &verdict, 1) || verdict != 1) {
+    if (!RecvAllBy(fd, &verdict, 1, std::chrono::time_point_cast<
+            std::chrono::steady_clock::duration>(deadline)) || verdict != 1) {
       *err = secret.empty()
                  ? "coordinator rejected this connection (does the job "
                    "require HOROVOD_SECRET_KEY?)"

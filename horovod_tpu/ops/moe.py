@@ -1,0 +1,140 @@
+"""Expert-parallel mixture-of-experts dispatch (Switch-style top-1 with
+capacity factor).
+
+The reference framework has no MoE (this is a beyond-reference extension,
+like ring attention); the design follows the Switch-Transformer /
+Mesh-TensorFlow dispatch discipline re-thought for XLA static shapes:
+
+* **Route**: top-1 expert per token from a softmax router (f32 for the
+  argmax/gate numerics).
+* **Capacity**: each expert accepts at most ``cap = ceil(capacity_factor
+  * T / E)`` tokens; a token's slot is its running position within its
+  expert (cumsum over the static token order), tokens past the capacity
+  are DROPPED (their gate is zeroed, so only the residual passes — the
+  standard Switch training behavior).  Static shapes throughout: the
+  dispatch buffer is ``(E, cap, D)`` with one scratch slot that dropped
+  tokens scatter into.
+* **Exchange**: under ``shard_map`` with an ``ep`` axis bound, the
+  dispatch buffer ``(E, cap, D) = (ep, E_local, cap, D)`` rides ONE
+  ``lax.all_to_all`` so each device receives exactly the tokens routed
+  to its RESIDENT experts (and only those); expert FFNs run as one
+  batched einsum over the local expert axis (MXU-friendly); a reverse
+  ``all_to_all`` returns expert outputs to the token owners.  Compute
+  per device is ``T_local * FFN`` — flat in E — unlike dense dispatch's
+  ``E * T * FFN``, and the ``ep`` axis now shards COMPUTE, not just
+  storage.
+* **Combine**: gather each token's slot from the returned buffer and
+  scale by its gate probability.
+
+Gradients flow through the scatter/gather and both all_to_alls (their
+VJPs are the transpose gather/scatter and the reverse all_to_all), so
+``jax.grad`` of a loss through :func:`switch_moe` is exact — verified
+against the dense-dispatch oracle in ``tests/test_moe.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def capacity(T: int, n_experts: int, capacity_factor: float) -> int:
+    """Per-expert token capacity: ``ceil(cf * T / E)`` clamped to [1, T]."""
+    cap = int(np.ceil(capacity_factor * T / n_experts))
+    return max(1, min(cap, T))
+
+
+def switch_moe(
+    x,
+    router,
+    w_gate,
+    w_up,
+    w_down,
+    *,
+    capacity_factor: float = 2.0,
+    axis_name: Optional[str] = None,
+    return_aux: bool = False,
+):
+    """Top-1 expert-parallel MoE FFN.
+
+    Args:
+      x: ``(..., D)`` tokens (leading dims flattened internally).
+      router: ``(D, E)`` router weights, REPLICATED (E = global experts).
+      w_gate, w_up: ``(E_local, D, F)`` — this device's resident experts
+        (the global stack sharded over ``axis_name``; pass the full
+        ``(E, D, F)`` stack when ``axis_name`` is None).
+      w_down: ``(E_local, F, D)``.
+      capacity_factor: per-expert capacity multiplier (see module doc).
+      axis_name: the ``ep`` mesh axis bound by ``shard_map``, or None for
+        single-device dispatch (still sparse: each token computes ONE
+        expert's FFN).
+      return_aux: also return the Switch load-balancing auxiliary loss
+        ``E * sum_e fraction_e * mean_prob_e`` (1.0 at perfect balance).
+
+    Returns:
+      ``y`` shaped like ``x`` (add it to the residual stream), or
+      ``(y, aux_loss)`` with ``return_aux``.
+    """
+    lead, D = x.shape[:-1], x.shape[-1]
+    xt = x.reshape(-1, D)
+    T = xt.shape[0]
+    ep = lax.axis_size(axis_name) if axis_name is not None else 1
+    E_loc = w_gate.shape[0]
+    E = E_loc * ep
+    dt = x.dtype
+
+    logits = xt.astype(jnp.float32) @ router.astype(jnp.float32)  # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    e_star = jnp.argmax(probs, axis=-1)  # (T,)
+    gate = jnp.max(probs, axis=-1)  # (T,)
+    onehot = jax.nn.one_hot(e_star, E, dtype=jnp.float32)
+
+    cap = capacity(T, E, capacity_factor)
+    # Position of each token within its expert's arrivals (static order).
+    pos = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1).astype(jnp.int32) - 1
+    keep = pos < cap
+    gate = jnp.where(keep, gate, 0.0)
+    slot = jnp.where(keep, pos, cap)  # dropped tokens -> scratch slot
+
+    # Scatter tokens into the (E, cap, D) dispatch buffer (+1 scratch).
+    buf = jnp.zeros((E, cap + 1, D), dt).at[e_star, slot].set(xt)
+    buf = buf[:, :cap]
+
+    if ep > 1:
+        # (ep * E_loc, cap, D): chunk e goes to device e // E_loc.  After
+        # the exchange, block i holds source i's tokens for MY experts.
+        recv = lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0,
+                              tiled=True)
+        toks = (recv.reshape(ep, E_loc, cap, D)
+                .transpose(1, 0, 2, 3)
+                .reshape(E_loc, ep * cap, D))
+    else:
+        toks = buf  # (E, cap, D)
+
+    # Resident experts only: one batched einsum over the local expert
+    # axis — (E_loc, tokens, D) x (E_loc, D, F) on the MXU.
+    g = jnp.einsum("ecd,edf->ecf", toks, w_gate.astype(dt))
+    u = jnp.einsum("ecd,edf->ecf", toks, w_up.astype(dt))
+    out = jnp.einsum("ecf,efd->ecd", jax.nn.silu(g) * u, w_down.astype(dt))
+
+    if ep > 1:
+        # Reverse exchange: piece j = outputs for source j's tokens;
+        # the concat arrives back in GLOBAL expert-major order.
+        out = (out.reshape(E_loc, ep, cap, D)
+               .transpose(1, 0, 2, 3)
+               .reshape(E, cap, D))
+        out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                             tiled=True)
+
+    y = out[e_star, jnp.minimum(slot, cap - 1)]  # (T, D); dropped gate=0
+    y = (y * gate[:, None].astype(dt)).reshape(*lead, D)
+    if not return_aux:
+        return y
+    frac = onehot.mean(axis=0)  # routed fraction per expert (pre-drop)
+    pbar = probs.mean(axis=0)
+    aux = E * jnp.sum(frac * pbar)
+    return y, aux
