@@ -23,6 +23,10 @@ def main() -> None:
     ap.add_argument("--gen", type=int, default=16, help="tokens to generate")
     ap.add_argument("--temperature", type=float, default=0.8)
     ap.add_argument("--top-k", type=int, default=8)
+    ap.add_argument("--mesh", default="", metavar="tpN",
+                    help="serve tp-sharded over an N-device tensor-parallel"
+                         " mesh (e.g. tp2) and verify token-identity with"
+                         " single-chip decode")
     args = ap.parse_args()
 
     import horovod_tpu as hvd
@@ -61,6 +65,36 @@ def main() -> None:
                               temperature=args.temperature,
                               top_k=args.top_k)
     print("sampled:", np.asarray(sampled)[0].tolist())
+
+    if args.mesh:
+        # tp-sharded serving: params sharded per serving_param_specs
+        # (heads/ffn/vocab over tp, training-only axes replicated), KV
+        # cache head-sharded per cache_specs; must be token-identical to
+        # the single-chip decode above.
+        from jax.sharding import Mesh
+        try:
+            tp = int(args.mesh.removeprefix("tp"))
+        except ValueError:
+            tp = 0
+        if not args.mesh.startswith("tp") or tp < 1:
+            raise SystemExit(f"--mesh must look like tp2, got {args.mesh!r}")
+        if len(jax.devices()) < tp:
+            raise SystemExit(
+                f"--mesh {args.mesh} needs {tp} devices, have "
+                f"{len(jax.devices())} (hint: JAX_PLATFORMS=cpu "
+                f"XLA_FLAGS=--xla_force_host_platform_device_count={tp})")
+        mesh = Mesh(np.array(jax.devices()[:tp]), axis_names=("tp",))
+        param_sh, cache_sh = T.serving_shardings(mesh, cfg)
+        params_tp = jax.device_put(params, param_sh)
+        greedy_tp = jax.jit(
+            lambda p, t: T.greedy_decode(p, t, args.gen, cfg,
+                                         cache_shardings=cache_sh)
+        )(params_tp, prompt)
+        same = bool((np.asarray(greedy_tp) == np.asarray(greedy)).all())
+        print(f"tp{tp}   :", np.asarray(greedy_tp)[0].tolist())
+        print(f"tp{tp} decode token-identical to single-chip: {same}")
+        if not same:
+            raise SystemExit(1)
     hvd.shutdown()
 
 

@@ -301,8 +301,13 @@ def _moe_mlp(x, p, cfg: TransformerConfig, impl: Optional[str] = None):
 
 
 def _mlp_block(x, p, cfg: TransformerConfig, moe_impl: Optional[str] = None):
-    """Residual MLP half of a layer (shared by forward, the pipeline, and
-    the decode step so the three can never drift apart)."""
+    """Residual MLP half of a layer, shared by forward, the pipeline, and
+    the decode step.  Dense MLPs are bit-identical across all three; MoE
+    decode/prefill force dense dispatch, so forward-vs-decode equivalence
+    holds exactly when switch dispatch drops no tokens (capacity_factor
+    >= n_experts guarantees that) and diverges by the dropped tokens'
+    contributions otherwise — capacity drops are a training-time
+    behavior, not part of the serving contract."""
     m = _rmsnorm(x, p["ln2"])
     if cfg.n_experts > 1:
         return x + _moe_mlp(m, p, cfg, impl=moe_impl)
@@ -351,6 +356,45 @@ def loss_fn(params: Dict, batch: Dict, cfg: TransformerConfig):
 
 
 # --- autoregressive decoding (KV cache) ---------------------------------------
+
+
+def serving_shardings(mesh, cfg: TransformerConfig):
+    """``(param_shardings, cache_shardings)`` as ``NamedSharding`` trees
+    for a tp serving mesh — the one-call recipe for
+    :func:`sample_decode`'s ``cache_shardings`` plus the ``device_put``
+    placement of restored params (see docs/inference.md)."""
+    from jax.sharding import NamedSharding
+
+    param_sh = jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), serving_param_specs(cfg),
+        is_leaf=lambda x: isinstance(x, P))
+    cache_sh = {k: NamedSharding(mesh, s) for k, s in cache_specs().items()}
+    return param_sh, cache_sh
+
+
+def serving_param_specs(cfg: TransformerConfig, axes=("tp",)) -> Dict:
+    """:func:`param_specs` restricted to the mesh axes available at
+    SERVING time (default a tp-only mesh): any training-only axis (pp,
+    fsdp, ep, ...) is replicated, so a model trained with tp>1 restores
+    onto a tp serving mesh without resharding logic — heads/ffn/vocab
+    stay sharded, everything else replicates."""
+    def keep(spec):
+        return P(*[a if a in axes else None for a in spec])
+
+    return jax.tree_util.tree_map(
+        keep, param_specs(cfg), is_leaf=lambda x: isinstance(x, P))
+
+
+def cache_specs() -> Dict:
+    """KV-cache shardings for tp serving: the cache's kv-head dim shards
+    over ``tp`` (cache layout ``(L, B, H_kv, T, Dh)``), matching the
+    head-sharded K/V projections so no resharding happens on the decode
+    hot path.  Requires ``cfg.kv_heads % tp == 0``."""
+    return {
+        "k": P(None, None, "tp", None, None),
+        "v": P(None, None, "tp", None, None),
+        "pos": P(),
+    }
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int = 0) -> Dict:
@@ -488,16 +532,28 @@ def prefill(params: Dict, prompt, cache: Dict, cfg: TransformerConfig):
 
 
 def sample_decode(params: Dict, prompt, steps: int, cfg: TransformerConfig,
-                  *, rng, temperature: float = 1.0, top_k: int = 0):
+                  *, rng, temperature: float = 1.0, top_k: int = 0,
+                  cache_shardings: Optional[Dict] = None):
     """Extend a (B, S0) prompt by ``steps`` SAMPLED tokens -> (B, steps).
 
     One batched :func:`prefill` forward fills the cache, then ``steps``
     compiled :func:`decode_step` calls generate.  ``temperature`` scales
     the logits; ``top_k > 0`` restricts sampling to the k most likely
     tokens (clamped to the vocabulary).  ``temperature=0`` is greedy
-    (:func:`greedy_decode` is exactly that case)."""
+    (:func:`greedy_decode` is exactly that case).
+
+    ``cache_shardings``: optional dict of ``NamedSharding`` matching
+    :func:`cache_specs` — pins the KV cache's head dim over a ``tp``
+    serving mesh so a model trained with tp>1 serves tp-sharded (the
+    scan carry keeps the constraint for every decode step; GSPMD
+    partitions the attention/FFN math and inserts the tp collectives)."""
     B, S0 = prompt.shape
     cache = init_cache(cfg, B, S0 + steps)
+    if cache_shardings is not None:
+        cache = {
+            k: lax.with_sharding_constraint(v, cache_shardings[k])
+            for k, v in cache.items()
+        }
     logits, cache = prefill(params, prompt, cache, cfg)
 
     def pick(logits, key):
@@ -521,10 +577,12 @@ def sample_decode(params: Dict, prompt, steps: int, cfg: TransformerConfig,
     return jnp.moveaxis(toks, 0, 1)
 
 
-def greedy_decode(params: Dict, prompt, steps: int, cfg: TransformerConfig):
+def greedy_decode(params: Dict, prompt, steps: int, cfg: TransformerConfig,
+                  *, cache_shardings: Optional[Dict] = None):
     """Extend a (B, S0) prompt by ``steps`` greedy tokens -> (B, steps)."""
     return sample_decode(params, prompt, steps, cfg,
-                         rng=jax.random.PRNGKey(0), temperature=0.0)
+                         rng=jax.random.PRNGKey(0), temperature=0.0,
+                         cache_shardings=cache_shardings)
 
 
 # --- true pipeline parallelism ------------------------------------------------

@@ -85,6 +85,11 @@ def switch_moe(
     ep = lax.axis_size(axis_name) if axis_name is not None else 1
     E_loc = w_gate.shape[0]
     E = E_loc * ep
+    if router.shape[1] != E:
+        raise ValueError(
+            f"router routes over {router.shape[1]} experts but the expert "
+            f"stack provides {E_loc} local x {ep} devices = {E} "
+            "(sharded weights outside shard_map, or axis_name missing?)")
     dt = x.dtype
 
     logits = xt.astype(jnp.float32) @ router.astype(jnp.float32)  # (T, E)

@@ -143,7 +143,12 @@ class TestDecode:
         assert int(cache["pos"]) == 10
 
     def test_decode_moe(self):
-        cfg = self._cfg(n_experts=2)
+        # capacity_factor >= n_experts makes switch dispatch dropless, so
+        # forward (switch) vs decode (forced dense) teacher-forcing
+        # equivalence holds EXACTLY — the documented serving contract
+        # (_mlp_block docstring); with drops they legitimately diverge
+        # (tests/test_moe.py covers that case).
+        cfg = self._cfg(n_experts=2, capacity_factor=2.0)
         params = T.init_params(jax.random.PRNGKey(0), cfg)
         tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 6), 0, 64)
         full = T.forward(params, tokens, cfg)
@@ -191,6 +196,32 @@ class TestDecode:
             np.testing.assert_allclose(
                 np.asarray(logits), np.asarray(full[:, t]),
                 atol=2e-4, rtol=2e-4)
+
+    def test_tp_sharded_decode_token_identical(self):
+        """tp-sharded serving (params per serving_param_specs, KV cache
+        head-sharded per cache_specs) must produce token-identical greedy
+        output to single-chip decode, and the compiled step must actually
+        shard the math (tp collectives in the HLO) — so a model that
+        needed tp>1 to train can be served by this framework."""
+        from jax.sharding import Mesh
+
+        cfg = self._cfg(n_kv_heads=2)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 4), 0, 64)
+        steps = 5
+        ref = T.greedy_decode(params, prompt, steps, cfg)
+
+        tp = 2
+        mesh = Mesh(np.array(jax.devices()[:tp]), axis_names=("tp",))
+        param_sh, cache_sh = T.serving_shardings(mesh, cfg)
+        params_tp = jax.device_put(params, param_sh)
+        fn = jax.jit(lambda p, t: T.greedy_decode(
+            p, t, steps, cfg, cache_shardings=cache_sh))
+        hlo = fn.lower(params_tp, prompt).compile().as_text()
+        assert "all-reduce" in hlo or "all-gather" in hlo, (
+            "tp decode must emit tp collectives")
+        out = fn(params_tp, prompt)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
     def test_prefill_requires_fresh_cache(self):
         cfg = self._cfg()

@@ -229,6 +229,28 @@ class TestModelSwitchMoE:
         np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_d),
                                    atol=1e-4, rtol=1e-4)
 
+    def test_forward_with_drops_diverges_from_dense_but_stays_finite(self):
+        """When capacity drops DO occur (biased router, tight capacity),
+        switch forward legitimately diverges from the dense oracle (the
+        dropped tokens' MLP contributions are gone) but must stay finite
+        — the documented training-time behavior."""
+        import dataclasses
+
+        T, cfg = self._cfg(capacity_factor=0.5)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        # Bias every layer's router hard toward expert 0 -> guaranteed
+        # overflow at cf=0.5.
+        L, D, E = params["layers"]["router"].shape
+        params["layers"]["router"] = (
+            jnp.zeros((L, D, E)).at[:, :, 0].set(10.0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+        out_s = T.forward(params, tokens, cfg)
+        out_d = T.forward(params, tokens,
+                          dataclasses.replace(cfg, moe_impl="dense"))
+        assert np.isfinite(np.asarray(out_s)).all()
+        assert not np.allclose(np.asarray(out_s), np.asarray(out_d),
+                               atol=1e-4), "drops must be observable"
+
     def test_bad_impl_raises(self):
         T, cfg = self._cfg(moe_impl="bogus")
         params = T.init_params(jax.random.PRNGKey(0), cfg)
