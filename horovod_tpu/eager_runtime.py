@@ -72,6 +72,11 @@ class EagerRuntime:
         self._counters = {k: itertools.count() for k in
                           ("allreduce", "allgather", "broadcast", "alltoall",
                            "reducescatter", "barrier")}
+        # Fusion observability (reference timeline's per-response grouping,
+        # as cheap counters): responses executed vs tensors they carried —
+        # tensors/responses is the achieved fusion ratio.
+        self.responses_executed = 0
+        self.tensors_executed = 0
         rt.set_executor(self._execute)
 
     # ---- naming (reference: "allreduce.noname.N" convention in the torch
@@ -147,6 +152,8 @@ class EagerRuntime:
         from horovod_tpu.ops import collectives as C
 
         _, to_op = _op_maps()
+        self.responses_executed += 1
+        self.tensors_executed += len(resp.tensor_names)
         try:
             with self._lock:
                 inputs = []
