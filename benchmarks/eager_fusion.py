@@ -220,9 +220,18 @@ def spawn(mode: str, args) -> dict:
     out_dir = os.path.join(args.output_dir, mode)
     # Workers inherit the driver's full environment (XLA/thread config
     # materially changes CPU collective throughput) with the per-mode
-    # knobs overriding.
+    # knobs overriding.  Only --xla_force_host_platform_device_count is
+    # stripped from XLA_FLAGS: the test harness exports it (8 virtual
+    # chips), which would silently change both the semantics
+    # (chip-weighted local_size) and the timings being compared; other
+    # user XLA flags stay in force.
+    xla_flags = " ".join(
+        f for f in os.environ.get("XLA_FLAGS", "").split()
+        if not f.startswith("--xla_force_host_platform_device_count"))
     env = {
         **os.environ,
+        "XLA_FLAGS": xla_flags,
+        "JAX_PLATFORMS": "cpu",
         "PYTHONPATH": REPO,
         "PALLAS_AXON_POOL_IPS": "",
         "HOROVOD_NUM_PROC": str(args.nproc),

@@ -94,8 +94,16 @@ class DataLoader:
 
         def put(b):
             start = b * self.batch_size
-            rows = idx[start:start + self.batch_size]
-            batch = {k: v[rows] for k, v in self.arrays.items()}
+            if not self.shuffle:
+                # Indices are arange by construction: slice VIEW instead
+                # of a fancy-index copy — device_put stages straight from
+                # the original buffer (measurably faster for large
+                # batches).
+                batch = {k: v[start:start + self.batch_size]
+                         for k, v in self.arrays.items()}
+            else:
+                rows = idx[start:start + self.batch_size]
+                batch = {k: v[rows] for k, v in self.arrays.items()}
             if self.sharding is not None:
                 return {k: jax.device_put(v, self.sharding)
                         for k, v in batch.items()}
