@@ -164,13 +164,32 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
     def __init__(
         self,
         warmup_epochs: int = 5,
-        momentum_correction: bool = True,  # accepted for API parity; no-op
+        momentum_correction: Optional[bool] = None,
         steps_per_epoch: Optional[int] = None,
         verbose: int = 0,
         initial_lr: Optional[float] = None,
     ) -> None:
         self.warmup_epochs = warmup_epochs
         self.verbose = verbose
+        # momentum_correction is unnecessary on the optax path (the LR
+        # multiplies the update AFTER the momentum trace, so mid-schedule
+        # LR changes don't bake into the buffer the way torch/TF1-style
+        # formulations do) and this framework-neutral callback has no
+        # optimizer handle to rescale anyway.  The default (None) is
+        # therefore a silent no-op; a caller who EXPLICITLY requests the
+        # reference behavior gets told where it actually lives instead of
+        # a silent drop.
+        if momentum_correction:
+            import warnings
+
+            warnings.warn(
+                "momentum_correction is not applied by the framework-"
+                "neutral LearningRateWarmupCallback (optax optimizers "
+                "don't need it: lr scales the post-momentum update). "
+                "For Keras optimizers use horovod_tpu.keras."
+                "LearningRateWarmupCallback, which rescales the momentum "
+                "variable like the reference.",
+                stacklevel=2)
         mult = lambda epoch: 1.0 / basics.size() * (
             epoch * (basics.size() - 1) / warmup_epochs + 1
         )
