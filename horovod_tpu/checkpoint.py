@@ -25,6 +25,7 @@ import os
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from horovod_tpu import basics
@@ -49,16 +50,45 @@ def save(path: str, tree: Any, *, force: bool = True) -> None:
         ckptr.save(path, jax.device_get(tree), force=force)
 
 
+def _abstract_or_host(t):
+    """jax.Array template leaves become abstract targets carrying their
+    SHARDING, so orbax places restored shards directly on the right
+    devices (no whole-tree bounce through one device — a tp/fsdp model
+    bigger than one chip restores sharded); other leaves restore as host
+    arrays."""
+    if isinstance(t, jax.Array):
+        return jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=t.sharding)
+    return t
+
+
+def _to_jax(x):
+    """Host-restored leaves become jax.Arrays (numpy cannot be indexed by
+    traced values — a restored embedding table would break the first
+    jitted ``embed[tokens]``) — EXCEPT when conversion would change the
+    dtype (64-bit leaves with jax_enable_x64 off keep their numpy form
+    and full precision, the pre-r4 behavior)."""
+    if isinstance(x, jax.Array):
+        return x
+    a = jnp.asarray(x)
+    return a if a.dtype == np.asarray(x).dtype else x
+
+
 def restore(path: str, template: Any, *, root_rank: int = 0,
             broadcast: bool = True) -> Any:
     """Load a checkpoint and (optionally) broadcast it so every process
     resumes from identical state (the reference's restored-state
-    broadcast)."""
+    broadcast).
+
+    Array leaves come back as ``jax.Array``s placed per the TEMPLATE's
+    shardings (pass a tree of sharded arrays — or ``device_put`` the
+    result — for multi-chip serving placement, docs/inference.md)."""
     path = os.path.abspath(path)
     if basics.num_processes() == 1:
         ocp = _ocp()
         with ocp.StandardCheckpointer() as ckptr:
-            return ckptr.restore(path, jax.device_get(template))
+            tree = ckptr.restore(
+                path, jax.tree_util.tree_map(_abstract_or_host, template))
+        return jax.tree_util.tree_map(_to_jax, tree)
     if basics.process_rank() == root_rank:
         ocp = _ocp()
         with ocp.StandardCheckpointer() as ckptr:
@@ -67,7 +97,7 @@ def restore(path: str, template: Any, *, root_rank: int = 0,
         tree = template
     if broadcast:
         tree = S.broadcast_parameters(tree, root_rank)
-    return tree
+    return jax.tree_util.tree_map(_to_jax, tree)
 
 
 class CheckpointManager:
