@@ -5,14 +5,16 @@ virtual device mesh:
 
 * the transformer's layer stack split into ``pp`` pipeline stages,
   scheduled with the memory-bounded **1F1B** schedule
-  (``horovod_tpu.parallel.pipeline_value_and_grad(schedule="1f1b")``);
+  (``transformer.pipelined_value_and_grad(..., schedule="1f1b")`` —
+  EVERY parameter trains: embedding and head gradients flow through the
+  schedule's input cotangents and loss-param accumulators);
 * **switch-MoE** FFNs inside every block (sparse capacity-factor
   dispatch — each token computes one expert).
 
 Run on CPU with virtual devices:
 
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
-        python examples/pipeline_moe_lm.py [--steps 20]
+        python examples/pipeline_moe_lm.py [--steps 30]
 """
 
 from __future__ import annotations
@@ -28,14 +30,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def main() -> None:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--steps", type=int, default=30)
     ap.add_argument("--pp", type=int, default=4, help="pipeline stages")
     ap.add_argument("--microbatches", type=int, default=8)
     args = ap.parse_args()
 
     import horovod_tpu as hvd
     from horovod_tpu.models import transformer as T
-    from horovod_tpu.parallel import pipeline
 
     hvd.init()
     pp = args.pp
@@ -49,80 +50,28 @@ def main() -> None:
         max_seq=16, dtype=jnp.float32, n_experts=4, capacity_factor=2.0)
     params = T.init_params(jax.random.PRNGKey(0), cfg)
 
-    # Count-mod-32 task; M microbatches of (mb, S).
+    # Count-mod-32 task.
     M, mb = args.microbatches, 2
     base = np.arange(M * mb * cfg.max_seq).reshape(M * mb, cfg.max_seq) % 32
-    tokens = jnp.asarray(base, jnp.int32)
-    targets = jnp.asarray((base + 1) % 32, jnp.int32)
+    batch = {"tokens": jnp.asarray(base, jnp.int32),
+             "targets": jnp.asarray((base + 1) % 32, jnp.int32)}
 
     mesh = Mesh(np.array(jax.devices()[:pp]), axis_names=("pp",))
     opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
 
-    def stage_fn_maker(cfg):
-        def stage_fn(stage_layers, x):
-            def body(h, lp):
-                return T._layer_body(h, lp, cfg), None
-
-            out, _ = jax.lax.scan(body, x, stage_layers)
-            return out
-
-        return stage_fn
-
-    def train_step(params, opt_state, tokens, targets):
-        """shard_map body: embed, run the 1F1B pipeline over the layer
-        stack, and apply the head inside the last stage's loss."""
-
-        def inner(params, tokens, targets):
-            x = params["embed"].astype(cfg.dtype)[tokens]
-            xs = x.reshape(M, mb, cfg.max_seq, cfg.d_model)
-            ts = targets.reshape(M, mb, cfg.max_seq)
-            s = jax.lax.axis_index("pp")
-            per_stage = cfg.n_layers // pp
-            my_layers = jax.tree_util.tree_map(
-                lambda l: jax.lax.dynamic_slice_in_dim(
-                    l, s * per_stage, per_stage, 0),
-                params["layers"])
-
-            def loss_fn(y, tgt):
-                h = T._rmsnorm(y, params["ln_f"])
-                logits = jnp.einsum(
-                    "bsd,dv->bsv", h,
-                    params["head"].astype(cfg.dtype)).astype(jnp.float32)
-                logz = jax.scipy.special.logsumexp(logits, axis=-1)
-                gold = jnp.take_along_axis(
-                    logits, tgt[..., None], axis=-1).squeeze(-1)
-                return jnp.sum(logz - gold) / (M * mb * cfg.max_seq)
-
-            loss, stage_grads = pipeline.pipeline_value_and_grad(
-                stage_fn_maker(cfg), my_layers, xs, ts, loss_fn,
-                axis_name="pp", schedule="1f1b")
-            # Reassemble the full layer-stack gradient from the per-stage
-            # pieces (each stage holds grads for ITS slice; psum of the
-            # padded pieces concatenates them), so a plain optimizer step
-            # applies everywhere identically.  Embedding/head grads flow
-            # only through stage boundaries in this demo and are left to
-            # the stage grads — fine for a pipeline showcase.
-            def expand(g):
-                full = jnp.zeros((pp,) + g.shape, g.dtype)
-                full = full.at[s].set(g)
-                full = jax.lax.psum(full, "pp")
-                return full.reshape((cfg.n_layers,) + g.shape[1:])
-
-            layer_grads = jax.tree_util.tree_map(expand, stage_grads)
-            return loss, layer_grads
-
-        loss, layer_grads = jax.shard_map(
-            inner, mesh=mesh, in_specs=(P(), P(), P()),
-            out_specs=(P(), P()))(params, tokens, targets)
-        grads = jax.tree_util.tree_map(jnp.zeros_like, params)
-        grads = {**grads, "layers": layer_grads}
+    @jax.jit
+    def step(params, opt_state):
+        loss, grads = jax.shard_map(
+            lambda pr, b: T.pipelined_value_and_grad(
+                pr, b, cfg, schedule="1f1b", n_microbatches=M),
+            mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+        )(params, batch)
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    opt_state = opt.init(params)
-    step = jax.jit(train_step)
     for i in range(args.steps):
-        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        params, opt_state, loss = step(params, opt_state)
         if i % 5 == 0 or i == args.steps - 1:
             print(f"step {i:3d}  loss {float(loss):.4f}")
     print(f"1F1B pipeline (pp={pp}) + switch-MoE (E={cfg.n_experts}) "

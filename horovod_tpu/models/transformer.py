@@ -329,6 +329,23 @@ def _remat(layer, cfg: TransformerConfig):
                      "expected 'full' or 'dots'")
 
 
+def _lm_head(y, ln_f, head, cfg: TransformerConfig):
+    """Final RMSNorm + vocabulary projection (f32 logits) — the ONE copy
+    shared by forward, decode/prefill, and both pipeline schedules."""
+    h = _rmsnorm(y, ln_f)
+    return jnp.einsum("bsd,dv->bsv", h, head.astype(cfg.dtype)).astype(
+        jnp.float32)
+
+
+def _xent_sum(logits, targets):
+    """SUM of next-token cross-entropy over all positions (divide by the
+    token count for a mean) — shared by loss_fn and the pipelines."""
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1).squeeze(-1)
+    return jnp.sum(logz - gold)
+
+
 def forward(params: Dict, tokens, cfg: TransformerConfig):
     """Logits for next-token prediction.  ``tokens``: (B, S) int32."""
     x = params["embed"].astype(cfg.dtype)[tokens]
@@ -339,20 +356,13 @@ def forward(params: Dict, tokens, cfg: TransformerConfig):
     if cfg.remat:
         layer = _remat(layer, cfg)
     x, _ = lax.scan(layer, x, params["layers"])
-    x = _rmsnorm(x, params["ln_f"])
-    return jnp.einsum("bsd,dv->bsv", x, params["head"].astype(cfg.dtype)).astype(
-        jnp.float32
-    )
+    return _lm_head(x, params["ln_f"], params["head"], cfg)
 
 
 def loss_fn(params: Dict, batch: Dict, cfg: TransformerConfig):
     """Mean next-token cross-entropy.  ``batch = {tokens, targets}``."""
     logits = forward(params, batch["tokens"], cfg)
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(
-        logits, batch["targets"][..., None], axis=-1
-    ).squeeze(-1)
-    return jnp.mean(logz - gold)
+    return _xent_sum(logits, batch["targets"]) / batch["targets"].size
 
 
 # --- autoregressive decoding (KV cache) ---------------------------------------
@@ -469,9 +479,7 @@ def decode_step(params: Dict, tokens_t, cache: Dict, cfg: TransformerConfig):
 
     x, (k_all, v_all) = lax.scan(
         layer, x, (params["layers"], cache["k"], cache["v"]))
-    x = _rmsnorm(x, params["ln_f"])
-    logits = jnp.einsum(
-        "bsd,dv->bsv", x, params["head"].astype(cfg.dtype)).astype(jnp.float32)
+    logits = _lm_head(x, params["ln_f"], params["head"], cfg)
     return logits[:, 0], {"k": k_all, "v": v_all, "pos": pos + 1}
 
 
@@ -518,9 +526,7 @@ def prefill(params: Dict, prompt, cache: Dict, cfg: TransformerConfig):
     x, (k_all, v_all) = lax.scan(layer, x, params["layers"])
     # Only the last position's logits are needed: slice BEFORE the
     # (B, S0, V) head projection.
-    x = _rmsnorm(x[:, -1:], params["ln_f"])
-    logits = jnp.einsum(
-        "bsd,dv->bsv", x, params["head"].astype(cfg.dtype)).astype(jnp.float32)
+    logits = _lm_head(x[:, -1:], params["ln_f"], params["head"], cfg)
     cache = {
         "k": lax.dynamic_update_slice_in_dim(
             cache["k"], k_all.astype(cache["k"].dtype), 0, axis=3),
@@ -603,19 +609,30 @@ def pipelined_forward(params: Dict, tokens, cfg: TransformerConfig, *,
     """
     from horovod_tpu.parallel import pipeline as _pl
 
+    B = tokens.shape[0]
+    M, my_layers, stage_fn = _pipeline_stage_setup(
+        params, cfg, axis_name, B, n_microbatches)
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    mb = x.reshape(M, B // M, *x.shape[1:])
+    out = _pl.pipeline_apply(stage_fn, my_layers, mb, axis_name=axis_name)
+    x = out.reshape(B, *x.shape[1:])
+    return _lm_head(x, params["ln_f"], params["head"], cfg)
+
+
+def _pipeline_stage_setup(params: Dict, cfg: TransformerConfig,
+                          axis_name: str, batch: int,
+                          n_microbatches: Optional[int]):
+    """Shared pipeline plumbing (both schedules): divisibility checks,
+    this stage's layer slice, and the scanned stage function."""
     P_ = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name)
     if cfg.n_layers % P_:
         raise ValueError(
             f"n_layers={cfg.n_layers} must divide over {P_} pipeline stages")
     per_stage = cfg.n_layers // P_
-    B = tokens.shape[0]
     M = n_microbatches or P_
-    if B % M:
-        raise ValueError(f"batch {B} must divide into {M} microbatches")
-
-    x = params["embed"].astype(cfg.dtype)[tokens]
-    mb = x.reshape(M, B // M, *x.shape[1:])
+    if batch % M:
+        raise ValueError(f"batch {batch} must divide into {M} microbatches")
     my_layers = jax.tree_util.tree_map(
         lambda l: lax.dynamic_slice_in_dim(l, s * per_stage, per_stage, 0),
         params["layers"])
@@ -630,45 +647,102 @@ def pipelined_forward(params: Dict, tokens, cfg: TransformerConfig, *,
         out, _ = lax.scan(layer, xb, lp_stack)
         return out
 
-    out = _pl.pipeline_apply(stage_fn, my_layers, mb, axis_name=axis_name)
-    x = out.reshape(B, *x.shape[1:])
-    x = _rmsnorm(x, params["ln_f"])
-    return jnp.einsum("bsd,dv->bsv", x, params["head"].astype(cfg.dtype)).astype(
-        jnp.float32
-    )
+    return M, my_layers, stage_fn
 
 
 def pipelined_value_and_grad(params: Dict, batch: Dict,
                              cfg: TransformerConfig, *,
                              axis_name: str = "pp",
-                             n_microbatches: Optional[int] = None):
+                             n_microbatches: Optional[int] = None,
+                             schedule: str = "gpipe"):
     """Loss + EXACT full-parameter gradients of the pipelined model —
     call inside ``shard_map`` with params/batch replicated over the axis.
 
-    Gradient accounting, by construction rather than correction: the
-    scalar loss is computed as ``psum(where(stage == last, raw, 0))``, so
-    the backward cotangent is nonzero only on the last stage for the
-    head/ln_f path, only on stage 0 for the embedding path, and only on
-    the owning stage for each layer (dynamic_slice VJP) — the psum that
-    shard_map's transpose applies to each replicated parameter therefore
-    sums one real contribution with zeros, giving gradients identical to
-    ``jax.grad(loss_fn)`` with no replication factors to divide out.
-    Verified in ``tests/test_pipeline.py``.
+    ``schedule="gpipe"``: gradient accounting by construction rather than
+    correction — the scalar loss is computed as ``psum(where(stage ==
+    last, raw, 0))``, so the backward cotangent is nonzero only on the
+    last stage for the head/ln_f path, only on stage 0 for the embedding
+    path, and only on the owning stage for each layer (dynamic_slice
+    VJP) — the psum that shard_map's transpose applies to each replicated
+    parameter therefore sums one real contribution with zeros, giving
+    gradients identical to ``jax.grad(loss_fn)`` with no replication
+    factors to divide out.
+
+    ``schedule="1f1b"``: the memory-bounded interleaved schedule
+    (:func:`horovod_tpu.parallel.pipeline_value_and_grad`) with the SAME
+    full-parameter gradient contract: stage grads reassemble into the
+    layer stack, the loss's head/ln_f grads come back via
+    ``loss_params``, and the embedding grads via the returned input
+    cotangents scattered through the token lookup.  Both verified
+    leaf-for-leaf against ``jax.grad(loss_fn)`` in
+    ``tests/test_pipeline.py``.
     """
     P_ = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name)
 
-    def _loss(p):
-        logits = pipelined_forward(p, batch["tokens"], cfg,
-                                   axis_name=axis_name,
-                                   n_microbatches=n_microbatches)
-        logz = jax.scipy.special.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(
-            logits, batch["targets"][..., None], axis=-1).squeeze(-1)
-        raw = jnp.mean(logz - gold)
-        return lax.psum(jnp.where(s == P_ - 1, raw, 0.0), axis_name)
+    if schedule == "gpipe":
+        def _loss(p):
+            logits = pipelined_forward(p, batch["tokens"], cfg,
+                                       axis_name=axis_name,
+                                       n_microbatches=n_microbatches)
+            raw = _xent_sum(logits, batch["targets"]) / batch["targets"].size
+            return lax.psum(jnp.where(s == P_ - 1, raw, 0.0), axis_name)
 
-    return jax.value_and_grad(_loss)(params)
+        return jax.value_and_grad(_loss)(params)
+    if schedule != "1f1b":
+        raise ValueError(f"unknown pipeline schedule {schedule!r}")
+
+    from horovod_tpu.parallel import pipeline as _pl
+
+    tokens, targets = batch["tokens"], batch["targets"]
+    B, S = tokens.shape
+    M, my_layers, stage_fn = _pipeline_stage_setup(
+        params, cfg, axis_name, B, n_microbatches)
+    per_stage = cfg.n_layers // P_
+    n_tok = B * S
+
+    x = params["embed"].astype(cfg.dtype)[tokens]
+    xs = x.reshape(M, B // M, S, cfg.d_model)
+    ts = targets.reshape(M, B // M, S)
+
+    def loss_fn(lp, y, tgt):
+        logits = _lm_head(y, lp["ln_f"], lp["head"], cfg)
+        return _xent_sum(logits, tgt) / n_tok  # microbatch losses sum to mean
+
+    loss, stage_grads, extras = _pl.pipeline_value_and_grad(
+        stage_fn, my_layers, xs, ts, loss_fn, axis_name=axis_name,
+        schedule="1f1b",
+        loss_params={"ln_f": params["ln_f"], "head": params["head"]},
+        return_input_grads=True)
+
+    # Reassemble the full layer-stack gradient: each stage owns its slice
+    # (zeros elsewhere), so writing it at the stage offset and psumming
+    # concatenates.
+    def expand(g):
+        full = jnp.zeros((cfg.n_layers,) + g.shape[1:], g.dtype)
+        full = lax.dynamic_update_slice_in_dim(full, g, s * per_stage, 0)
+        return lax.psum(full, axis_name)
+
+    layer_grads = jax.tree_util.tree_map(expand, stage_grads)
+    # Loss-param grads live on the last stage (zero elsewhere): psum.
+    lp_grads = jax.tree_util.tree_map(
+        lambda g: lax.psum(g, axis_name), extras["loss_param_grads"])
+    # Embedding grad: input cotangents live on stage 0 (zero elsewhere);
+    # psum, then scatter-add through the token lookup's VJP.
+    gx = lax.psum(extras["input_grads"], axis_name)  # (M, mb, S, D)
+    embed_grad = (
+        jnp.zeros(params["embed"].shape, cfg.dtype)
+        .at[tokens.reshape(-1)]
+        .add(gx.reshape(n_tok, cfg.d_model))
+    ).astype(params["embed"].dtype)
+
+    grads = {
+        "embed": embed_grad,
+        "layers": layer_grads,
+        "ln_f": lp_grads["ln_f"],
+        "head": lp_grads["head"],
+    }
+    return loss, grads
 
 
 def synthetic_batch(rng, cfg: TransformerConfig, batch: int, seq: Optional[int] = None):

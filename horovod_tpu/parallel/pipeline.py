@@ -90,7 +90,9 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
 def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
                             targets, loss_fn: Callable, *,
                             axis_name: str = "pp",
-                            schedule: str = "gpipe"):
+                            schedule: str = "gpipe",
+                            loss_params=None,
+                            return_input_grads: bool = False):
     """Microbatched pipeline training step: total loss and THIS stage's
     parameter gradients.
 
@@ -104,11 +106,23 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
         loss is the SUM over microbatches (scale inside ``loss_fn`` for a
         mean).
       schedule: ``"gpipe"`` or ``"1f1b"``.
+      loss_params: optional pytree of parameters the LOSS uses (readout
+        head, final norm, ...).  When given, ``loss_fn`` is called as
+        ``loss_fn(loss_params, y, target)`` and its parameter gradients
+        are returned — accumulated at the last stage and ZERO on other
+        stages (``psum`` over the axis outside, or rely on shard_map's
+        replicated-output transpose, to get the true gradient).
+      return_input_grads: also return ``d loss / d microbatches``
+        (``(M, mb, ...)``), accumulated at stage 0 and zero elsewhere —
+        what an embedding layer upstream of the pipeline backprops
+        through.
 
     Returns:
       ``(loss, stage_grads)`` — loss replicated over the axis,
       ``stage_grads`` matching ``stage_params`` (per-stage, i.e. still
-      pp-sharded from the caller's viewpoint).
+      pp-sharded from the caller's viewpoint).  With ``loss_params`` /
+      ``return_input_grads``, ``(loss, stage_grads, extras)`` where
+      ``extras`` holds ``loss_param_grads`` and/or ``input_grads``.
 
     Schedules:
 
@@ -135,14 +149,53 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
     s = lax.axis_index(axis_name)
     M = microbatches.shape[0]
 
-    if schedule == "gpipe":
-        def total_loss(params):
-            outs = pipeline_apply(stage_fn, params, microbatches,
-                                  axis_name=axis_name)
-            losses = jax.vmap(loss_fn)(outs, targets)
-            return jnp.sum(losses)
+    has_lp = loss_params is not None
+    if has_lp:
+        # Make loss_params VARYING over the axis before any
+        # differentiation: the VJP of a replicated (unvarying) operand
+        # inside shard_map carries an implicit psum over the axis, which
+        # would sum every stage's loss gradient — including the garbage
+        # gradients non-last stages compute from their intermediate
+        # activations.  As varying values each stage's gradient stays
+        # LOCAL, and the last-stage gating keeps exactly the real one
+        # (psum outside to collect it).
+        loss_params = jax.tree_util.tree_map(
+            lambda a: a + (s * 0).astype(a.dtype), loss_params)
+    if return_input_grads:
+        # Same reasoning for d loss / d microbatches.
+        microbatches = microbatches + (s * 0).astype(microbatches.dtype)
 
-        return jax.value_and_grad(total_loss)(stage_params)
+    def _apply_loss(lp, y, tgt):
+        return loss_fn(lp, y, tgt) if has_lp else loss_fn(y, tgt)
+
+    if schedule == "gpipe":
+        def total_loss(params, lp, mbs):
+            outs = pipeline_apply(stage_fn, params, mbs,
+                                  axis_name=axis_name)
+            losses = jax.vmap(lambda y, t: _apply_loss(lp, y, t))(
+                outs, targets)
+            # Gate the (replicated) loss to the last stage and psum: the
+            # value is unchanged, but the backward cotangent is nonzero
+            # only there — so loss_param grads land on the last stage and
+            # input grads on stage 0, zero elsewhere: the SAME ownership
+            # contract the 1f1b schedule produces (and the construction
+            # the model-level pipelined_value_and_grad documents).
+            raw = jnp.sum(losses)
+            return lax.psum(jnp.where(s == P - 1, raw, 0.0), axis_name)
+
+        argnums = [0] + ([1] if has_lp else []) + (
+            [2] if return_input_grads else [])
+        loss, grads = jax.value_and_grad(total_loss, argnums=tuple(argnums))(
+            stage_params, loss_params, microbatches)
+        if not has_lp and not return_input_grads:
+            return loss, grads[0]
+        extras = {}
+        rest = list(grads[1:])
+        if has_lp:
+            extras["loss_param_grads"] = rest.pop(0)
+        if return_input_grads:
+            extras["input_grads"] = rest.pop(0)
+        return loss, grads[0], extras
     if schedule != "1f1b":
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
 
@@ -157,7 +210,7 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
     is_last = s == P - 1
 
     def tick(carry, t):
-        fwd_in, bwd_in, xbuf, gacc, lacc = carry
+        fwd_in, bwd_in, xbuf, gacc, lacc, lpacc, xgacc = carry
 
         # ---- forward wave: F(s, m) at tick t = s + m -------------------
         m_f = t - s
@@ -179,7 +232,16 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
         y_b, pull = jax.vjp(stage_fn, stage_params, x_b)
         tgt = lax.dynamic_index_in_dim(
             targets, jnp.clip(m_b, 0, M - 1), keepdims=False)
-        loss_b, gy_loss = jax.value_and_grad(loss_fn)(y_b, tgt)
+        if has_lp:
+            loss_b, (glp, gy_loss) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(loss_params, y_b, tgt)
+            lp_mask = b_valid & is_last
+            glp = jax.tree_util.tree_map(
+                lambda g: jnp.where(lp_mask, g, jnp.zeros_like(g)), glp)
+            lpacc = jax.tree_util.tree_map(
+                lambda a, g: a + g, lpacc, glp)
+        else:
+            loss_b, gy_loss = jax.value_and_grad(loss_fn)(y_b, tgt)
         # Cotangent source: the last stage seeds from its own loss; other
         # stages consume what their right neighbour emitted last tick.
         gy = jnp.where(b_valid, jnp.where(is_last, gy_loss, bwd_in),
@@ -194,10 +256,18 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
         gx = jnp.where(b_valid, gx, jnp.zeros_like(gx))
         gacc = jax.tree_util.tree_map(lambda a, g: a + g, gacc, gparams)
         lacc = lacc + jnp.where(b_valid & is_last, loss_b, 0.0)
+        if return_input_grads:
+            # Stage 0's gx IS d loss / d microbatch m_b; other stages
+            # write zeros (and invalid ticks land in the scratch slot).
+            xg_slot = jnp.where(b_valid & (s == 0),
+                                jnp.clip(m_b, 0, M - 1), M)
+            xgacc = lax.dynamic_update_index_in_dim(
+                xgacc, jnp.where(s == 0, gx, jnp.zeros_like(gx)),
+                xg_slot, axis=0)
 
         return (lax.ppermute(y, axis_name, right),
                 lax.ppermute(gx, axis_name, left),
-                xbuf, gacc, lacc), None
+                xbuf, gacc, lacc, lpacc, xgacc), None
 
     # Device-varying zeros (see pipeline_apply): every carry leaf becomes
     # varying-over-pp inside the scan (permuted wires, per-stage grads),
@@ -211,11 +281,24 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
     gacc0 = jax.tree_util.tree_map(
         lambda p: vzeros(p.shape, p.dtype), stage_params)
     lacc0 = vzeros((), jnp.float32)
+    lpacc0 = jax.tree_util.tree_map(
+        lambda p: vzeros(p.shape, p.dtype), loss_params) if has_lp else 0.0
+    xgacc0 = (vzeros((M + 1,) + mb_shape, dtype)
+              if return_input_grads else 0.0)
 
-    (_, _, _, gacc, lacc), _ = lax.scan(
-        tick, (fwd0, bwd0, xbuf0, gacc0, lacc0), jnp.arange(T))
+    (_, _, _, gacc, lacc, lpacc, xgacc), _ = lax.scan(
+        tick, (fwd0, bwd0, xbuf0, gacc0, lacc0, lpacc0, xgacc0),
+        jnp.arange(T))
     # Only stage P-1 accumulated loss; psum broadcasts it to the axis.
-    return lax.psum(lacc, axis_name), gacc
+    loss = lax.psum(lacc, axis_name)
+    if not has_lp and not return_input_grads:
+        return loss, gacc
+    extras = {}
+    if has_lp:
+        extras["loss_param_grads"] = lpacc
+    if return_input_grads:
+        extras["input_grads"] = xgacc[:M]
+    return loss, gacc, extras
 
 
 def stack_to_stages(stacked, n_stages: int):

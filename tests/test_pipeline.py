@@ -141,6 +141,73 @@ class TestPipeline1F1B:
         np.testing.assert_allclose(loss_1, loss_g, rtol=1e-5)
         np.testing.assert_allclose(g_1, g_g, atol=1e-4, rtol=1e-4)
 
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    @pytest.mark.parametrize("with_lp,with_xg", [
+        (True, True), (True, False), (False, True)])
+    def test_loss_params_and_input_grads_exact(self, schedule, with_lp,
+                                               with_xg):
+        """loss_params (readout head) gradients and input cotangents from
+        BOTH schedules must match direct autodiff — including the VMA
+        subtlety that the VJP of a replicated operand inside shard_map
+        implicitly psums over the axis (regression for the bug where
+        non-last stages' garbage loss grads leaked into the sum)."""
+        p, layers, m, mb, d = 4, 8, 6, 2, 8
+        w_all = jax.random.normal(jax.random.PRNGKey(0), (layers, d, d)) * 0.3
+        x = jax.random.normal(jax.random.PRNGKey(1), (m, mb, d))
+        tgt = jax.random.normal(jax.random.PRNGKey(2), (m, mb, d)) * 0.1
+        head = jax.random.normal(jax.random.PRNGKey(3), (d, d)) * 0.5
+
+        def lfn_lp(lp, y, t):
+            return jnp.sum((y @ lp["head"] - t) ** 2)
+
+        def lfn_plain(y, t):
+            return lfn_lp({"head": head}, y, t)
+
+        def ref():
+            def loss(w_all, head, x):
+                outs = jax.vmap(lambda xb: _sequential(w_all, xb))(x)
+                return jnp.sum(jax.vmap(
+                    lambda y, t: lfn_lp({"head": head}, y, t))(outs, tgt))
+
+            return jax.value_and_grad(loss, argnums=(0, 1, 2))(w_all, head, x)
+
+        staged = pipeline.stack_to_stages(w_all, p)
+        mesh = _mesh(p)
+
+        def inner(wst, xs, ts, lp):
+            loss, g, ex = pipeline.pipeline_value_and_grad(
+                _stage_fn, wst[0], xs, ts,
+                lfn_lp if with_lp else lfn_plain, axis_name="pp",
+                schedule=schedule,
+                loss_params=lp if with_lp else None,
+                return_input_grads=with_xg)
+            lpg = (jax.tree_util.tree_map(
+                lambda a: jax.lax.psum(a, "pp"), ex["loss_param_grads"])
+                if with_lp else {"head": jnp.zeros_like(lp["head"])})
+            xg = (jax.lax.psum(ex["input_grads"], "pp")
+                  if with_xg else jnp.zeros_like(xs))
+            assert set(ex) == ({"loss_param_grads"} if with_lp else set()) | (
+                {"input_grads"} if with_xg else set())
+            return loss, g[None], lpg, xg
+
+        loss, g, lpg, xg = jax.jit(jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P("pp"), P(), P(), P()),
+            out_specs=(P(), P("pp"), P(), P())))(staged, x, tgt,
+                                                 {"head": head})
+        l_ref, (gw_ref, gh_ref, gx_ref) = ref()
+        np.testing.assert_allclose(float(loss), float(l_ref), rtol=1e-5)
+        if with_lp:
+            np.testing.assert_allclose(
+                np.asarray(lpg["head"]), np.asarray(gh_ref),
+                atol=1e-5, rtol=1e-5)
+        if with_xg:
+            np.testing.assert_allclose(np.asarray(xg), np.asarray(gx_ref),
+                                       atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(g).reshape(w_all.shape), np.asarray(gw_ref),
+            atol=1e-5, rtol=1e-5)
+
     def test_unknown_schedule_raises(self):
         mesh = _mesh(2)
         w = jnp.zeros((2, 1, 4, 4))
@@ -221,9 +288,11 @@ class TestPipelinedTransformerAPI:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-4, rtol=1e-4)
 
-    def test_value_and_grad_exact(self):
+    @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    def test_value_and_grad_exact(self, schedule):
         """The pipelined loss AND every parameter gradient — embedding,
-        per-layer, final norm, head — must equal jax.grad(loss_fn)."""
+        per-layer, final norm, head — must equal jax.grad(loss_fn), for
+        BOTH schedules."""
         p = 4
         T, cfg, params, batch = self._setup(p)
         l_ref, g_ref = jax.value_and_grad(
@@ -231,7 +300,8 @@ class TestPipelinedTransformerAPI:
         mesh = _mesh(p)
 
         l_pipe, g_pipe = jax.jit(jax.shard_map(
-            lambda pr, b: T.pipelined_value_and_grad(pr, b, cfg),
+            lambda pr, b: T.pipelined_value_and_grad(
+                pr, b, cfg, schedule=schedule),
             mesh=mesh, in_specs=(P(), P()), out_specs=P(),
         ))(params, batch)
         np.testing.assert_allclose(float(l_pipe), float(l_ref), atol=1e-5)
