@@ -269,3 +269,10 @@ class TestTorchMultiProcess:
     def test_adasum_delta_four_process(self, tmp_path):
         """Same at 4 ranks: two VHDD rounds exercise the recursion."""
         self._spawn(tmp_path, "adasum", 4)
+
+    def test_adasum_delta_three_process(self, tmp_path):
+        """Non-power-of-2 rank count: the eager Adasum falls back to
+        gather + the serial pairwise oracle (the reference ERRORS here —
+        adasum_mpi.cc:52-67; we degrade gracefully instead), and the
+        delta optimizer must still match adasum_reduce_stack exactly."""
+        self._spawn(tmp_path, "adasum", 3)

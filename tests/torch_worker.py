@@ -27,7 +27,9 @@ def scenario_adasum():
     """Delta-model Adasum optimizer vs the pairwise oracle (reference
     test_adasum_* structure): local SGD update, Adasum-combined parameter
     delta, verified against adasum_reduce_stack of the gathered per-rank
-    deltas.  Runs at any power-of-two nproc (spawned at 2 and 4)."""
+    deltas.  Runs at ANY nproc (spawned at 2, 3 and 4): power-of-two
+    counts run the distributed VHDD rounds, others exercise the
+    gather + serial-oracle fallback."""
     from horovod_tpu.ops import adasum as AD
 
     torch.manual_seed(0)
