@@ -1,0 +1,60 @@
+"""Subprocess body for the pp x sp x ep triple-composition test
+(tests/test_pipeline.py::TestPipelineTripleComposition): 1F1B pipeline
+over pp, ring attention over sp, expert-parallel switch-MoE over ep, one
+shard_map — loss and every gradient exact vs the unsharded reference.
+Shares the ep shard/unshard helpers and the gradient-tree assertion with
+test_pipeline.py (one source of truth for the gradient contract)."""
+
+import dataclasses
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+sys.path.insert(0, _here)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.models import transformer as T
+from test_pipeline import (
+    _assert_grad_trees_match,
+    _ep_shard_params,
+    _ep_unshard_grads,
+)
+
+pp, sp, ep = 2, 2, 2
+cfg = T.TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+    max_seq=16, dtype=jnp.float32, n_experts=4, capacity_factor=4.0,
+    moe_impl="switch", moe_axis="ep", attention_impl="ring", n_kv_heads=2)
+cfg_ref = dataclasses.replace(cfg, moe_axis=None,
+                              attention_impl="reference")
+params = T.init_params(jax.random.PRNGKey(0), cfg)
+batch = T.synthetic_batch(0, cfg, batch=4)
+l_ref, g_ref = jax.value_and_grad(
+    lambda p: T.loss_fn(p, batch, cfg_ref))(params)
+
+mesh = Mesh(np.array(jax.devices()).reshape(pp, sp, ep),
+            axis_names=("pp", "sp", "ep"))
+
+
+def inner(pr, b):
+    pr_sh = _ep_shard_params(pr, cfg.n_experts, ep)
+    loss, grads = T.pipelined_value_and_grad(
+        pr_sh, b, cfg, axis_name="pp", schedule="1f1b")
+    grads = _ep_unshard_grads(grads, cfg.n_experts, ep)
+    loss = lax.pmean(loss, ("sp", "ep"))
+    grads = jax.tree_util.tree_map(lambda x: lax.pmean(x, "sp"), grads)
+    return loss, grads
+
+
+l, g = jax.jit(jax.shard_map(
+    inner, mesh=mesh, in_specs=(P(), P("ep", "sp")), out_specs=(P(), P()),
+    check_vma=False))(params, batch)
+np.testing.assert_allclose(float(l), float(l_ref), atol=1e-5)
+_assert_grad_trees_match(g, g_ref)
+print("TRIPLE-COMPOSITION-OK")
