@@ -1,0 +1,89 @@
+"""Subprocess body for the pipeline composition tests
+(tests/test_pipeline.py): argv[1] selects the mesh —
+
+  sp      (pp, sp):     1F1B x ring-attention sequence parallelism
+  ep      (pp, ep):     1F1B x expert-parallel switch-MoE
+  triple  (pp, sp, ep): all three in one shard_map
+
+Each asserts loss and EVERY parameter gradient exact vs the unsharded
+single-device reference.  Run in subprocesses because the XLA CPU
+runtime's collective rendezvous accumulates state across distinct
+multi-axis meshes in one process and aborts (each composition passes
+standalone).  Shares the ep shard/unshard helpers and the gradient-tree
+assertion with test_pipeline.py (one source of truth)."""
+
+import dataclasses
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_here))
+sys.path.insert(0, _here)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.models import transformer as T
+from test_pipeline import (
+    _assert_grad_trees_match,
+    _ep_shard_params,
+    _ep_unshard_grads,
+)
+
+MODE = sys.argv[1] if len(sys.argv) > 1 else "triple"
+
+if MODE == "sp":
+    pp, sp, ep = 2, 4, 1
+    axes, shape = ("pp", "sp"), (2, 4)
+    batch_spec = P(None, "sp")  # sequence sharded over sp
+elif MODE == "ep":
+    pp, sp, ep = 2, 1, 4
+    axes, shape = ("pp", "ep"), (2, 4)
+    batch_spec = P("ep")  # batch sharded over ep (dp-style)
+elif MODE == "triple":
+    pp, sp, ep = 2, 2, 2
+    axes, shape = ("pp", "sp", "ep"), (2, 2, 2)
+    batch_spec = P("ep", "sp")
+else:
+    raise SystemExit(f"unknown mode {MODE!r}")
+
+n_experts = 4 * (ep > 1)
+cfg = T.TransformerConfig(
+    vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
+    max_seq=16 if sp == 1 else 8 * sp, dtype=jnp.float32,
+    n_experts=n_experts, capacity_factor=float(max(n_experts, 1)),
+    moe_impl="switch", moe_axis="ep" if ep > 1 else None,
+    attention_impl="ring" if sp > 1 else "reference", n_kv_heads=2)
+cfg_ref = dataclasses.replace(cfg, moe_axis=None,
+                              attention_impl="reference")
+params = T.init_params(jax.random.PRNGKey(0), cfg)
+batch = T.synthetic_batch(0, cfg, batch=4 if ep == 1 else 8 // sp)
+l_ref, g_ref = jax.value_and_grad(
+    lambda p: T.loss_fn(p, batch, cfg_ref))(params)
+
+mesh = Mesh(np.array(jax.devices()).reshape(shape), axis_names=axes)
+
+
+def inner(pr, b):
+    pr_sh = _ep_shard_params(pr, cfg.n_experts, ep) if ep > 1 else pr
+    loss, grads = T.pipelined_value_and_grad(
+        pr_sh, b, cfg, axis_name="pp", schedule="1f1b")
+    if ep > 1:
+        grads = _ep_unshard_grads(grads, cfg.n_experts, ep)
+    data_axes = tuple(a for a in ("sp", "ep") if a in axes)
+    loss = lax.pmean(loss, data_axes)
+    if "sp" in axes:
+        grads = jax.tree_util.tree_map(
+            lambda x: lax.pmean(x, "sp"), grads)
+    return loss, grads
+
+
+l, g = jax.jit(jax.shard_map(
+    inner, mesh=mesh, in_specs=(P(), batch_spec), out_specs=(P(), P()),
+    check_vma=False))(params, batch)
+np.testing.assert_allclose(float(l), float(l_ref), atol=1e-5)
+_assert_grad_trees_match(g, g_ref)
+print(f"COMPOSITION-{MODE.upper()}-OK")

@@ -357,123 +357,52 @@ class TestPipelinedTransformerAPI:
         _assert_grad_trees_match(g_pipe, g_ref)
 
 
-class TestPipelineTimesSequenceParallel:
+def _run_composition_worker(mode: str):
+    """Spawn tests/composition_worker.py in a SUBPROCESS: the XLA CPU
+    runtime's collective rendezvous accumulates state across the several
+    distinct multi-axis meshes a full-suite process builds and aborts
+    (each composition passes standalone in its own process — a backend
+    limitation, not a framework one).  The worker shares the ep
+    shard/unshard helpers and gradient assertions with this module."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {
+        **os.environ,
+        "PYTHONPATH": repo,
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    }
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "tests", "composition_worker.py"), mode],
+        env=env, capture_output=True, text=True, timeout=500)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert f"COMPOSITION-{mode.upper()}-OK" in out.stdout, out.stdout
+
+
+class TestPipelineCompositions:
+    """1F1B composed with the other parallelism axes, each loss- and
+    gradient-exact vs the unsharded single-device reference model (see
+    composition_worker.py for the mesh arrangements)."""
+
     def test_1f1b_ring_attention_pp_x_sp_exact(self):
-        """COMPOSITION: 1F1B pipeline over pp x ring-attention sequence
-        parallelism over sp, in one shard_map — loss and every parameter
-        gradient exact vs the unsharded reference model.  The sequence is
-        sharded over sp (ring K/V shards ppermute within each pipeline
-        stage) while microbatch activations ppermute over pp.  Uses the
-        FULL device set: the XLA CPU runtime's collective rendezvous
-        miscounts participants on subset meshes."""
-        import dataclasses
+        """(pp, sp): ring K/V shards ppermute over sp within each
+        pipeline stage while microbatch activations ppermute over pp."""
+        _run_composition_worker("sp")
 
-        from horovod_tpu.models import transformer as T
-
-        pp, sp = 2, 4
-        cfg = T.TransformerConfig(
-            vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
-            max_seq=16, dtype=jnp.float32, attention_impl="ring",
-            n_kv_heads=2)
-        cfg_ref = dataclasses.replace(cfg, attention_impl="reference")
-        params = T.init_params(jax.random.PRNGKey(0), cfg)
-        batch = T.synthetic_batch(0, cfg, batch=4)
-        l_ref, g_ref = jax.value_and_grad(
-            lambda p: T.loss_fn(p, batch, cfg_ref))(params)
-
-        mesh = Mesh(np.array(jax.devices()).reshape(pp, sp),
-                    axis_names=("pp", "sp"))
-
-        def inner(pr, b):
-            loss, grads = T.pipelined_value_and_grad(
-                pr, b, cfg, axis_name="pp", schedule="1f1b")
-            # per-shard loss is the mean over LOCAL tokens; equal shards
-            # make the global mean/grads the pmean over sp
-            loss = jax.lax.pmean(loss, "sp")
-            grads = jax.tree_util.tree_map(
-                lambda g: jax.lax.pmean(g, "sp"), grads)
-            return loss, grads
-
-        l, g = jax.jit(jax.shard_map(
-            inner, mesh=mesh, in_specs=(P(), P(None, "sp")),
-            out_specs=(P(), P()),
-            check_vma=False,  # Pallas CPU interpreter vs varying operands
-        ))(params, batch)
-        np.testing.assert_allclose(float(l), float(l_ref), atol=1e-5)
-        _assert_grad_trees_match(g, g_ref)
-
-
-class TestPipelineTimesExpertParallel:
     def test_1f1b_switch_moe_pp_x_ep_exact(self):
-        """COMPOSITION: 1F1B pipeline over pp x expert-parallel switch-MoE
-        over ep (which shards BOTH the batch, dp-style, and the experts —
-        each device dispatches ITS tokens to resident experts via the
-        all_to_all inside every stage).  Loss and every gradient exact vs
-        the single-device dropless oracle."""
-        import dataclasses
+        """(pp, ep): ep shards BOTH the batch (dp-style) and the experts
+        — each device dispatches ITS tokens to resident experts via the
+        all_to_all inside every stage."""
+        _run_composition_worker("ep")
 
-        from horovod_tpu.models import transformer as T
-
-        pp, ep = 2, 4
-        cfg = T.TransformerConfig(
-            vocab_size=64, d_model=32, n_heads=4, n_layers=4, d_ff=64,
-            max_seq=16, dtype=jnp.float32, n_experts=8,
-            capacity_factor=8.0,  # dropless -> exactness is well-defined
-            moe_impl="switch", moe_axis="ep", attention_impl="reference")
-        cfg_ref = dataclasses.replace(cfg, moe_axis=None)
-        params = T.init_params(jax.random.PRNGKey(0), cfg)
-        batch = T.synthetic_batch(0, cfg, batch=8)
-        l_ref, g_ref = jax.value_and_grad(
-            lambda p: T.loss_fn(p, batch, cfg_ref))(params)
-
-        mesh = Mesh(np.array(jax.devices()).reshape(pp, ep),
-                    axis_names=("pp", "ep"))
-
-        def inner(pr, b):
-            pr_sh = _ep_shard_params(pr, cfg.n_experts, ep)
-            loss, grads = T.pipelined_value_and_grad(
-                pr_sh, b, cfg, axis_name="pp", schedule="1f1b")
-            grads = _ep_unshard_grads(grads, cfg.n_experts, ep)
-            return jax.lax.pmean(loss, "ep"), grads
-
-        l, g = jax.jit(jax.shard_map(
-            inner, mesh=mesh, in_specs=(P(), P("ep")),
-            out_specs=(P(), P()), check_vma=False))(params, batch)
-        np.testing.assert_allclose(float(l), float(l_ref), atol=1e-5)
-        _assert_grad_trees_match(g, g_ref)
-
-
-class TestPipelineTripleComposition:
     def test_1f1b_ring_moe_pp_x_sp_x_ep_exact(self):
-        """TRIPLE composition on a (pp, sp, ep) mesh: 1F1B pipeline over
-        pp, ring-attention sequence parallelism over sp, and
-        expert-parallel switch-MoE over ep (ep doubling as the batch
-        axis) — one shard_map, loss and every parameter gradient exact
-        vs the unsharded single-device reference.
-
-        Runs in a SUBPROCESS: the XLA CPU runtime's collective
-        rendezvous accumulates state across the several distinct
-        multi-axis meshes this suite builds and aborts on the third
-        (passes standalone) — a backend limitation, not a framework
-        one."""
-        import os
-        import subprocess
-        import sys
-
-        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        env = {
-            **os.environ,
-            "PYTHONPATH": repo,
-            "PALLAS_AXON_POOL_IPS": "",
-            "JAX_PLATFORMS": "cpu",
-            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-        }
-        out = subprocess.run(
-            [sys.executable,
-             os.path.join(repo, "tests", "triple_composition_worker.py")],
-            env=env, capture_output=True, text=True, timeout=500)
-        assert out.returncode == 0, out.stdout + out.stderr
-        assert "TRIPLE-COMPOSITION-OK" in out.stdout, out.stdout
+        """(pp, sp, ep): all three in one shard_map."""
+        _run_composition_worker("triple")
 
 
 class TestPipelineTransformerStage:
