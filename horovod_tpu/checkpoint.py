@@ -38,14 +38,45 @@ def _ocp():
     return ocp
 
 
+def _spans_processes(tree: Any) -> bool:
+    """True when any leaf is a global jax.Array whose shards live on more
+    than one process — the pod/GSPMD regime where every process must
+    participate in the (collaborative) orbax write."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return True
+        if isinstance(leaf, jax.ShapeDtypeStruct):
+            sh = getattr(leaf, "sharding", None)
+            if sh is not None and len(sh.device_set) > len(
+                list(sh.addressable_devices)
+            ):
+                return True
+    return False
+
+
 def save(path: str, tree: Any, *, force: bool = True) -> None:
-    """Write a pytree checkpoint (rank-0 convention: in multi-process runs
-    only rank 0's data is authoritative — replicas are identical by the
-    DistributedOptimizer contract, so any single writer suffices)."""
-    if basics.num_processes() > 1 and basics.process_rank() != 0:
-        return
-    ocp = _ocp()
+    """Write a pytree checkpoint.
+
+    Two regimes (SURVEY.md §5.4):
+
+    * **replicated/eager** — rank 0's data is authoritative (replicas are
+      identical by the DistributedOptimizer contract), so only rank 0
+      writes and other ranks return immediately.
+    * **global GSPMD arrays** (any leaf spans processes) — EVERY process
+      calls into orbax: each writes the shards it addresses and orbax's
+      multihost barrier finalizes the checkpoint on the primary.  This is
+      the pod save path: a tp/fsdp-sharded model larger than one host
+      checkpoints without ever being gathered.
+    """
     path = os.path.abspath(path)
+    if _spans_processes(tree):
+        ocp = _ocp()
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(path, tree, force=force)
+        return
+    if basics.num_processes() > 1 and basics.process_rank() != 0:
+        return  # non-writers never touch orbax
+    ocp = _ocp()
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(path, jax.device_get(tree), force=force)
 
@@ -83,7 +114,11 @@ def restore(path: str, template: Any, *, root_rank: int = 0,
     shardings (pass a tree of sharded arrays — or ``device_put`` the
     result — for multi-chip serving placement, docs/inference.md)."""
     path = os.path.abspath(path)
-    if basics.num_processes() == 1:
+    if basics.num_processes() == 1 or _spans_processes(template):
+        # Single-controller, or pod-mode GSPMD template: every process
+        # restores collaboratively — orbax places each shard directly on
+        # the devices named by the template's shardings (no broadcast;
+        # the shardings ARE the distribution).
         ocp = _ocp()
         with ocp.StandardCheckpointer() as ckptr:
             tree = ckptr.restore(

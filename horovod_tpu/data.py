@@ -14,7 +14,14 @@ epoch.  This module provides that contract TPU-first:
   host→HBM copy (the usual TPU input-pipeline win); a small deque keeps
   ``prefetch`` transfers in flight;
 * optional **sharding placement** so multi-chip runs commit each batch
-  directly to its mesh sharding instead of chip 0.
+  directly to its mesh sharding instead of chip 0;
+* **global-array feeding** — when the target sharding spans multiple
+  processes (a pod run: one process per host over a global mesh), each
+  process loads only ITS batch rows and the loader assembles them into
+  one global ``jax.Array`` via ``jax.make_array_from_process_local_data``
+  — the multi-host input contract of a compiled GSPMD step (the pod
+  analogue of the reference's per-rank ``shard`` + framework loader,
+  e.g. ``spark/keras/remote.py`` make_batch_reader sharding).
 """
 
 from __future__ import annotations
@@ -44,7 +51,15 @@ class DataLoader:
         yielded, and the count is the min over all ranks' shards.
       prefetch: how many batches to keep in flight on device.
       sharding: optional ``jax.sharding.Sharding`` the batches are
-        committed to (e.g. ``NamedSharding(mesh, P(hvd.AXIS))``).
+        committed to (e.g. ``NamedSharding(mesh, P(hvd.AXIS))``).  When
+        the sharding spans multiple PROCESSES, ``batch_size`` is the
+        GLOBAL batch size: every process draws the same shuffled index
+        stream (same seed — no per-rank fold), materializes only the
+        rows its devices own, and yields global arrays assembled with
+        ``jax.make_array_from_process_local_data``.  Only the leading
+        (batch) dimension may be partitioned across processes; inner
+        dims may still be sharded across the devices WITHIN a process
+        (e.g. sp over local chips).
     """
 
     def __init__(self, arrays: Dict[str, np.ndarray], batch_size: int, *,
@@ -62,7 +77,19 @@ class DataLoader:
         self.sharding = sharding
         self._epoch = 0
 
-        if shard and basics.is_initialized() and basics.num_processes() > 1:
+        self._global = (
+            sharding is not None
+            and len(sharding.device_set)
+            > len(list(sharding.addressable_devices))
+        )
+        if self._global:
+            # Pod mode: the permutation is process-independent (all ranks
+            # see the same global index stream) and sharding is decided by
+            # the SHARDING's row ownership, not rank round-robin.
+            self.arrays = dict(arrays)
+            self._len = self.n_total // self.batch_size
+            self._local_rows = self._addressable_rows()
+        elif shard and basics.is_initialized() and basics.num_processes() > 1:
             r, p = basics.process_rank(), basics.num_processes()
             self.arrays = {k: v[r::p] for k, v in arrays.items()}
             # lockstep: every rank yields the same number of batches —
@@ -79,11 +106,42 @@ class DataLoader:
     def __len__(self) -> int:
         return self._len
 
+    def _addressable_rows(self) -> np.ndarray:
+        """Positions WITHIN a global batch (dim 0) owned by this process's
+        devices under ``self.sharding``.  Validates the pod-mode contract:
+        only the leading batch dim may be partitioned across processes
+        (inner dims may still shard over the devices inside a process)."""
+        rows = None
+        for k, v in self.arrays.items():
+            shape = (self.batch_size,) + v.shape[1:]
+            imap = self.sharding.addressable_devices_indices_map(shape)
+            dim_sets = [set() for _ in shape]
+            for idx in imap.values():
+                for d, sl in enumerate(idx):
+                    start, stop, _ = sl.indices(shape[d])
+                    dim_sets[d].update(range(start, stop))
+            for d in range(1, len(shape)):
+                if len(dim_sets[d]) != shape[d]:
+                    raise ValueError(
+                        "global DataLoader: only the leading batch dim may "
+                        f"be sharded across processes (dim {d} of {k!r} is "
+                        "process-partitioned)")
+            r = np.array(sorted(dim_sets[0]), dtype=np.int64)
+            if rows is None:
+                rows = r
+            elif not np.array_equal(rows, r):
+                raise ValueError(
+                    "arrays disagree on per-process row ownership")
+        return rows
+
     def _epoch_indices(self) -> np.ndarray:
         n = len(next(iter(self.arrays.values())))
         if not self.shuffle:
             return np.arange(n)
-        rank = basics.process_rank() if basics.is_initialized() else 0
+        # Pod mode: every process must draw the SAME permutation — each
+        # materializes a different slice of the same global batch.
+        rank = (basics.process_rank()
+                if basics.is_initialized() and not self._global else 0)
         rng = np.random.RandomState(
             ((self.seed * 1000003 + self._epoch) ^ rank) % (2 ** 32))
         return rng.permutation(n)
@@ -92,7 +150,20 @@ class DataLoader:
         idx = self._epoch_indices()
         self._epoch += 1
 
+        def put_global(b):
+            start = b * self.batch_size
+            rows_g = idx[start:start + self.batch_size]
+            sel = rows_g[self._local_rows]
+            out = {}
+            for k, v in self.arrays.items():
+                out[k] = jax.make_array_from_process_local_data(
+                    self.sharding, np.ascontiguousarray(v[sel]),
+                    (self.batch_size,) + v.shape[1:])
+            return out
+
         def put(b):
+            if self._global:
+                return put_global(b)
             start = b * self.batch_size
             if not self.shuffle:
                 # Indices are arange by construction: slice VIEW instead

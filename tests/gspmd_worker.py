@@ -1,0 +1,162 @@
+"""Multi-process COMPILED GSPMD worker — the pod deployment shape.
+
+The reference's single product is N processes training synchronously (one
+process per slot, ``run/gloo_run.py`` launch contract; every reference
+test body runs under a 2-process launcher, SURVEY.md §4).  On a TPU pod
+the equivalent shape is one process per HOST over a GLOBAL mesh: the
+compiled GSPMD train step runs SPMD across all processes, input batches
+are global ``jax.Array``s assembled from process-local shards, and
+checkpoints are written collaboratively (each process writes the shards
+it owns).
+
+This worker runs that full lifecycle on N launcher-spawned processes of
+``GSPMD_LOCAL_DEVICES`` virtual CPU devices each:
+
+  1. ``hvd.init()`` → ``jax.distributed.initialize`` via the launcher env;
+  2. global (dp×tp) mesh over all processes' devices;
+  3. flagship Transformer + ``spmd.make_gspmd_train_step``;
+  4. per-process input shards fed through ``DataLoader``'s global-array
+     mode (``jax.make_array_from_process_local_data``);
+  5. multihost orbax save at step 2, collaborative sharded restore,
+     resume — replayed losses must be bit-identical;
+  6. prints per-step loss/param-checksum BITS so the spawning test can
+     compare the 2-process run against the single-process 8-device run.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.environ["REPO"])
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_num_cpu_devices", int(os.environ.get("GSPMD_LOCAL_DEVICES", "4"))
+)
+
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import basics, checkpoint, spmd  # noqa: E402
+from horovod_tpu.data import DataLoader  # noqa: E402
+from horovod_tpu.models import transformer as T  # noqa: E402
+from horovod_tpu.parallel.meshes import AXIS_ORDER, MeshSpec  # noqa: E402
+
+CKPT_DIR = os.environ["GSPMD_CKPT_DIR"]
+STEPS = 4
+SAVE_AT = 2  # save after this many steps, then resume and replay
+GLOBAL_BATCH = 16
+
+
+def bits(x) -> str:
+    return np.float32(float(x)).tobytes().hex()
+
+
+def main() -> None:
+    hvd.init()
+    rank, nproc = basics.process_rank(), basics.num_processes()
+
+    # Global 8-device mesh, (process, id)-lexicographic so the logical
+    # mesh is identical whether 8 devices live in 1 process or 2.
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    assert len(devs) == 8, devs
+    spec = MeshSpec(dp=4, tp=2)
+    mesh = Mesh(np.array(devs).reshape(spec.shape), axis_names=AXIS_ORDER)
+
+    cfg = T.TransformerConfig(
+        vocab_size=128, d_model=64, n_heads=4, n_layers=2, d_ff=128,
+        max_seq=16, dtype=np.float32, attention_impl="reference",
+    )
+
+    # Identical init on every process; device_put commits each leaf to its
+    # GSPMD sharding (only the addressable shards transfer).
+    p_specs = T.param_specs(cfg)
+    params = jax.device_put(
+        T.init_params(jax.random.PRNGKey(0), cfg),
+        jax.tree_util.tree_map(lambda s: NamedSharding(mesh, s), p_specs),
+    )
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    step = spmd.make_gspmd_train_step(
+        lambda p, b: T.loss_fn(p, b, cfg), opt,
+        mesh=mesh, param_spec=p_specs, batch_spec=T.batch_specs(),
+        donate=False,
+    )
+
+    # Deterministic dataset; the loader's global-array mode hands each
+    # process only ITS rows and assembles one global array per batch.
+    rng = np.random.RandomState(0)
+    data = {
+        "tokens": rng.randint(
+            0, cfg.vocab_size, size=(64, cfg.max_seq)).astype(np.int32),
+    }
+    data["targets"] = np.roll(data["tokens"], -1, axis=1)
+    tok_sharding = NamedSharding(mesh, T.batch_specs()["tokens"])
+    loader = DataLoader(
+        data, GLOBAL_BATCH, shuffle=True, seed=7, shard=False,
+        prefetch=0, sharding=tok_sharding,
+    )
+    if nproc > 1:
+        assert loader._global, "loader must be in global-array mode"
+        assert len(loader._local_rows) == GLOBAL_BATCH // nproc, (
+            loader._local_rows)
+    batches = list(loader)
+    assert len(batches) == STEPS
+    assert batches[0]["tokens"].shape == (GLOBAL_BATCH, cfg.max_seq)
+
+    repl = NamedSharding(mesh, P())
+
+    def checksum(tree):
+        # Host-side, order-deterministic: reshard each leaf to replicated
+        # (pure data movement — an in-XLA sum's reduction tree is
+        # topology-dependent and drifts by ulps between 1- and 2-process
+        # runs), pull the full array, sum with numpy's fixed order.
+        acc = np.float32(0)
+        for leaf in jax.tree_util.tree_leaves(tree):
+            full = np.asarray(jax.device_put(leaf, repl))
+            acc = np.float32(acc + np.sum(full, dtype=np.float32))
+        return acc
+
+    losses = []
+    saved = None
+    for i in range(STEPS):
+        params, opt_state, loss = step(params, opt_state, batches[i])
+        losses.append(bits(loss))
+        if i + 1 == SAVE_AT:
+            # Multihost collaborative save: every process calls in; each
+            # writes the shards it addresses.
+            checkpoint.save(
+                os.path.join(CKPT_DIR, "state"),
+                {"params": params, "opt_state": opt_state, "step": i + 1},
+            )
+            saved = (params, opt_state)
+
+    # --- resume: collaborative sharded restore, replay steps 2..4 -------
+    template = {"params": saved[0], "opt_state": saved[1], "step": 0}
+    back = checkpoint.restore(os.path.join(CKPT_DIR, "state"), template)
+    assert back["step"] == SAVE_AT
+    rparams, ropt_state = back["params"], back["opt_state"]
+    for leaf in jax.tree_util.tree_leaves(rparams):
+        assert isinstance(leaf, jax.Array)
+        if nproc > 1:
+            assert not leaf.is_fully_addressable  # restored SHARDED
+    resume = []
+    for i in range(SAVE_AT, STEPS):
+        rparams, ropt_state, loss = step(rparams, ropt_state, batches[i])
+        resume.append(bits(loss))
+    assert resume == losses[SAVE_AT:], (
+        f"resume diverged: {resume} vs {losses[SAVE_AT:]}")
+
+    print(
+        f"GSPMD-WORKER-OK rank={rank} nproc={nproc} "
+        f"losses={','.join(losses)} resume={','.join(resume)} "
+        f"check={bits(checksum(params))}"
+    )
+    hvd.shutdown()
+
+
+main()
