@@ -47,7 +47,10 @@ def main() -> None:
 
     cfg = T.TransformerConfig(
         vocab_size=32, d_model=32, n_heads=4, n_layers=2 * pp, d_ff=64,
-        max_seq=16, dtype=jnp.float32, n_experts=4, capacity_factor=2.0)
+        max_seq=16, dtype=jnp.float32, n_experts=4, capacity_factor=2.0,
+        # Switch balance term: keeps the learned router from collapsing
+        # onto few experts (flows through BOTH pipeline schedules).
+        moe_aux_coeff=0.01)
     params = T.init_params(jax.random.PRNGKey(0), cfg)
 
     # Count-mod-32 task.
@@ -76,6 +79,11 @@ def main() -> None:
             print(f"step {i:3d}  loss {float(loss):.4f}")
     print(f"1F1B pipeline (pp={pp}) + switch-MoE (E={cfg.n_experts}) "
           f"trained to loss {float(loss):.4f}")
+    load = np.asarray(T.expert_load(params, batch["tokens"], cfg))
+    print("expert load per layer (aux keeps this near uniform = "
+          f"{1 / cfg.n_experts:.2f}):")
+    for li, row in enumerate(load):
+        print(f"  layer {li:2d}: " + " ".join(f"{f:.2f}" for f in row))
     hvd.shutdown()
 
 

@@ -57,6 +57,14 @@ class TransformerConfig:
     # (None = single-device sparse dispatch; the GSPMD/jit path shards
     # the expert axis via param_specs instead).
     moe_axis: Optional[str] = None
+    # Switch load-balancing auxiliary loss coefficient (Switch paper's
+    # alpha, typically 1e-2).  When > 0, loss_fn adds
+    # ``coeff * sum_over_layers(E * sum_e frac_e * pbar_e)`` so the
+    # router is pushed toward uniform expert load — without it a learned
+    # router under tight capacity route-collapses (all tokens -> one
+    # expert, capacity drops eat the batch).  0 disables (the oracle /
+    # equivalence-test setting).
+    moe_aux_coeff: float = 0.0
     # Grouped-query attention: K/V heads (0 = n_heads, i.e. MHA).  With
     # ring attention the rotating K/V shards shrink by n_heads/n_kv_heads
     # — the long-context ICI-bandwidth lever (beyond-reference extension).
@@ -265,7 +273,7 @@ def _dense_mlp(x, p, cfg: TransformerConfig):
     return jnp.einsum("bsf,fd->bsd", jax.nn.silu(g) * u, p["w_down"].astype(cfg.dtype))
 
 
-def _moe_mlp_dense(x, p, cfg: TransformerConfig):
+def _moe_mlp_dense(x, p, cfg: TransformerConfig, return_aux: bool = False):
     """Top-1 MoE, dense dispatch: compute routing probs, evaluate every
     expert, combine with the routing one-hot.  Exact and dropless — the
     oracle for the sparse path, and the right choice for decoding (a
@@ -279,16 +287,24 @@ def _moe_mlp_dense(x, p, cfg: TransformerConfig):
     u = jnp.einsum("bsd,edf->besf", x, p["w_up"].astype(cfg.dtype))
     y = jnp.einsum("besf,efd->besd", jax.nn.silu(g) * u, p["w_down"].astype(cfg.dtype))
     y = jnp.einsum("besd,bse->bsd", y, onehot)
-    return y * gate[..., None].astype(cfg.dtype)
+    y = y * gate[..., None].astype(cfg.dtype)
+    if not return_aux:
+        return y
+    frac = onehot.astype(jnp.float32).reshape(-1, cfg.n_experts).mean(0)
+    pbar = probs.reshape(-1, cfg.n_experts).mean(0)
+    return y, cfg.n_experts * jnp.sum(frac * pbar)
 
 
-def _moe_mlp(x, p, cfg: TransformerConfig, impl: Optional[str] = None):
+def _moe_mlp(x, p, cfg: TransformerConfig, impl: Optional[str] = None,
+             return_aux: bool = False):
     """Mixture-of-experts FFN; ``impl`` overrides ``cfg.moe_impl`` (the
     decode path forces "dense": per-step token counts are tiny and the
-    capacity-drop pattern is a training-time behavior)."""
+    capacity-drop pattern is a training-time behavior).  With
+    ``return_aux`` also returns the layer's Switch load-balancing loss
+    (ops/moe.py switch_moe(return_aux=True); same formula for dense)."""
     impl = impl or cfg.moe_impl
     if impl == "dense":
-        return _moe_mlp_dense(x, p, cfg)
+        return _moe_mlp_dense(x, p, cfg, return_aux=return_aux)
     if impl != "switch":
         raise ValueError(f"unknown moe_impl {impl!r}; "
                          "expected 'switch' or 'dense'")
@@ -297,26 +313,35 @@ def _moe_mlp(x, p, cfg: TransformerConfig, impl: Optional[str] = None):
     return moe.switch_moe(
         x, p["router"], p["w_gate"].astype(cfg.dtype),
         p["w_up"].astype(cfg.dtype), p["w_down"].astype(cfg.dtype),
-        capacity_factor=cfg.capacity_factor, axis_name=cfg.moe_axis)
+        capacity_factor=cfg.capacity_factor, axis_name=cfg.moe_axis,
+        return_aux=return_aux)
 
 
-def _mlp_block(x, p, cfg: TransformerConfig, moe_impl: Optional[str] = None):
+def _mlp_block(x, p, cfg: TransformerConfig, moe_impl: Optional[str] = None,
+               return_aux: bool = False):
     """Residual MLP half of a layer, shared by forward, the pipeline, and
     the decode step.  Dense MLPs are bit-identical across all three; MoE
     decode/prefill force dense dispatch, so forward-vs-decode equivalence
     holds exactly when switch dispatch drops no tokens (capacity_factor
     >= n_experts guarantees that) and diverges by the dropped tokens'
     contributions otherwise — capacity drops are a training-time
-    behavior, not part of the serving contract."""
+    behavior, not part of the serving contract.  ``return_aux`` threads
+    the MoE balance loss out (0 for dense MLPs so callers can accumulate
+    unconditionally)."""
     m = _rmsnorm(x, p["ln2"])
     if cfg.n_experts > 1:
-        return x + _moe_mlp(m, p, cfg, impl=moe_impl)
-    return x + _dense_mlp(m, p, cfg)
+        out = _moe_mlp(m, p, cfg, impl=moe_impl, return_aux=return_aux)
+        if return_aux:
+            y, aux = out
+            return x + y, aux
+        return x + out
+    y = x + _dense_mlp(m, p, cfg)
+    return (y, jnp.float32(0.0)) if return_aux else y
 
 
-def _layer_body(x, p, cfg: TransformerConfig):
+def _layer_body(x, p, cfg: TransformerConfig, return_aux: bool = False):
     x = x + _attention(_rmsnorm(x, p["ln1"]), p, cfg)
-    return _mlp_block(x, p, cfg)
+    return _mlp_block(x, p, cfg, return_aux=return_aux)
 
 
 def _remat(layer, cfg: TransformerConfig):
@@ -346,23 +371,71 @@ def _xent_sum(logits, targets):
     return jnp.sum(logz - gold)
 
 
-def forward(params: Dict, tokens, cfg: TransformerConfig):
-    """Logits for next-token prediction.  ``tokens``: (B, S) int32."""
+def forward(params: Dict, tokens, cfg: TransformerConfig,
+            return_aux: bool = False):
+    """Logits for next-token prediction.  ``tokens``: (B, S) int32.
+
+    ``return_aux`` additionally returns the SUM over layers of the MoE
+    load-balancing auxiliary loss (0.0 for dense models) — accumulated
+    in the layer-scan carry."""
     x = params["embed"].astype(cfg.dtype)[tokens]
 
-    def layer(x, p):
-        return _layer_body(x, p, cfg), None
+    if return_aux:
+        def layer(carry, p):
+            x, aux = carry
+            x, a = _layer_body(x, p, cfg, return_aux=True)
+            return (x, aux + a), None
+    else:
+        def layer(x, p):
+            return _layer_body(x, p, cfg), None
 
     if cfg.remat:
         layer = _remat(layer, cfg)
+    if return_aux:
+        (x, aux), _ = lax.scan(layer, (x, jnp.float32(0.0)), params["layers"])
+        return _lm_head(x, params["ln_f"], params["head"], cfg), aux
     x, _ = lax.scan(layer, x, params["layers"])
     return _lm_head(x, params["ln_f"], params["head"], cfg)
 
 
 def loss_fn(params: Dict, batch: Dict, cfg: TransformerConfig):
-    """Mean next-token cross-entropy.  ``batch = {tokens, targets}``."""
+    """Mean next-token cross-entropy.  ``batch = {tokens, targets}``.
+
+    With ``cfg.moe_aux_coeff > 0`` on an MoE config, adds
+    ``coeff * sum_over_layers(aux)`` — the Switch balance term that keeps
+    the learned router from collapsing onto few experts."""
+    if cfg.n_experts > 1 and cfg.moe_aux_coeff > 0.0:
+        logits, aux = forward(params, batch["tokens"], cfg, return_aux=True)
+        xent = _xent_sum(logits, batch["targets"]) / batch["targets"].size
+        return xent + cfg.moe_aux_coeff * aux
     logits = forward(params, batch["tokens"], cfg)
     return _xent_sum(logits, batch["targets"]) / batch["targets"].size
+
+
+def expert_load(params: Dict, tokens, cfg: TransformerConfig):
+    """Routing observability: ``(n_layers, n_experts)`` fraction of tokens
+    whose top-1 route lands on each expert, measured on the activations
+    actually entering every MoE block.  Uniform rows (≈ 1/E) mean a
+    balanced router; a collapsed router shows one column near 1.0 (and,
+    under tight capacity, most tokens dropped).  Pair with
+    ``cfg.moe_aux_coeff`` — the balance term that keeps this histogram
+    flat during training."""
+    if cfg.n_experts <= 1:
+        raise ValueError("expert_load needs an MoE config (n_experts > 1)")
+    x = params["embed"].astype(cfg.dtype)[tokens]
+
+    def layer(x, p):
+        att = x + _attention(_rmsnorm(x, p["ln1"]), p, cfg)
+        m = _rmsnorm(att, p["ln2"])
+        logits = (m.astype(jnp.float32).reshape(-1, cfg.d_model)
+                  @ p["router"].astype(jnp.float32))
+        frac = jax.nn.one_hot(
+            jnp.argmax(logits, axis=-1), cfg.n_experts,
+            dtype=jnp.float32).mean(0)
+        return _mlp_block(att, p, cfg), frac
+
+    _, fracs = lax.scan(layer, x, params["layers"])
+    return fracs
 
 
 # --- autoregressive decoding (KV cache) ---------------------------------------
@@ -596,7 +669,8 @@ def greedy_decode(params: Dict, prompt, steps: int, cfg: TransformerConfig,
 
 def pipelined_forward(params: Dict, tokens, cfg: TransformerConfig, *,
                       axis_name: str = "pp",
-                      n_microbatches: Optional[int] = None):
+                      n_microbatches: Optional[int] = None,
+                      return_aux: bool = False):
     """``forward`` with the layer stack executed as a GPipe pipeline over
     the ``axis_name`` mesh axis (one stage of ``n_layers/P`` blocks per
     device, microbatched activations flowing via ppermute —
@@ -606,24 +680,34 @@ def pipelined_forward(params: Dict, tokens, cfg: TransformerConfig, *,
     (``P()`` specs): each device slices its own stage out of the full
     layer stack locally, so no parameter resharding collectives are
     emitted.  Numerically identical to :func:`forward`.
+
+    ``return_aux`` additionally returns this STAGE's MoE balance-loss sum
+    (``psum`` over the axis == :func:`forward`'s aux; kept local so each
+    stage owns its aux gradient).
     """
     from horovod_tpu.parallel import pipeline as _pl
 
     B = tokens.shape[0]
     M, my_layers, stage_fn = _pipeline_stage_setup(
-        params, cfg, axis_name, B, n_microbatches)
+        params, cfg, axis_name, B, n_microbatches, return_aux=return_aux)
     x = params["embed"].astype(cfg.dtype)[tokens]
     mb = x.reshape(M, B // M, *x.shape[1:])
-    out = _pl.pipeline_apply(stage_fn, my_layers, mb, axis_name=axis_name)
+    out = _pl.pipeline_apply(stage_fn, my_layers, mb, axis_name=axis_name,
+                             stage_aux=return_aux)
+    if return_aux:
+        out, aux_local = out
     x = out.reshape(B, *x.shape[1:])
-    return _lm_head(x, params["ln_f"], params["head"], cfg)
+    logits = _lm_head(x, params["ln_f"], params["head"], cfg)
+    return (logits, aux_local) if return_aux else logits
 
 
 def _pipeline_stage_setup(params: Dict, cfg: TransformerConfig,
                           axis_name: str, batch: int,
-                          n_microbatches: Optional[int]):
+                          n_microbatches: Optional[int],
+                          return_aux: bool = False):
     """Shared pipeline plumbing (both schedules): divisibility checks,
-    this stage's layer slice, and the scanned stage function."""
+    this stage's layer slice, and the scanned stage function (aux-carrying
+    when ``return_aux`` — the per-stage MoE balance sum)."""
     P_ = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name)
     if cfg.n_layers % P_:
@@ -636,6 +720,25 @@ def _pipeline_stage_setup(params: Dict, cfg: TransformerConfig,
     my_layers = jax.tree_util.tree_map(
         lambda l: lax.dynamic_slice_in_dim(l, s * per_stage, per_stage, 0),
         params["layers"])
+
+    if return_aux:
+        def layer(carry, p):
+            x, aux = carry
+            x, a = _layer_body(x, p, cfg, return_aux=True)
+            return (x, aux + a), None
+
+        if cfg.remat:
+            layer = _remat(layer, cfg)
+
+        def stage_fn(lp_stack, xb):
+            # Axis-varying zero init: the aux output is varying (computed
+            # from the varying activations), so the scan carry init must
+            # be too (shard_map VMA typing).
+            aux0 = jnp.float32(0.0) + (s * 0).astype(jnp.float32)
+            (out, aux), _ = lax.scan(layer, (xb, aux0), lp_stack)
+            return out, aux
+
+        return M, my_layers, stage_fn
 
     def layer(x, p):
         return _layer_body(x, p, cfg), None
@@ -680,13 +783,29 @@ def pipelined_value_and_grad(params: Dict, batch: Dict,
     P_ = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name)
 
+    aux_on = cfg.n_experts > 1 and cfg.moe_aux_coeff > 0.0
+
     if schedule == "gpipe":
         def _loss(p):
-            logits = pipelined_forward(p, batch["tokens"], cfg,
-                                       axis_name=axis_name,
-                                       n_microbatches=n_microbatches)
+            if aux_on:
+                logits, aux_local = pipelined_forward(
+                    p, batch["tokens"], cfg, axis_name=axis_name,
+                    n_microbatches=n_microbatches, return_aux=True)
+            else:
+                logits = pipelined_forward(p, batch["tokens"], cfg,
+                                           axis_name=axis_name,
+                                           n_microbatches=n_microbatches)
             raw = _xent_sum(logits, batch["targets"]) / batch["targets"].size
-            return lax.psum(jnp.where(s == P_ - 1, raw, 0.0), axis_name)
+            total = lax.psum(jnp.where(s == P_ - 1, raw, 0.0), axis_name)
+            if aux_on:
+                # Pipelined aux is computed PER MICROBATCH (the dispatch
+                # group switch routing actually sees); the mean over
+                # groups matches loss_fn's full-batch aux scale — and
+                # equals it exactly at n_microbatches=1.
+                M_ = n_microbatches or P_
+                total = total + cfg.moe_aux_coeff * lax.psum(
+                    aux_local, axis_name) / M_
+            return total
 
         return jax.value_and_grad(_loss)(params)
     if schedule != "1f1b":
@@ -697,7 +816,7 @@ def pipelined_value_and_grad(params: Dict, batch: Dict,
     tokens, targets = batch["tokens"], batch["targets"]
     B, S = tokens.shape
     M, my_layers, stage_fn = _pipeline_stage_setup(
-        params, cfg, axis_name, B, n_microbatches)
+        params, cfg, axis_name, B, n_microbatches, return_aux=aux_on)
     per_stage = cfg.n_layers // P_
     n_tok = B * S
 
@@ -713,7 +832,10 @@ def pipelined_value_and_grad(params: Dict, batch: Dict,
         stage_fn, my_layers, xs, ts, loss_fn, axis_name=axis_name,
         schedule="1f1b",
         loss_params={"ln_f": params["ln_f"], "head": params["head"]},
-        return_input_grads=True)
+        return_input_grads=True,
+        # Per-microbatch aux averaged over the M dispatch groups (see the
+        # gpipe branch) — the weight folds the 1/M in.
+        aux_weight=cfg.moe_aux_coeff / M if aux_on else None)
 
     # Reassemble the full layer-stack gradient: each stage owns its slice
     # (zeros elsewhere), so writing it at the stage offset and psumming

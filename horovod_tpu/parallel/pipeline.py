@@ -39,12 +39,16 @@ from jax import lax
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
-                   *, axis_name: str = "pp"):
+                   *, axis_name: str = "pp", stage_aux: bool = False):
     """Run ``microbatches`` through the P pipeline stages.
 
     Args:
       stage_fn: ``(stage_params, x) -> y`` applying THIS device's stage to
-        one microbatch; must preserve ``x.shape``.
+        one microbatch; must preserve ``x.shape``.  With ``stage_aux``,
+        ``(stage_params, x) -> (y, aux)`` where ``aux`` is a scalar
+        side-loss (e.g. the MoE balance term) summed per stage over its
+        REAL microbatches only (fill/drain ticks run on zero activations
+        whose aux is meaningless and is masked out).
       stage_params: this device's stage parameters (inside ``shard_map``,
         pass the pp-sharded slice — e.g. a layer stack reshaped to
         ``(P, layers_per_stage, ...)`` and sharded on axis 0, squeezed).
@@ -56,35 +60,48 @@ def pipeline_apply(stage_fn: Callable, stage_params, microbatches,
       ``(M, mb, ...)`` outputs of the LAST stage, broadcast to every
       stage member (one ``psum`` — lets the loss/readout be computed
       replicated, and keeps the return value meaningful on all devices).
+      With ``stage_aux``, ``(outputs, aux_local)`` where ``aux_local`` is
+      THIS stage's aux sum (``psum`` it over the axis for the total —
+      keeping it local preserves per-stage gradient ownership).
     """
     P = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name)
     M = microbatches.shape[0]
     right = [(i, (i + 1) % P) for i in range(P)]
 
-    def tick(buf, t):
+    def tick(carry, t):
         # Stage 0 reads the schedule's fresh microbatch (zeros in the
         # drain phase — those ticks' outputs are discarded below);
         # other stages read what arrived from the left last tick.
+        buf, aacc = carry
         mb = microbatches[jnp.clip(t, 0, M - 1)]
         mb = jnp.where(t < M, mb, jnp.zeros_like(mb))
         x = jnp.where(s == 0, mb, buf)
-        y = stage_fn(stage_params, x)
-        return lax.ppermute(y, axis_name, right), y
+        if stage_aux:
+            y, aux = stage_fn(stage_params, x)
+            # Stage s computes real microbatch t-s only while 0 <= t-s < M.
+            f_valid = (t >= s) & (t - s < M)
+            aacc = aacc + jnp.where(f_valid, aux, 0.0)
+        else:
+            y = stage_fn(stage_params, x)
+        return (lax.ppermute(y, axis_name, right), aacc), y
 
     # Derive the initial carry from axis_index so it is varying-over-axis
     # under shard_map (the ppermuted carry-out is; a plain replicated
     # zeros literal would mismatch the scan carry type).
     buf0 = jnp.zeros_like(microbatches[0]) + (s * 0).astype(
         microbatches.dtype)
-    _, ys = lax.scan(tick, buf0, jnp.arange(M + P - 1))
+    aacc0 = jnp.float32(0.0) + (s * 0).astype(jnp.float32)
+    (_, aux_local), ys = lax.scan(
+        tick, (buf0, aacc0), jnp.arange(M + P - 1))
 
     # Last stage's outputs for microbatch m appear at tick m + P - 1.
     out_last = lax.dynamic_slice_in_dim(ys, P - 1, M, axis=0)
     # Select the last stage's values and share them with the whole axis:
     # every other stage contributes zeros, so the psum IS a broadcast.
-    return lax.psum(jnp.where(s == P - 1, out_last, jnp.zeros_like(out_last)),
-                    axis_name)
+    out = lax.psum(jnp.where(s == P - 1, out_last, jnp.zeros_like(out_last)),
+                   axis_name)
+    return (out, aux_local) if stage_aux else out
 
 
 def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
@@ -92,7 +109,8 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
                             axis_name: str = "pp",
                             schedule: str = "gpipe",
                             loss_params=None,
-                            return_input_grads: bool = False):
+                            return_input_grads: bool = False,
+                            aux_weight=None):
     """Microbatched pipeline training step: total loss and THIS stage's
     parameter gradients.
 
@@ -116,6 +134,13 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
         (``(M, mb, ...)``), accumulated at stage 0 and zero elsewhere —
         what an embedding layer upstream of the pipeline backprops
         through.
+      aux_weight: when not None, ``stage_fn`` returns ``(y, aux)`` (see
+        :func:`pipeline_apply` ``stage_aux``) and the optimized loss
+        becomes ``sum(loss_fn) + aux_weight * sum(aux over stages and
+        microbatches)`` — both value and gradients.  In the 1f1b
+        schedule the aux cotangent rides the SAME per-microbatch
+        ``jax.vjp`` replay the backward wave already does, so the
+        schedule's memory bound is unchanged.
 
     Returns:
       ``(loss, stage_grads)`` — loss replicated over the axis,
@@ -170,8 +195,13 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
 
     if schedule == "gpipe":
         def total_loss(params, lp, mbs):
-            outs = pipeline_apply(stage_fn, params, mbs,
-                                  axis_name=axis_name)
+            if aux_weight is not None:
+                outs, aux_local = pipeline_apply(
+                    stage_fn, params, mbs, axis_name=axis_name,
+                    stage_aux=True)
+            else:
+                outs = pipeline_apply(stage_fn, params, mbs,
+                                      axis_name=axis_name)
             losses = jax.vmap(lambda y, t: _apply_loss(lp, y, t))(
                 outs, targets)
             # Gate the (replicated) loss to the last stage and psum: the
@@ -181,7 +211,12 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
             # contract the 1f1b schedule produces (and the construction
             # the model-level pipelined_value_and_grad documents).
             raw = jnp.sum(losses)
-            return lax.psum(jnp.where(s == P - 1, raw, 0.0), axis_name)
+            total = lax.psum(jnp.where(s == P - 1, raw, 0.0), axis_name)
+            if aux_weight is not None:
+                # Each stage's aux is LOCAL (gradient ownership); the
+                # psum collects the value across stages.
+                total = total + aux_weight * lax.psum(aux_local, axis_name)
+            return total
 
         argnums = [0] + ([1] if has_lp else []) + (
             [2] if return_input_grads else [])
@@ -209,8 +244,10 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
     T = M + 2 * P - 2
     is_last = s == P - 1
 
+    has_aux = aux_weight is not None
+
     def tick(carry, t):
-        fwd_in, bwd_in, xbuf, gacc, lacc, lpacc, xgacc = carry
+        fwd_in, bwd_in, xbuf, gacc, lacc, auxacc, lpacc, xgacc = carry
 
         # ---- forward wave: F(s, m) at tick t = s + m -------------------
         m_f = t - s
@@ -221,7 +258,12 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
                          jnp.zeros(mb_shape, dtype))
         slot = jnp.where(f_valid, jnp.clip(m_f, 0, M - 1) % R, R)
         xbuf = lax.dynamic_update_index_in_dim(xbuf, x_in, slot, axis=0)
-        y = stage_fn(stage_params, x_in)
+        if has_aux:
+            # Aux's VALUE and gradient are both taken from the backward
+            # replay below; the forward wave only moves activations.
+            y, _ = stage_fn(stage_params, x_in)
+        else:
+            y = stage_fn(stage_params, x_in)
 
         # ---- backward wave: B(s, m) at tick t = (2P-2-s) + m -----------
         m_b = t - (2 * P - 2 - s)
@@ -229,7 +271,11 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
         x_b = lax.dynamic_index_in_dim(
             xbuf, jnp.where(b_valid, jnp.clip(m_b, 0, M - 1) % R, R),
             keepdims=False)
-        y_b, pull = jax.vjp(stage_fn, stage_params, x_b)
+        if has_aux:
+            (y_b, aux_b), pull = jax.vjp(stage_fn, stage_params, x_b)
+            auxacc = auxacc + jnp.where(b_valid, aux_b, 0.0)
+        else:
+            y_b, pull = jax.vjp(stage_fn, stage_params, x_b)
         tgt = lax.dynamic_index_in_dim(
             targets, jnp.clip(m_b, 0, M - 1), keepdims=False)
         if has_lp:
@@ -246,7 +292,14 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
         # stages consume what their right neighbour emitted last tick.
         gy = jnp.where(b_valid, jnp.where(is_last, gy_loss, bwd_in),
                        jnp.zeros_like(y_b))
-        gparams, gx = pull(gy)
+        if has_aux:
+            # The aux term's cotangent is its weight, on valid ticks only
+            # — it joins the xent cotangent in ONE pullback call.
+            g_aux = jnp.where(b_valid, jnp.float32(aux_weight),
+                              jnp.float32(0.0))
+            gparams, gx = pull((gy, g_aux))
+        else:
+            gparams, gx = pull(gy)
         # Double-where guard: zeroing gy is not enough when stage_fn's
         # partials are non-finite at the zero fill/drain input (0 * inf =
         # nan would poison the accumulator), so mask the pullback outputs
@@ -267,7 +320,7 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
 
         return (lax.ppermute(y, axis_name, right),
                 lax.ppermute(gx, axis_name, left),
-                xbuf, gacc, lacc, lpacc, xgacc), None
+                xbuf, gacc, lacc, auxacc, lpacc, xgacc), None
 
     # Device-varying zeros (see pipeline_apply): every carry leaf becomes
     # varying-over-pp inside the scan (permuted wires, per-stage grads),
@@ -281,16 +334,19 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
     gacc0 = jax.tree_util.tree_map(
         lambda p: vzeros(p.shape, p.dtype), stage_params)
     lacc0 = vzeros((), jnp.float32)
+    auxacc0 = vzeros((), jnp.float32)
     lpacc0 = jax.tree_util.tree_map(
         lambda p: vzeros(p.shape, p.dtype), loss_params) if has_lp else 0.0
     xgacc0 = (vzeros((M + 1,) + mb_shape, dtype)
               if return_input_grads else 0.0)
 
-    (_, _, _, gacc, lacc, lpacc, xgacc), _ = lax.scan(
-        tick, (fwd0, bwd0, xbuf0, gacc0, lacc0, lpacc0, xgacc0),
+    (_, _, _, gacc, lacc, auxacc, lpacc, xgacc), _ = lax.scan(
+        tick, (fwd0, bwd0, xbuf0, gacc0, lacc0, auxacc0, lpacc0, xgacc0),
         jnp.arange(T))
-    # Only stage P-1 accumulated loss; psum broadcasts it to the axis.
-    loss = lax.psum(lacc, axis_name)
+    # Only stage P-1 accumulated the xent loss; every stage accumulated
+    # its own aux.  One psum broadcasts the total to the axis.
+    contrib = lacc if not has_aux else lacc + jnp.float32(aux_weight) * auxacc
+    loss = lax.psum(contrib, axis_name)
     if not has_lp and not return_input_grads:
         return loss, gacc
     extras = {}

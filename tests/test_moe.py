@@ -257,3 +257,110 @@ class TestModelSwitchMoE:
         tokens = jnp.zeros((1, 8), jnp.int32)
         with pytest.raises(ValueError, match="moe_impl"):
             T.forward(params, tokens, cfg)
+
+
+class TestModelAuxLoss:
+    """The Switch balance term wired into the FLAGSHIP training loss
+    (cfg.moe_aux_coeff), and the routed-fraction observability that
+    proves it keeps the router from collapsing."""
+
+    def _cfg(self, **kw):
+        import dataclasses
+
+        from horovod_tpu.models import transformer as T
+
+        base = T.TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=16, n_experts=4, dtype=jnp.float32,
+            attention_impl="reference")
+        return T, dataclasses.replace(base, **kw)
+
+    def test_loss_fn_adds_exactly_coeff_times_aux(self):
+        """loss_fn(coeff) == loss_fn(0) + coeff * sum-of-layer-aux — the
+        wiring is arithmetic, not approximate."""
+        import dataclasses
+
+        T, cfg = self._cfg(moe_aux_coeff=0.0)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        batch = T.synthetic_batch(1, cfg, batch=4)
+        base = float(T.loss_fn(params, batch, cfg))
+        _, aux = T.forward(params, batch["tokens"], cfg, return_aux=True)
+        with_aux = float(T.loss_fn(
+            params, batch, dataclasses.replace(cfg, moe_aux_coeff=0.02)))
+        np.testing.assert_allclose(
+            with_aux, base + 0.02 * float(aux), rtol=1e-6)
+
+    def test_aux_nonzero_for_moe_zero_for_dense(self):
+        T, cfg = self._cfg()
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        batch = T.synthetic_batch(1, cfg, batch=2)
+        _, aux = T.forward(params, batch["tokens"], cfg, return_aux=True)
+        assert float(aux) >= 2.0 - 1e-4  # >= n_layers * 1.0 (min per layer)
+
+        Td, dcfg = self._cfg(n_experts=0)
+        dparams = Td.init_params(jax.random.PRNGKey(0), dcfg)
+        _, daux = Td.forward(dparams, batch["tokens"], dcfg, return_aux=True)
+        assert float(daux) == 0.0
+
+    def test_router_gradient_flows_from_aux(self):
+        """With every token hard-routed to one expert, the plain LM loss
+        gives the router no balance pressure; the aux term must produce a
+        router gradient pushing load off the overloaded expert."""
+        import dataclasses
+
+        T, cfg = self._cfg(moe_aux_coeff=0.01, capacity_factor=1.0)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        L, D, E = params["layers"]["router"].shape
+        params["layers"]["router"] = (
+            jnp.asarray(params["layers"]["router"]).at[:, :, 0].add(3.0))
+        batch = T.synthetic_batch(1, cfg, batch=4)
+        g = jax.grad(lambda p: T.loss_fn(p, batch, cfg))(params)
+        g0 = np.asarray(g["layers"]["router"])[:, :, 0]
+        assert np.abs(g0).max() > 0, "aux must reach the router"
+
+    def test_training_with_aux_keeps_load_uniform(self):
+        """Train a small switch model under TIGHT capacity (cf=1.0, where
+        every point of imbalance costs dropped tokens): with the aux term
+        the routed-fraction histogram stays near uniform; the no-aux
+        control drifts measurably less balanced.  (A linear bias-free
+        router cannot be force-collapsed deterministically at this scale
+        — rmsnorm'd activations kill constant logit offsets — so the
+        assertion is the measured uniformity GAP, not a staged
+        collapse.)"""
+        import dataclasses
+
+        import optax
+
+        T, cfg0 = self._cfg(capacity_factor=1.0)
+        rng = np.random.RandomState(0)
+        toks = rng.randint(0, 64, size=(8, 16)).astype(np.int32)
+        batch = {"tokens": jnp.asarray(toks),
+                 "targets": jnp.asarray(np.roll(toks, -1, 1))}
+
+        def train(coeff, steps=200):
+            cfg = dataclasses.replace(cfg0, moe_aux_coeff=coeff)
+            params = T.init_params(jax.random.PRNGKey(0), cfg)
+            opt = optax.adam(1e-2)
+            state = opt.init(params)
+
+            @jax.jit
+            def step(params, state):
+                loss, g = jax.value_and_grad(
+                    lambda p: T.loss_fn(p, batch, cfg))(params)
+                up, state = opt.update(g, state, params)
+                return optax.apply_updates(params, up), state, loss
+
+            for _ in range(steps):
+                params, state, loss = step(params, state)
+            assert np.isfinite(float(loss))
+            return np.asarray(T.expert_load(params, batch["tokens"], cfg))
+
+        load_aux = train(0.02)
+        load_ctrl = train(0.0)
+        E = cfg0.n_experts
+        # Aux run: near-uniform (ideal 1/E = 0.25) — no expert hoards,
+        # every expert carries real load in every layer.
+        assert load_aux.max() < 0.32, load_aux
+        assert load_aux.min() > 0.10, load_aux
+        # Control: measurably less balanced than the aux run.
+        assert load_ctrl.max() > load_aux.max() + 0.02, (load_ctrl, load_aux)
