@@ -278,6 +278,21 @@ constexpr uint32_t kMagicPlain = 0x48764130;  // "Hv A0"
 constexpr char kCoordTag[] = "hvd-coord";
 constexpr char kRankTag[] = "hvd-rank";
 
+// Handshake integers ride the wire (and enter the HMAC transcripts) as
+// explicit little-endian bytes, so a mixed-endianness cluster fails with
+// honest protocol errors instead of a misleading "secret key mismatch".
+inline void PutLe32(uint8_t* out, uint32_t v) {
+  out[0] = static_cast<uint8_t>(v);
+  out[1] = static_cast<uint8_t>(v >> 8);
+  out[2] = static_cast<uint8_t>(v >> 16);
+  out[3] = static_cast<uint8_t>(v >> 24);
+}
+inline uint32_t GetLe32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
 }  // namespace
 
 SocketComm::~SocketComm() { Shutdown(); }
@@ -357,13 +372,13 @@ bool SocketComm::Init(int rank, int size, const std::string& addr, int port,
           std::chrono::steady_clock::now() +
           std::chrono::milliseconds(std::min<int64_t>(left.count(), 2000));
       SetIoTimeoutMs(fd, 2000);  // bounds the verdict/reply sends too
-      uint32_t magic = 0;
-      int32_t peer_rank = -1;
-      if (!RecvAllBy(fd, &magic, 4, hs_deadline) ||
-          !RecvAllBy(fd, &peer_rank, 4, hs_deadline)) {
+      uint8_t hdr[8];
+      if (!RecvAllBy(fd, hdr, 8, hs_deadline)) {
         ::close(fd);
         continue;
       }
+      const uint32_t magic = GetLe32(hdr);
+      const int32_t peer_rank = static_cast<int32_t>(GetLe32(hdr + 4));
       const bool peer_auth = magic == kMagicAuth;
       if ((!peer_auth && magic != kMagicPlain) ||
           (secret.empty() != !peer_auth)) {
@@ -395,7 +410,8 @@ bool SocketComm::Init(int rank, int size, const std::string& addr, int port,
         }
         std::vector<uint8_t> expect_msg(sizeof(kRankTag) - 1 + 4 + 32);
         std::memcpy(expect_msg.data(), kRankTag, sizeof(kRankTag) - 1);
-        std::memcpy(expect_msg.data() + sizeof(kRankTag) - 1, &peer_rank, 4);
+        PutLe32(expect_msg.data() + sizeof(kRankTag) - 1,
+                static_cast<uint32_t>(peer_rank));
         std::memcpy(expect_msg.data() + sizeof(kRankTag) - 1 + 4,
                     server_nonce, 32);
         uint8_t expect[32];
@@ -458,10 +474,10 @@ bool SocketComm::Init(int rank, int size, const std::string& addr, int port,
       SetIoTimeoutMs(fd, std::max<int64_t>(1, left.count()));
     }
     const uint32_t magic = secret.empty() ? kMagicPlain : kMagicAuth;
-    int32_t my_rank = rank;
+    const int32_t my_rank = rank;
     uint8_t hello[40];
-    std::memcpy(hello, &magic, 4);
-    std::memcpy(hello + 4, &my_rank, 4);
+    PutLe32(hello, magic);
+    PutLe32(hello + 4, static_cast<uint32_t>(my_rank));
     size_t hello_len = 8;
     uint8_t client_nonce[32];
     if (!secret.empty()) {
@@ -501,7 +517,8 @@ bool SocketComm::Init(int rank, int size, const std::string& addr, int port,
       }
       std::vector<uint8_t> proof_msg(sizeof(kRankTag) - 1 + 4 + 32);
       std::memcpy(proof_msg.data(), kRankTag, sizeof(kRankTag) - 1);
-      std::memcpy(proof_msg.data() + sizeof(kRankTag) - 1, &my_rank, 4);
+      PutLe32(proof_msg.data() + sizeof(kRankTag) - 1,
+              static_cast<uint32_t>(my_rank));
       std::memcpy(proof_msg.data() + sizeof(kRankTag) - 1 + 4, reply, 32);
       uint8_t proof[32];
       HmacSha256(secret, proof_msg.data(), proof_msg.size(), proof);

@@ -679,6 +679,14 @@ def grouped_allreduce(tensors: Sequence, op: str = Average, *, axis_name=None, *
     if _is_traced(tensors):
         return [allreduce(t, op, axis_name=axis_name, **kw) for t in tensors]
     basics._ctx()
+    # Parse the kwargs the eager paths support; anything else raises LOUDLY
+    # rather than silently returning unscaled results (r4 advisor finding).
+    name = kw.pop("name", None)
+    prescale = kw.pop("prescale_factor", None)
+    postscale = kw.pop("postscale_factor", None)
+    if kw:
+        raise TypeError(
+            f"unsupported kwargs for eager grouped allreduce: {sorted(kw)}")
     rt = _native_rt()
     if rt is not None:
         # Submit the whole group before waiting: one negotiation cycle sees
@@ -686,9 +694,21 @@ def grouped_allreduce(tensors: Sequence, op: str = Average, *, axis_name=None, *
         # collective launch order globally consistent with concurrent
         # async ops).
         treedef, pairs = _native_submit_tree(
-            rt, "allreduce", tensors, None, reduce_op=_native_reduce_op(op)
+            rt, "allreduce", tensors, name,
+            reduce_op=_native_reduce_op(op),
+            prescale=1.0 if prescale is None else prescale,
+            postscale=1.0 if postscale is None else postscale,
         )
         return _native_wait_tree(rt, treedef, pairs)
+    if prescale is not None:
+        tensors = [np.asarray(t) * np.asarray(prescale, np.asarray(t).dtype)
+                   for t in tensors]
+
+    def _post(out):
+        if postscale is None:
+            return out
+        return [o * np.asarray(postscale, np.asarray(o).dtype) for o in out]
+
     if op == Adasum:
         # Concatenating a bucket and running one Adasum would change the
         # math (one global pairwise coefficient instead of one per
@@ -697,10 +717,11 @@ def grouped_allreduce(tensors: Sequence, op: str = Average, *, axis_name=None, *
         # FusedAllreduce semantics, adasum.h:194-338).
         from horovod_tpu.ops import adasum as _adasum
 
-        return _adasum.eager_adasum_group([np.asarray(t) for t in tensors])
+        return _post(_adasum.eager_adasum_group(
+            [np.asarray(t) for t in tensors]))
     from horovod_tpu.ops import fusion
 
-    return fusion.fused_eager_allreduce(tensors, op)
+    return _post(fusion.fused_eager_allreduce(tensors, op))
 
 
 def allgather(tensor, *, axis_name=None, name: Optional[str] = None):

@@ -417,7 +417,14 @@ def distributed_adasum_optimizer_class(base_cls, compression=None,
 
     Matches the optax ``DistributedAdasumOptimizer`` (``optim.py:151``)
     and the torch factory dispatch (``torch/__init__.py:153-243``)
-    step-for-step."""
+    step-for-step.
+
+    ORDERING CONTRACT (same as the reference's): broadcast the initial
+    variables to all workers BEFORE the first ``apply_gradients`` —
+    ``start`` is snapshotted lazily on the first step, so a
+    post-broadcast-after-step ordering would capture divergent
+    pre-broadcast weights and the first sync would silently write back
+    divergent deltas."""
 
     bpps = int(backward_passes_per_step)
     if bpps < 1:

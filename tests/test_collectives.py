@@ -199,6 +199,32 @@ class TestEager:
         for a, b in zip(outs, xs):
             np.testing.assert_allclose(a, ls * b, rtol=1e-6)
 
+    def test_grouped_allreduce_scaling_kwargs(self):
+        """prescale/postscale must reach every eager grouped path (the
+        native route forwards them per tensor; the direct routes scale
+        around the reduction) — silently dropping them was the r4
+        advisor finding."""
+        xs = [np.random.randn(4).astype(np.float32) for _ in range(3)]
+        ls = hvd.local_size()
+        outs = hvd.grouped_allreduce(
+            xs, hvd.Sum, prescale_factor=0.5, postscale_factor=4.0)
+        for a, b in zip(outs, xs):
+            np.testing.assert_allclose(a, 2.0 * ls * b, rtol=1e-5)
+
+    def test_grouped_adasum_scaling_kwargs(self):
+        """Single-process Adasum is the identity, so the scales are
+        directly observable: out = post * adasum(pre * x)."""
+        xs = [np.random.randn(4).astype(np.float32) for _ in range(2)]
+        outs = hvd.grouped_allreduce(
+            xs, hvd.Adasum, prescale_factor=0.5, postscale_factor=4.0)
+        for a, b in zip(outs, xs):
+            np.testing.assert_allclose(a, 2.0 * b, rtol=1e-5)
+
+    def test_grouped_allreduce_rejects_unknown_kwargs(self):
+        with pytest.raises(TypeError, match="unsupported kwargs"):
+            hvd.grouped_allreduce([np.ones(3, np.float32)], hvd.Adasum,
+                                  bogus_knob=1)
+
     def test_barrier(self):
         hvd.barrier()
 
