@@ -757,7 +757,8 @@ def pipelined_value_and_grad(params: Dict, batch: Dict,
                              cfg: TransformerConfig, *,
                              axis_name: str = "pp",
                              n_microbatches: Optional[int] = None,
-                             schedule: str = "gpipe"):
+                             schedule: str = "gpipe",
+                             n_virtual: int = 2):
     """Loss + EXACT full-parameter gradients of the pipelined model —
     call inside ``shard_map`` with params/batch replicated over the axis.
 
@@ -771,13 +772,23 @@ def pipelined_value_and_grad(params: Dict, batch: Dict,
     gradients identical to ``jax.grad(loss_fn)`` with no replication
     factors to divide out.
 
-    ``schedule="1f1b"``: the memory-bounded interleaved schedule
-    (:func:`horovod_tpu.parallel.pipeline_value_and_grad`) with the SAME
-    full-parameter gradient contract: stage grads reassemble into the
-    layer stack, the loss's head/ln_f grads come back via
+    ``schedule="1f1b"``: the memory-bounded one-forward-one-backward
+    schedule (:func:`horovod_tpu.parallel.pipeline_value_and_grad`) with
+    the SAME full-parameter gradient contract: stage grads reassemble
+    into the layer stack, the loss's head/ln_f grads come back via
     ``loss_params``, and the embedding grads via the returned input
-    cotangents scattered through the token lookup.  Both verified
-    leaf-for-leaf against ``jax.grad(loss_fn)`` in
+    cotangents scattered through the token lookup.
+
+    ``schedule="interleaved"``: virtual-stage (Megatron-interleaved)
+    schedule — the layer stack splits into ``n_virtual * P`` chunks laid
+    round-robin (:func:`horovod_tpu.parallel.interleaved_apply`), so the
+    fill/drain bubble shrinks by ~``n_virtual`` at the cost of
+    ``n_virtual×`` stage-boundary traffic; gradient construction is the
+    gpipe one (loss gated to the last chunk's device, chunk slices taken
+    inside the differentiated function so ``dynamic_slice``'s VJP
+    scatters each chunk's gradient into the full stack).
+
+    All three verified leaf-for-leaf against ``jax.grad(loss_fn)`` in
     ``tests/test_pipeline.py``.
     """
     P_ = lax.axis_size(axis_name)
@@ -808,6 +819,45 @@ def pipelined_value_and_grad(params: Dict, batch: Dict,
             return total
 
         return jax.value_and_grad(_loss)(params)
+
+    if schedule == "interleaved":
+        from horovod_tpu.parallel import pipeline as _pl
+
+        tokens, targets = batch["tokens"], batch["targets"]
+        B, S = tokens.shape
+        M, _, stage_fn = _pipeline_stage_setup(
+            params, cfg, axis_name, B, n_microbatches, return_aux=aux_on)
+        v = int(n_virtual)
+        if cfg.n_layers % (v * P_):
+            raise ValueError(
+                f"n_layers={cfg.n_layers} must divide over "
+                f"{v} virtual x {P_} stages")
+
+        def _iloss(p):
+            # Chunk slices taken INSIDE the differentiated function: the
+            # dynamic_slice VJP scatters each chunk's gradient back into
+            # the full (replicated) stack, same construction as gpipe.
+            my_chunks = _pl.stack_to_chunks(p["layers"], P_, v, s)
+            x = p["embed"].astype(cfg.dtype)[tokens]
+            mbs = x.reshape(M, B // M, *x.shape[1:])
+            if aux_on:
+                outs, aux_local = _pl.interleaved_apply(
+                    stage_fn, my_chunks, mbs, axis_name=axis_name,
+                    n_virtual=v, stage_aux=True)
+            else:
+                outs = _pl.interleaved_apply(
+                    stage_fn, my_chunks, mbs, axis_name=axis_name,
+                    n_virtual=v)
+            y = outs.reshape(B, *x.shape[1:])
+            logits = _lm_head(y, p["ln_f"], p["head"], cfg)
+            raw = _xent_sum(logits, targets) / targets.size
+            total = lax.psum(jnp.where(s == P_ - 1, raw, 0.0), axis_name)
+            if aux_on:
+                total = total + cfg.moe_aux_coeff * lax.psum(
+                    aux_local, axis_name) / M
+            return total
+
+        return jax.value_and_grad(_iloss)(params)
     if schedule != "1f1b":
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
 

@@ -110,7 +110,8 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
                             schedule: str = "gpipe",
                             loss_params=None,
                             return_input_grads: bool = False,
-                            aux_weight=None):
+                            aux_weight=None,
+                            n_virtual: int = 2):
     """Microbatched pipeline training step: total loss and THIS stage's
     parameter gradients.
 
@@ -123,7 +124,10 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
       loss_fn: ``(y, target) -> scalar`` per-microbatch loss; the returned
         loss is the SUM over microbatches (scale inside ``loss_fn`` for a
         mean).
-      schedule: ``"gpipe"`` or ``"1f1b"``.
+      schedule: ``"gpipe"``, ``"1f1b"``, or ``"interleaved"`` (virtual
+        stages — ``stage_params`` stacked on a leading ``n_virtual``
+        axis, ``stage_fn`` applying one chunk; see
+        :func:`interleaved_apply`).
       loss_params: optional pytree of parameters the LOSS uses (readout
         head, final norm, ...).  When given, ``loss_fn`` is called as
         ``loss_fn(loss_params, y, target)`` and its parameter gradients
@@ -193,15 +197,26 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
     def _apply_loss(lp, y, tgt):
         return loss_fn(lp, y, tgt) if has_lp else loss_fn(y, tgt)
 
-    if schedule == "gpipe":
+    if schedule in ("gpipe", "interleaved"):
+        # Both schedules share the forward-then-autodiff-reverse
+        # construction; "interleaved" runs the chunked virtual-stage
+        # schedule (stage_params stacked on a leading n_virtual axis,
+        # stage_fn applying ONE chunk) with the bubble divided by ~v.
+        if schedule == "interleaved":
+            def _apply(params, mbs, **akw):
+                return interleaved_apply(stage_fn, params, mbs,
+                                         axis_name=axis_name,
+                                         n_virtual=n_virtual, **akw)
+        else:
+            def _apply(params, mbs, **akw):
+                return pipeline_apply(stage_fn, params, mbs,
+                                      axis_name=axis_name, **akw)
+
         def total_loss(params, lp, mbs):
             if aux_weight is not None:
-                outs, aux_local = pipeline_apply(
-                    stage_fn, params, mbs, axis_name=axis_name,
-                    stage_aux=True)
+                outs, aux_local = _apply(params, mbs, stage_aux=True)
             else:
-                outs = pipeline_apply(stage_fn, params, mbs,
-                                      axis_name=axis_name)
+                outs = _apply(params, mbs)
             losses = jax.vmap(lambda y, t: _apply_loss(lp, y, t))(
                 outs, targets)
             # Gate the (replicated) loss to the last stage and psum: the
@@ -355,6 +370,116 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
     if return_input_grads:
         extras["input_grads"] = xgacc[:M]
     return loss, gacc, extras
+
+
+def interleaved_apply(stage_fn: Callable, chunk_params, microbatches,
+                      *, axis_name: str = "pp", n_virtual: int,
+                      stage_aux: bool = False):
+    """Forward pass of the INTERLEAVED (virtual-stage) pipeline: the layer
+    stack splits into ``L = n_virtual * P`` chunks laid round-robin on the
+    P devices (chunk j lives on device ``j % P`` — Megatron-LM's
+    interleaved assignment), so the fill/drain bubble shrinks to chunk
+    granularity: ``P-1`` chunk-ticks instead of ``P-1`` full-stage ticks —
+    bubble fraction ``(P-1)/(M·v + P-1)``, i.e. the non-interleaved
+    bubble divided by ~v, at the price of ``v×`` the stage-boundary
+    ppermute traffic.
+
+    Microbatches are processed in groups of P (``M % P == 0`` required):
+    device d's local step k runs chunk ``(k mod vP) // P`` on microbatch
+    ``(k // vP)·P + (k mod P)``; every consecutive (chunk, microbatch)
+    hand-off lands exactly one tick later on the right ring neighbour, so
+    ONE ppermute wire carries all v virtual stages.
+
+    Args:
+      stage_fn: ``(one_chunk_params, x) -> y`` (shape-preserving), or
+        ``-> (y, aux)`` with ``stage_aux``.
+      chunk_params: this device's chunks, stacked on a leading ``v`` axis
+        (chunk ``v_idx`` of device d is global chunk ``v_idx * P + d``).
+      microbatches: ``(M, mb, ...)``, replicated over the axis.
+      n_virtual: v, virtual stages (chunks) per device.
+
+    Returns: like :func:`pipeline_apply` — last chunk's outputs broadcast
+    to the axis (+ ``aux_local`` with ``stage_aux``).  Differentiable:
+    ``jax.grad`` through the scan reverses the schedule, giving the
+    interleaved backward automatically.
+    """
+    P = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    v = int(n_virtual)
+    if v < 1:
+        raise ValueError(f"n_virtual must be >= 1, got {v}")
+    if M % P:
+        raise ValueError(
+            f"interleaved schedule needs n_microbatches ({M}) divisible by "
+            f"the pipeline width ({P}) — microbatches run in groups of P")
+    right = [(i, (i + 1) % P) for i in range(P)]
+    T = M * v + P - 1
+    mb_shape = microbatches.shape[1:]
+    dtype = microbatches.dtype
+
+    def tick(carry, t):
+        buf, outbuf, aacc = carry
+        k = t - s  # this device's local step
+        valid = (k >= 0) & (k < M * v)
+        kc = jnp.clip(k, 0, M * v - 1)
+        g = kc // (v * P)          # microbatch group
+        within = kc % (v * P)
+        c = within // P            # which of my v chunks
+        m = g * P + (within % P)   # microbatch index
+        fresh = microbatches[jnp.clip(m, 0, M - 1)]
+        # Chunk 0 on device 0 reads the schedule's fresh microbatch;
+        # everything else consumes what arrived on the ring last tick.
+        x = jnp.where((s == 0) & (c == 0), fresh, buf)
+        x = jnp.where(valid, x, jnp.zeros(mb_shape, dtype))
+        my_chunk = jax.tree_util.tree_map(
+            lambda l: lax.dynamic_index_in_dim(l, c, keepdims=False),
+            chunk_params)
+        if stage_aux:
+            y, aux = stage_fn(my_chunk, x)
+            aacc = aacc + jnp.where(valid, aux, 0.0)
+        else:
+            y = stage_fn(my_chunk, x)
+        # The LAST logical chunk (v-1 on device P-1) emits microbatch m.
+        emit = valid & (s == P - 1) & (c == v - 1)
+        slot = jnp.where(emit, m, M)  # scratch slot M for non-emitting
+        outbuf = lax.dynamic_update_index_in_dim(
+            outbuf, jnp.where(emit, y, jnp.zeros_like(y)), slot, axis=0)
+        return (lax.ppermute(y, axis_name, right), outbuf, aacc), None
+
+    def vzeros(shape, dt):
+        return jnp.zeros(shape, dt) + (s * 0).astype(dt)
+
+    (_, outbuf, aux_local), _ = lax.scan(
+        tick,
+        (vzeros(mb_shape, dtype), vzeros((M + 1,) + mb_shape, dtype),
+         vzeros((), jnp.float32)),
+        jnp.arange(T))
+    # Only device P-1 wrote real outputs; psum broadcasts them.
+    out = lax.psum(outbuf[:M], axis_name)
+    return (out, aux_local) if stage_aux else out
+
+
+def stack_to_chunks(stacked, n_stages: int, n_virtual: int, stage_index):
+    """Slice a ``(n_layers, ...)`` scanned-layer pytree into THIS device's
+    ``(n_virtual, layers_per_chunk, ...)`` interleaved chunks (global
+    chunk ``v_idx * n_stages + stage_index``; pass ``stage_index =
+    lax.axis_index(axis)`` inside shard_map)."""
+    L = n_stages * n_virtual
+
+    def slice_chunks(leaf):
+        n = leaf.shape[0]
+        if n % L:
+            raise ValueError(
+                f"{n} layers do not divide into {L} interleaved chunks")
+        per = n // L
+        return jnp.stack([
+            lax.dynamic_slice_in_dim(
+                leaf, (vi * n_stages + stage_index) * per, per, 0)
+            for vi in range(n_virtual)
+        ])
+
+    return jax.tree_util.tree_map(slice_chunks, stacked)
 
 
 def stack_to_stages(stacked, n_stages: int):
