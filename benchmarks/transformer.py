@@ -55,10 +55,16 @@ def main() -> None:
     ap.add_argument("--n-experts", type=int, default=0,
                     help="MoE experts per layer (0 = dense MLP)")
     ap.add_argument("--moe-impl", default="switch",
-                    choices=["switch", "dense"],
+                    choices=["switch", "dense", "dropless"],
                     help="MoE dispatch: sparse capacity-factor token "
-                         "dispatch (each token computes ONE expert) or "
-                         "the dense all-experts oracle")
+                         "dispatch (each token computes ONE expert), "
+                         "the dense all-experts oracle, or grouped "
+                         "ragged matmuls (dropless, serving path)")
+    ap.add_argument("--moe-dispatch", default="sort",
+                    choices=["sort", "cumsum"],
+                    help="switch dispatch mechanism (sort = argsort + "
+                         "gathers; cumsum = one-hot running-position "
+                         "oracle)")
     ap.add_argument("--capacity-factor", type=float, default=1.25)
     ap.add_argument("--kv-heads", type=int, default=0,
                     help="grouped-query attention: K/V heads "
@@ -82,6 +88,7 @@ def main() -> None:
         remat_policy=args.remat_policy,
         n_experts=args.n_experts,
         moe_impl=args.moe_impl,
+        moe_dispatch=args.moe_dispatch,
         capacity_factor=args.capacity_factor,
         n_kv_heads=args.kv_heads,
     )
@@ -196,7 +203,9 @@ def main() -> None:
         "metric": (f"TransformerLM d{args.d_model} L{args.n_layers} "
                    f"seq{args.seq}"
                    + (f" moe{args.n_experts}-{args.moe_impl}"
-                      f"-cf{args.capacity_factor:g}"
+                      + (f"-{args.moe_dispatch}"
+                         if args.moe_impl == "switch" else "")
+                      + f"-cf{args.capacity_factor:g}"
                       if args.n_experts > 1 else "")
                    + f" {args.attention}-attention train "
                    f"throughput per chip"),

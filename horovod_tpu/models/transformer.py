@@ -53,6 +53,10 @@ class TransformerConfig:
     # accepts ceil(cf * T / E) tokens per step; overflow tokens pass
     # through the residual only (standard Switch training behavior).
     capacity_factor: float = 2.0
+    # Switch dispatch mechanism: "sort" (argsort + gathers — the TPU
+    # fast path) or "cumsum" (one-hot running-position oracle).  Both
+    # produce identical outputs, gradients, and drop patterns.
+    moe_dispatch: str = "sort"
     # Mesh axis for expert parallelism when running under shard_map
     # (None = single-device sparse dispatch; the GSPMD/jit path shards
     # the expert axis via param_specs instead).
@@ -297,24 +301,34 @@ def _moe_mlp_dense(x, p, cfg: TransformerConfig, return_aux: bool = False):
 
 def _moe_mlp(x, p, cfg: TransformerConfig, impl: Optional[str] = None,
              return_aux: bool = False):
-    """Mixture-of-experts FFN; ``impl`` overrides ``cfg.moe_impl`` (the
-    decode path forces "dense": per-step token counts are tiny and the
-    capacity-drop pattern is a training-time behavior).  With
-    ``return_aux`` also returns the layer's Switch load-balancing loss
-    (ops/moe.py switch_moe(return_aux=True); same formula for dense)."""
+    """Mixture-of-experts FFN; ``impl`` overrides ``cfg.moe_impl``:
+    "switch" (capacity-factor sparse dispatch — training), "dense"
+    (every-expert oracle — per-step decode, tiny E), "dropless"
+    (grouped ragged matmuls, exact at 1/E dense FLOPs — prefill/serving).
+    With ``return_aux`` also returns the layer's Switch load-balancing
+    loss (ops/moe.py switch_moe(return_aux=True); same formula for
+    dense)."""
     impl = impl or cfg.moe_impl
     if impl == "dense":
         return _moe_mlp_dense(x, p, cfg, return_aux=return_aux)
-    if impl != "switch":
-        raise ValueError(f"unknown moe_impl {impl!r}; "
-                         "expected 'switch' or 'dense'")
     from horovod_tpu.ops import moe
 
+    if impl == "dropless":
+        if return_aux:
+            raise ValueError(
+                "moe_impl='dropless' is the serving dispatch — train with "
+                "'switch' (+ moe_aux_coeff) for the balance loss")
+        return moe.dropless_moe(
+            x, p["router"], p["w_gate"].astype(cfg.dtype),
+            p["w_up"].astype(cfg.dtype), p["w_down"].astype(cfg.dtype))
+    if impl != "switch":
+        raise ValueError(f"unknown moe_impl {impl!r}; "
+                         "expected 'switch', 'dense', or 'dropless'")
     return moe.switch_moe(
         x, p["router"], p["w_gate"].astype(cfg.dtype),
         p["w_up"].astype(cfg.dtype), p["w_down"].astype(cfg.dtype),
         capacity_factor=cfg.capacity_factor, axis_name=cfg.moe_axis,
-        return_aux=return_aux)
+        return_aux=return_aux, dispatch=cfg.moe_dispatch)
 
 
 def _mlp_block(x, p, cfg: TransformerConfig, moe_impl: Optional[str] = None,
@@ -576,11 +590,16 @@ def _attention_prefill(x, p, cfg: TransformerConfig):
     return _out_proj(oh, p, cfg), kh, vh
 
 
-def prefill(params: Dict, prompt, cache: Dict, cfg: TransformerConfig):
+def prefill(params: Dict, prompt, cache: Dict, cfg: TransformerConfig,
+            *, moe_impl: str = "dropless"):
     """Fill a FRESH cache with a (B, S0) prompt in ONE forward pass
     (the serving-shape prefill: batched MXU work instead of S0 serial
     decode steps) and return ``(last-position logits (B, V), cache)``
-    with ``pos = S0``.  Continue with :func:`decode_step`."""
+    with ``pos = S0``.  Continue with :func:`decode_step`.
+
+    ``moe_impl`` selects the MoE dispatch for MoE configs: "dropless"
+    (grouped ragged matmuls — exact at 1/E of dense FLOPs, the default)
+    or "dense" (the every-expert oracle; benchmarking/fallback)."""
     pos = cache["pos"]
     if not isinstance(pos, jax.core.Tracer) and int(pos) != 0:
         raise ValueError("prefill requires a fresh cache (pos == 0)")
@@ -594,7 +613,11 @@ def prefill(params: Dict, prompt, cache: Dict, cfg: TransformerConfig):
 
     def layer(x, p):
         h, kh, vh = _attention_prefill(_rmsnorm(x, p["ln1"]), p, cfg)
-        return _mlp_block(x + h, p, cfg, moe_impl="dense"), (kh, vh)
+        # Prefill ingests whole prompts: DROPLESS grouped-matmul dispatch
+        # by default — exact like dense but 1/E of its FFN FLOPs
+        # (ops/moe.py dropless_moe).  Per-step decode keeps dense (a
+        # handful of tokens; ragged grouping buys nothing there).
+        return _mlp_block(x + h, p, cfg, moe_impl=moe_impl), (kh, vh)
 
     x, (k_all, v_all) = lax.scan(layer, x, params["layers"])
     # Only the last position's logits are needed: slice BEFORE the

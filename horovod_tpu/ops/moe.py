@@ -48,6 +48,52 @@ def capacity(T: int, n_experts: int, capacity_factor: float) -> int:
     return max(1, min(cap, T))
 
 
+def _cumsum_dispatch(xt, e_star, E: int, cap: int):
+    """Original dispatch: f32 one-hot running-position cumsum + row
+    scatter into the (E, cap+1, D) buffer.  Kept as the oracle and the
+    fallback; the sort dispatch below is the fast path on TPU."""
+    T, D = xt.shape
+    onehot = jax.nn.one_hot(e_star, E, dtype=jnp.float32)
+    pos = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1).astype(jnp.int32) - 1
+    keep = pos < cap
+    slot = jnp.where(keep, pos, cap)  # dropped tokens -> scratch slot
+    buf = jnp.zeros((E, cap + 1, D), xt.dtype).at[e_star, slot].set(xt)
+    frac = onehot.mean(axis=0)
+    return buf[:, :cap], jnp.where(keep, pos, cap), keep, frac
+
+
+def _sort_dispatch(xt, e_star, E: int, cap: int):
+    """Sort-based dispatch: argsort tokens by expert (stable — original
+    arrival order within an expert is preserved, so drop semantics match
+    the cumsum oracle exactly), then build the (E, cap, D) buffer with
+    ONE row gather (row (e, c) = sorted token ``starts[e] + c``).  No
+    row scatter and no (T, E) f32 cumsum — the two ops that made the
+    cumsum dispatch eat the MFU on chip (only 1-D int sorts/scatters
+    remain, plus the unavoidable row gathers whose VJPs are the
+    scatter-adds autodiff inserts in the backward)."""
+    T, D = xt.shape
+    e32 = e_star.astype(jnp.int32)
+    order = jnp.argsort(e32, stable=True)
+    es = e32[order]
+    eye = jnp.arange(E, dtype=e32.dtype)
+    starts = jnp.searchsorted(es, eye).astype(jnp.int32)
+    counts = (jnp.searchsorted(es, eye, side="right").astype(jnp.int32)
+              - starts)
+    pos_sorted = jnp.arange(T, dtype=jnp.int32) - starts[es]
+    xs = xt[order]
+    rowidx = starts[:, None] + jnp.arange(cap, dtype=jnp.int32)[None]
+    rowvalid = jnp.arange(cap, dtype=jnp.int32)[None] < counts[:, None]
+    buf = jnp.where(rowvalid[..., None],
+                    xs[jnp.clip(rowidx, 0, T - 1)],
+                    jnp.zeros((), xt.dtype))
+    # Per-original-token slot: unsort the within-expert positions (1-D
+    # int32 scatter — cheap, unlike a (T, D) row scatter).
+    slot = jnp.zeros((T,), jnp.int32).at[order].set(pos_sorted)
+    keep = slot < cap
+    frac = counts.astype(jnp.float32) / T
+    return buf, jnp.where(keep, slot, cap), keep, frac
+
+
 def switch_moe(
     x,
     router,
@@ -58,6 +104,7 @@ def switch_moe(
     capacity_factor: float = 2.0,
     axis_name: Optional[str] = None,
     return_aux: bool = False,
+    dispatch: str = "sort",
 ):
     """Top-1 expert-parallel MoE FFN.
 
@@ -74,6 +121,12 @@ def switch_moe(
         expert's FFN).
       return_aux: also return the Switch load-balancing auxiliary loss
         ``E * sum_e fraction_e * mean_prob_e`` (1.0 at perfect balance).
+      dispatch: ``"sort"`` (argsort + gathers — the fast path on TPU,
+        where row scatters and the (T, E) f32 running-position cumsum
+        dominate the dispatch cost) or ``"cumsum"`` (the original
+        formulation, kept as the oracle).  Identical results including
+        drop patterns: the stable sort preserves each expert's original
+        arrival order.
 
     Returns:
       ``y`` shaped like ``x`` (add it to the residual stream), or
@@ -90,24 +143,20 @@ def switch_moe(
             f"router routes over {router.shape[1]} experts but the expert "
             f"stack provides {E_loc} local x {ep} devices = {E} "
             "(sharded weights outside shard_map, or axis_name missing?)")
+    if dispatch not in ("sort", "cumsum"):
+        raise ValueError(f"unknown dispatch {dispatch!r}; "
+                         "expected 'sort' or 'cumsum'")
     dt = x.dtype
 
     logits = xt.astype(jnp.float32) @ router.astype(jnp.float32)  # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
     e_star = jnp.argmax(probs, axis=-1)  # (T,)
     gate = jnp.max(probs, axis=-1)  # (T,)
-    onehot = jax.nn.one_hot(e_star, E, dtype=jnp.float32)
 
     cap = capacity(T, E, capacity_factor)
-    # Position of each token within its expert's arrivals (static order).
-    pos = (jnp.cumsum(onehot, axis=0) * onehot).sum(-1).astype(jnp.int32) - 1
-    keep = pos < cap
+    dispatch_fn = _sort_dispatch if dispatch == "sort" else _cumsum_dispatch
+    buf, slot, keep, frac = dispatch_fn(xt, e_star, E, cap)
     gate = jnp.where(keep, gate, 0.0)
-    slot = jnp.where(keep, pos, cap)  # dropped tokens -> scratch slot
-
-    # Scatter tokens into the (E, cap, D) dispatch buffer (+1 scratch).
-    buf = jnp.zeros((E, cap + 1, D), dt).at[e_star, slot].set(xt)
-    buf = buf[:, :cap]
 
     if ep > 1:
         # (ep * E_loc, cap, D): chunk e goes to device e // E_loc.  After
@@ -139,7 +188,48 @@ def switch_moe(
     y = (y * gate[:, None].astype(dt)).reshape(*lead, D)
     if not return_aux:
         return y
-    frac = onehot.mean(axis=0)  # routed fraction per expert (pre-drop)
     pbar = probs.mean(axis=0)
-    aux = E * jnp.sum(frac * pbar)
+    aux = E * jnp.sum(frac * pbar)  # frac = routed fraction (pre-drop)
     return y, aux
+
+
+def dropless_moe(x, router, w_gate, w_up, w_down):
+    """Top-1 MoE FFN, DROPLESS, via grouped (ragged) matmuls: sort tokens
+    by expert, run the three FFN matmuls as ``lax.ragged_dot`` with the
+    per-expert group sizes, unsort, scale by the gate.
+
+    Exact (== the dense dispatch oracle — no capacity, nothing dropped)
+    at 1/E of dense FLOPs: each token touches only its own expert's
+    weights, and the grouped matmuls stay MXU-shaped.  This is the
+    SERVING dispatch: prefill uses it so an E-expert model ingests a
+    prompt at 1× FFN cost instead of dense's E× (training keeps
+    capacity-factor :func:`switch_moe` — fixed shapes and the one
+    all_to_all each way under ``ep``; per-step decode keeps dense — a
+    handful of tokens).  Single-device or tp-sharded; no ep axis
+    (ragged group sizes are data-dependent, which an all_to_all cannot
+    carry statically)."""
+    lead, D = x.shape[:-1], x.shape[-1]
+    xt = x.reshape(-1, D)
+    T = xt.shape[0]
+    E = router.shape[1]
+    dt = x.dtype
+
+    logits = xt.astype(jnp.float32) @ router.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    e_star = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+    gate = jnp.max(probs, axis=-1)
+
+    order = jnp.argsort(e_star, stable=True)
+    xs = xt[order]
+    es = e_star[order]
+    eye = jnp.arange(E, dtype=jnp.int32)
+    counts = (jnp.searchsorted(es, eye, side="right")
+              - jnp.searchsorted(es, eye)).astype(jnp.int32)
+
+    g = lax.ragged_dot(xs, w_gate.astype(dt), counts)
+    u = lax.ragged_dot(xs, w_up.astype(dt), counts)
+    y_s = lax.ragged_dot(jax.nn.silu(g) * u, w_down.astype(dt), counts)
+
+    inv = jnp.argsort(order)  # unsort permutation
+    y = y_s[inv] * gate[:, None].astype(dt)
+    return y.reshape(*lead, D)

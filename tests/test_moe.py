@@ -92,6 +92,64 @@ class TestSwitchDispatchLocal:
                                 return_aux=True)
         np.testing.assert_allclose(float(aux), 1.0, atol=0.05)
 
+    def test_sort_dispatch_identical_to_cumsum(self):
+        """The sort-based fast dispatch must reproduce the cumsum oracle
+        EXACTLY — outputs, every gradient, and the drop pattern (stable
+        sort preserves each expert's arrival order) — both dropless and
+        under forced overflow."""
+        E, D, F, T = 4, 16, 32, 24
+        p = _params(jax.random.PRNGKey(0), E, D, F)
+
+        def run(x, cf, dispatch):
+            def loss(p):
+                y, aux = moe.switch_moe(
+                    x, p["router"], p["w_gate"], p["w_up"], p["w_down"],
+                    capacity_factor=cf, dispatch=dispatch, return_aux=True)
+                return jnp.sum(y ** 2) + 0.1 * aux, y
+
+            (l, y), g = jax.value_and_grad(loss, has_aux=True)(p)
+            return l, y, g
+
+        x = jax.random.normal(jax.random.PRNGKey(1), (T, D))
+        for cf in (float(E), 1.0, 0.5):  # dropless, tight, overflowing
+            l_s, y_s, g_s = run(x, cf, "sort")
+            l_c, y_c, g_c = run(x, cf, "cumsum")
+            np.testing.assert_array_equal(np.asarray(y_s), np.asarray(y_c))
+            np.testing.assert_allclose(float(l_s), float(l_c), rtol=1e-7)
+            for k in p:
+                np.testing.assert_allclose(
+                    np.asarray(g_s[k]), np.asarray(g_c[k]),
+                    atol=1e-6, rtol=1e-6, err_msg=f"cf={cf} {k}")
+
+    def test_sort_dispatch_ep2_matches_local(self):
+        """Sort dispatch under the ep all_to_all exchange (the buffer
+        contract is dispatch-mechanism independent)."""
+        E, D, F, T_loc, EP = 4, 16, 32, 12, 2
+        p = _params(jax.random.PRNGKey(0), E, D, F)
+        x = jax.random.normal(jax.random.PRNGKey(1), (EP, T_loc, D))
+        mesh = Mesh(np.array(jax.devices()[:EP]), axis_names=("ep",))
+
+        out = jax.jit(jax.shard_map(
+            lambda x, r, wg, wu, wd: moe.switch_moe(
+                x[0], r, wg, wu, wd, capacity_factor=1.25, axis_name="ep",
+                dispatch="sort")[None],
+            mesh=mesh,
+            in_specs=(P("ep"), P(), P("ep"), P("ep"), P("ep")),
+            out_specs=P("ep")))(
+            x, p["router"], p["w_gate"], p["w_up"], p["w_down"])
+        for s in range(EP):
+            ref = moe.switch_moe(x[s], p["router"], p["w_gate"], p["w_up"],
+                                 p["w_down"], capacity_factor=1.25,
+                                 dispatch="cumsum")
+            np.testing.assert_allclose(np.asarray(out[s]), np.asarray(ref),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_bad_dispatch_raises(self):
+        p = _params(jax.random.PRNGKey(0), 2, 8, 16)
+        with pytest.raises(ValueError, match="dispatch"):
+            moe.switch_moe(jnp.zeros((4, 8)), p["router"], p["w_gate"],
+                           p["w_up"], p["w_down"], dispatch="bogus")
+
     def test_flops_flat_in_experts(self):
         """The headline claim, statically: dense dispatch FLOPs grow with
         E; switch dispatch FLOPs stay ~flat (total expert compute is
@@ -120,6 +178,66 @@ class TestSwitchDispatchLocal:
         assert d8 > d2 * 3, (d2, d8)  # dense: ~linear in E
         assert s8 < s2 * 1.5, (s2, s8)  # switch: ~flat in E
         assert s8 < d8 / 2.5, (s8, d8)  # and far below dense at E=8
+
+
+class TestDroplessMoE:
+    def test_matches_dense_oracle_outputs_and_grads(self):
+        """Grouped ragged-matmul dispatch is EXACT (nothing dropped): it
+        must match the dense every-expert oracle at 1/E of its FLOPs —
+        the serving/prefill dispatch."""
+        E, D, F, T = 4, 16, 32, 24
+        p = _params(jax.random.PRNGKey(0), E, D, F)
+        x = jax.random.normal(jax.random.PRNGKey(1), (T, D))
+
+        def loss_dl(p):
+            return jnp.sum(moe.dropless_moe(
+                x, p["router"], p["w_gate"], p["w_up"], p["w_down"]) ** 2)
+
+        def loss_dense(p):
+            return jnp.sum(_dense_oracle(x, p) ** 2)
+
+        l_d, g_d = jax.value_and_grad(loss_dl)(p)
+        l_o, g_o = jax.value_and_grad(loss_dense)(p)
+        np.testing.assert_allclose(float(l_d), float(l_o), rtol=1e-5)
+        for k in p:
+            np.testing.assert_allclose(
+                np.asarray(g_d[k]), np.asarray(g_o[k]),
+                atol=1e-4, rtol=1e-4, err_msg=k)
+
+    def test_skewed_routing_still_exact(self):
+        """All tokens on one expert — the case capacity dispatch drops;
+        dropless must still equal the oracle."""
+        E, D, F, T = 2, 8, 16, 10
+        p = _params(jax.random.PRNGKey(0), E, D, F)
+        p["router"] = jnp.eye(D, E) * 50.0
+        x = jnp.abs(jax.random.normal(jax.random.PRNGKey(1), (T, D)))
+        y = moe.dropless_moe(x, p["router"], p["w_gate"], p["w_up"],
+                             p["w_down"])
+        np.testing.assert_allclose(np.asarray(y), np.asarray(_dense_oracle(x, p)),
+                                   atol=1e-5, rtol=1e-5)
+
+    def test_dropless_flops_fraction_of_dense(self):
+        """Static cost: dropless FFN FLOPs must be ~1/E of dense's.
+
+        Platform-dependent: the TPU lowering of ragged_dot is truly
+        grouped (measured on chip: 2.1 GF vs dense's 17.2 GF at E=8 —
+        docs/benchmarks.md), but the CPU lowering masks full matmuls, so
+        the assertion only holds off-CPU.  The exactness tests above run
+        everywhere."""
+        if jax.default_backend() == "cpu":
+            pytest.skip("CPU lowers ragged_dot to masked dense matmuls; "
+                        "the 1/E cost claim is asserted on TPU")
+        E, D, F, T = 8, 64, 128, 256
+        p = _params(jax.random.PRNGKey(0), E, D, F)
+        x = jax.random.normal(jax.random.PRNGKey(1), (T, D))
+
+        def flops(fn):
+            return jax.jit(fn).lower(x).compile().cost_analysis()["flops"]
+
+        fd = flops(lambda x: _dense_oracle(x, p))
+        fl = flops(lambda x: moe.dropless_moe(
+            x, p["router"], p["w_gate"], p["w_up"], p["w_down"]))
+        assert fl < fd / (E / 2), (fl, fd)
 
 
 class TestSwitchDispatchExpertParallel:
