@@ -1,0 +1,110 @@
+"""Distributed skip-gram word2vec in JAX with SPARSE embedding-gradient
+reduction (the IndexedSlices-allgather analogue; reference
+``examples/tensorflow_word2vec.py`` + ``tensorflow/__init__.py:74-89``).
+
+Each step touches a few hundred rows of the embedding tables, so
+``DistributedOptimizer(..., sparse_keys=("embed",))`` reduces those
+leaves by allgathering (indices, values) instead of allreducing the
+dense tables — wire traffic scales with the batch's vocabulary slice,
+not the table.  The run prints measured wire bytes sparse-vs-dense.
+
+    horovodrun -np 2 python examples/jax_word2vec.py
+
+Synthetic Zipf corpus so the example runs hermetically.  The training
+loop is EAGER (like the reference's tape) — that is where the sparse
+route engages; under jit, gradients are static-shape dense.
+"""
+
+import numpy as np
+
+import jax
+
+# CPU demo (must run before any backend init): the sparse reduction is a
+# host-side eager path, and N launcher ranks should not all grab the
+# accelerator.  Delete this line to run on real chips.
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import sparse as SP
+
+VOCAB = 2000
+DIM = 64
+WINDOW = 2
+BATCH = 256
+NEG = 4
+
+
+def synthetic_corpus(n=50_000, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.zipf(1.3, n).clip(max=VOCAB - 1).astype(np.int32)
+
+
+def batches(corpus, seed):
+    rng = np.random.RandomState(seed)
+    while True:
+        centers = rng.randint(WINDOW, len(corpus) - WINDOW, BATCH)
+        offs = rng.randint(1, WINDOW + 1, BATCH) * rng.choice([-1, 1], BATCH)
+        ctx = corpus[centers + offs]
+        neg = rng.randint(0, VOCAB, (BATCH, NEG)).astype(np.int32)
+        yield corpus[centers], ctx, neg
+
+
+def loss_fn(params, center, ctx, neg):
+    """Negative-sampling skip-gram loss."""
+    v = params["in_embed"][center]           # (B, D)
+    u_pos = params["out_embed"][ctx]         # (B, D)
+    u_neg = params["out_embed"][neg]         # (B, NEG, D)
+    pos = jax.nn.log_sigmoid(jnp.sum(v * u_pos, -1))
+    negs = jax.nn.log_sigmoid(-jnp.einsum("bd,bnd->bn", v, u_neg))
+    return -(pos.mean() + negs.sum(-1).mean())
+
+
+def main():
+    hvd.init()
+    rank = hvd.process_rank()
+    rng = np.random.RandomState(0)
+    params = {
+        "in_embed": jnp.asarray(
+            rng.uniform(-0.5 / DIM, 0.5 / DIM, (VOCAB, DIM)), jnp.float32),
+        "out_embed": jnp.zeros((VOCAB, DIM), jnp.float32),
+    }
+    opt = hvd.DistributedOptimizer(optax.adagrad(0.5),
+                                   sparse_keys=("embed",))
+    state = opt.init(params)
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    grad = jax.jit(jax.value_and_grad(loss_fn))
+    stream = batches(synthetic_corpus(), seed=rank)
+    sparse_bytes = dense_bytes = 0
+    for step in range(60):
+        center, ctx, neg = next(stream)
+        loss, g = grad(params, center, ctx, neg)
+        g = {k: np.asarray(v) for k, v in g.items()}  # eager: sparse path
+        for v in g.values():  # wire accounting (same math the path does)
+            rows = np.flatnonzero(np.any(v != 0, axis=1))
+            sparse_bytes += rows.nbytes + v[rows].nbytes
+            dense_bytes += v.nbytes
+        up, state = opt.update(g, state, params)
+        params = jax.tree_util.tree_map(lambda p, u: p + u, params, up)
+        if rank == 0 and step % 20 == 0:
+            print(f"step {step:3d}  loss {float(loss):.4f}")
+
+    # Independent check (collective — every rank participates): one
+    # sparse reduction equals the dense one.
+    probe = np.asarray(g["in_embed"])
+    np.testing.assert_allclose(
+        SP.sparse_allreduce(probe, hvd.Average, name="w2v.check"),
+        np.asarray(hvd.allreduce(probe, hvd.Average, name="w2v.ref")),
+        rtol=1e-6)
+    if rank == 0:
+        print(f"wire bytes: sparse {sparse_bytes:,} vs dense "
+              f"{dense_bytes:,} ({dense_bytes / sparse_bytes:.1f}x saved)")
+        print("sparse == dense reduction: OK")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

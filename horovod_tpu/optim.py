@@ -34,13 +34,43 @@ def distributed_gradients(
     compression=Compression.none,
     fuse: bool = True,
     fusion_threshold: Optional[int] = None,
+    sparse_keys=(),
 ):
     """Allreduce a gradient pytree (the reference's
     ``_make_allreduce_grads_fn``, ``tensorflow/__init__.py:230-251``).
 
     ``fuse=True`` buckets leaves into large flat collectives
     (:mod:`horovod_tpu.ops.fusion`); compression casts to 16-bit for the
-    wire and restores dtype after (``tensorflow/compression.py``)."""
+    wire and restores dtype after (``tensorflow/compression.py``).
+
+    ``sparse_keys``: tree-path substrings (e.g. ``("embed",)``) whose
+    EAGER leaves reduce by allgathering touched rows instead of the
+    dense allreduce — the reference's IndexedSlices path
+    (``tensorflow/__init__.py:74-89``), re-created for JAX's dense
+    lookup VJPs by row-sparsity detection
+    (:func:`horovod_tpu.ops.sparse.sparse_allreduce`).  Traced leaves
+    (inside jit) always reduce dense — static shapes; compression is
+    not applied to the sparse leaves (their values ride the wire
+    already-small)."""
+    if sparse_keys and op in (C.Average, C.Sum):
+        from horovod_tpu.ops import sparse as SP
+
+        treedef, dense, sparse = SP.split_sparse_leaves(
+            grads, tuple(sparse_keys))
+        if sparse:
+            idx = [i for i, l in enumerate(dense) if l is not None]
+            reduced = distributed_gradients(
+                [dense[i] for i in idx], op, axis_name=axis_name,
+                compression=compression, fuse=fuse,
+                fusion_threshold=fusion_threshold)
+            out = [None] * len(dense)
+            for i, r in zip(idx, reduced):
+                out[i] = r
+            red_sparse = [
+                (i, SP.sparse_allreduce(leaf, op, name=f"sparse.{i}"))
+                for i, _key, leaf in sparse
+            ]
+            return SP.merge_sparse_leaves(treedef, out, red_sparse)
     grads, ctx = compression.compress(grads)
     if fuse and op in (C.Average, C.Sum):
         out = F.fused_allreduce_tree(
@@ -67,6 +97,7 @@ def DistributedOptimizer(
     axis_name=None,
     fuse: bool = True,
     fusion_threshold: Optional[int] = None,
+    sparse_keys=(),
 ) -> optax.GradientTransformation:
     """Wrap an optax optimizer so updates are computed from
     cross-worker-reduced gradients.
@@ -79,6 +110,9 @@ def DistributedOptimizer(
       updates (``torch/__init__.py:95-157``).
     * ``average_aggregated_gradients`` divides the accumulated sum by k
       before reduction (``tensorflow/__init__.py:328-365``).
+    * ``sparse_keys`` — embedding-shaped leaves reduce sparsely on the
+      eager path (see :func:`distributed_gradients`; the reference's
+      IndexedSlices allgather, ``tensorflow/__init__.py:74-89``).
     """
     if backward_passes_per_step < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
@@ -91,6 +125,7 @@ def DistributedOptimizer(
             compression=compression,
             fuse=fuse,
             fusion_threshold=fusion_threshold,
+            sparse_keys=sparse_keys,
         )
 
     if backward_passes_per_step == 1:
@@ -252,13 +287,16 @@ def DistributedGradientTape(
     axis_name=None,
     has_aux: bool = False,
     fuse: bool = True,
+    sparse_keys=(),
 ):
     """Return ``value_and_grad(fun)`` whose gradients are allreduced.
 
     JAX analogue of ``hvd.DistributedGradientTape``
     (``tensorflow/__init__.py:474-531``): TF tapes record eagerly, JAX
     differentiates functionally, so the "tape" is a transformed
-    ``value_and_grad``.
+    ``value_and_grad``.  ``sparse_keys`` routes embedding-shaped leaves
+    through the sparse (indices, values) allgather on the eager path —
+    the IndexedSlices analogue.
 
         loss, grads = hvd.DistributedGradientTape(loss_fn)(params, batch)
     """
@@ -267,7 +305,8 @@ def DistributedGradientTape(
     def wrapped(*args, **kwargs):
         val, grads = vg(*args, **kwargs)
         grads = distributed_gradients(
-            grads, op, axis_name=axis_name, compression=compression, fuse=fuse
+            grads, op, axis_name=axis_name, compression=compression,
+            fuse=fuse, sparse_keys=sparse_keys
         )
         return val, grads
 

@@ -240,6 +240,21 @@ def scenario_full():
             hvd.allreduce(x, hvd.Sum, name="post.join2"),
             np.full((4,), total))
 
+    # Sparse embedding-gradient reduction (the IndexedSlices-allgather
+    # analogue): touched rows OVERLAP across ranks (row 10 everywhere),
+    # and the (indices, values) allgather must equal the dense
+    # allreduce while shipping only the touched rows.
+    from horovod_tpu.ops import sparse as SP
+    emb = np.zeros((32, 4), np.float32)
+    for r_ in (rank, rank + 1, 10):
+        emb[r_] = (r_ + 1.0) * (rank + 1.0)
+    dense_ref = hvd.allreduce(emb, hvd.Average, name="spg.ref")
+    sp_out, sp_stats = SP.sparse_allreduce(
+        emb, hvd.Average, name="spg.t", return_stats=True)
+    np.testing.assert_allclose(sp_out, dense_ref, rtol=1e-6)
+    assert sp_stats["rows"] == 3 and sp_stats["total_rows"] == 32
+    assert sp_stats["sparse_bytes"] < sp_stats["dense_bytes"] / 2
+
     hvd.barrier()
     hvd.shutdown()
     print(f"NATIVE-WORKER-OK rank={rank}")
