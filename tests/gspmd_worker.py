@@ -21,6 +21,12 @@ This worker runs that full lifecycle on N launcher-spawned processes of
      resume — replayed losses must be bit-identical;
   6. prints per-step loss/param-checksum BITS so the spawning test can
      compare the 2-process run against the single-process 8-device run.
+
+With ``GSPMD_RESTORE_FROM`` set, the worker instead RESUMES from a
+checkpoint another job topology wrote (cross-topology portability: a
+pod checkpoint saved by N processes restores into M processes' mesh —
+orbax re-places shards per this job's template shardings) and prints
+the resumed losses for cross-job comparison.
 """
 
 import os
@@ -107,6 +113,37 @@ def main() -> None:
     batches = list(loader)
     assert len(batches) == STEPS
     assert batches[0]["tokens"].shape == (GLOBAL_BATCH, cfg.max_seq)
+
+    restore_from = os.environ.get("GSPMD_RESTORE_FROM")
+    if restore_from:
+        # Cross-topology resume: the checkpoint was written by a job with
+        # a DIFFERENT process layout over the same logical mesh; the
+        # sharding-carrying template makes orbax place each shard on THIS
+        # job's devices.  The logical program is identical, so the
+        # resumed losses must be bit-identical to the writer's.
+        # Scalar optimizer leaves (adam's count) from opt.init sit
+        # UNCOMMITTED on one device; as restore targets they must carry
+        # the mesh-wide placement or the restored (committed) array
+        # conflicts with the 8-device params under jit.
+        repl = NamedSharding(mesh, P())
+        opt_t = jax.tree_util.tree_map(
+            lambda l: (jax.device_put(l, repl)
+                       if isinstance(l, jax.Array) and l.ndim == 0 else l),
+            opt_state)
+        template = {"params": params, "opt_state": opt_t, "step": 0}
+        back = checkpoint.restore(os.path.join(restore_from, "state"),
+                                  template)
+        assert back["step"] == SAVE_AT
+        rparams, ropt_state = back["params"], back["opt_state"]
+        resume = []
+        for i in range(SAVE_AT, STEPS):
+            rparams, ropt_state, loss = step(rparams, ropt_state,
+                                             batches[i])
+            resume.append(bits(loss))
+        print(f"GSPMD-RESUME-OK rank={rank} nproc={nproc} "
+              f"resume={','.join(resume)}")
+        hvd.shutdown()
+        return
 
     repl = NamedSharding(mesh, P())
 

@@ -31,6 +31,9 @@ OK_RE = re.compile(
     r"GSPMD-WORKER-OK rank=(\d+) nproc=(\d+) "
     r"losses=(\S+) resume=(\S+) check=(\S+)"
 )
+RESUME_RE = re.compile(
+    r"GSPMD-RESUME-OK rank=(\d+) nproc=(\d+) resume=(\S+)"
+)
 
 
 def _free_port():
@@ -39,7 +42,7 @@ def _free_port():
         return s.getsockname()[1]
 
 
-def _run_job(tmp_path, tag, nproc, local_devices):
+def _run_job(tmp_path, tag, nproc, local_devices, restore_from=None):
     out = tmp_path / tag
     ckpt = tmp_path / f"ckpt-{tag}"
     env = {
@@ -52,6 +55,8 @@ def _run_job(tmp_path, tag, nproc, local_devices):
         "GSPMD_LOCAL_DEVICES": str(local_devices),
         "GSPMD_CKPT_DIR": str(ckpt),
     }
+    if restore_from is not None:
+        env["GSPMD_RESTORE_FROM"] = str(restore_from)
     rc = launch.launch_job(
         [sys.executable, WORKER],
         [HostSpec("localhost", 1)] * nproc,
@@ -64,13 +69,17 @@ def _run_job(tmp_path, tag, nproc, local_devices):
     )
     assert rc == 0, stderr[-4000:]
     results = {}
+    regex = RESUME_RE if restore_from is not None else OK_RE
     for r in range(nproc):
         text = (out / f"rank.{r}.stdout").read_text()
-        m = OK_RE.search(text)
+        m = regex.search(text)
         assert m, f"rank {r} produced no OK line:\n{text}\n{stderr[-2000:]}"
-        results[r] = dict(
-            losses=m.group(3), resume=m.group(4), check=m.group(5)
-        )
+        if restore_from is not None:
+            results[r] = dict(resume=m.group(3))
+        else:
+            results[r] = dict(
+                losses=m.group(3), resume=m.group(4), check=m.group(5)
+            )
     return results
 
 
@@ -91,3 +100,13 @@ class TestGspmdMultiProcess:
             multi[0]["losses"], single[0]["losses"])
         assert multi[0]["check"] == single[0]["check"], (
             multi[0]["check"], single[0]["check"])
+
+        # Cross-topology resume: the checkpoint the 2-process job wrote
+        # collaboratively restores into a DIFFERENT process layout (one
+        # process, 8 devices) and continues bit-identically — pod
+        # checkpoints are portable across deployment shapes (elastic
+        # pod-resize resume).
+        resumed = _run_job(tmp_path, "resume1", nproc=1, local_devices=8,
+                           restore_from=tmp_path / "ckpt-np2")
+        assert resumed[0]["resume"] == multi[0]["resume"], (
+            resumed[0]["resume"], multi[0]["resume"])
