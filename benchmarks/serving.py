@@ -1,0 +1,93 @@
+"""Serving-path benchmark: prefill latency + autoregressive decode
+throughput on the current chip.
+
+The decode loop is ONE compiled ``lax.scan`` (``sample_decode``), so the
+tunneled chip's ~10 ms per-call floor amortizes over all steps; timing
+closes with a value fetch of the final tokens (axon ``block_until_ready``
+returns early).  GQA rows show the KV-cache bandwidth lever
+(`n_kv_heads` shrinks the cache the decode step streams every token).
+
+    python benchmarks/serving.py [--batches 1 8 32] [--steps 128]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--d-model", type=int, default=1024)
+    ap.add_argument("--n-layers", type=int, default=8)
+    ap.add_argument("--n-heads", type=int, default=16)
+    ap.add_argument("--d-ff", type=int, default=4096)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--prompt-len", type=int, default=2048)
+    ap.add_argument("--steps", type=int, default=128)
+    ap.add_argument("--batches", type=int, nargs="+", default=[1, 8, 32])
+    ap.add_argument("--kv-heads", type=int, nargs="+", default=[0, 4])
+    ap.add_argument("--iters", type=int, default=3)
+    args = ap.parse_args()
+
+    from horovod_tpu.models import transformer as T
+
+    kind = jax.devices()[0].device_kind
+    print(f"chip={kind} d{args.d_model} L{args.n_layers} "
+          f"h{args.n_heads} d_ff{args.d_ff} vocab{args.vocab} bf16")
+
+    for kv in args.kv_heads:
+        cfg = T.TransformerConfig(
+            vocab_size=args.vocab, d_model=args.d_model,
+            n_heads=args.n_heads, n_layers=args.n_layers, d_ff=args.d_ff,
+            max_seq=args.prompt_len + args.steps,
+            n_kv_heads=kv, attention_impl="reference",
+        )
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        kv_tag = f"kv{kv or args.n_heads}"
+
+        for B in args.batches:
+            prompt = jax.random.randint(
+                jax.random.PRNGKey(1), (B, args.prompt_len), 0,
+                cfg.vocab_size, jnp.int32)
+
+            # ---- prefill latency --------------------------------------
+            pre = jax.jit(lambda p, t: T.prefill(
+                p, t, T.init_cache(cfg, B, cfg.max_seq), cfg))
+            logits, cache = pre(params, prompt)
+            float(jnp.sum(logits))  # warm + sync
+            best_pre = float("inf")
+            for _ in range(args.iters):
+                t0 = time.perf_counter()
+                logits, cache = pre(params, prompt)
+                float(jnp.sum(logits))
+                best_pre = min(best_pre, time.perf_counter() - t0)
+
+            # ---- decode throughput (one scanned call) -----------------
+            dec = jax.jit(lambda p, t: T.sample_decode(
+                p, t, args.steps, cfg, rng=jax.random.PRNGKey(2),
+                temperature=0.0))
+            toks = dec(params, prompt)
+            np.asarray(toks)  # warm + sync
+            best_dec = float("inf")
+            for _ in range(args.iters):
+                t0 = time.perf_counter()
+                toks = dec(params, prompt)
+                np.asarray(toks)
+                best_dec = min(best_dec, time.perf_counter() - t0)
+            # sample_decode includes the prefill of the prompt; subtract
+            # the measured prefill to isolate the per-token decode rate.
+            dec_time = max(best_dec - best_pre, 1e-9)
+            tps = B * args.steps / dec_time
+            per_tok_ms = dec_time / args.steps * 1e3
+            print(f"{kv_tag} B={B:<3} prefill({args.prompt_len}) "
+                  f"{best_pre * 1e3:7.1f}ms | decode {tps:8.0f} tok/s "
+                  f"({per_tok_ms:.2f} ms/token-step)")
+
+
+if __name__ == "__main__":
+    main()
