@@ -21,6 +21,15 @@ bool Controller::ComputeResponseList(std::vector<Request> pending,
   out->responses.clear();
   out->shutdown = false;
 
+  // Requests deferred by earlier cycles (cache-divergence holds) rejoin
+  // ahead of the fresh batch.
+  if (!carryover_.empty()) {
+    pending.insert(pending.begin(),
+                   std::make_move_iterator(carryover_.begin()),
+                   std::make_move_iterator(carryover_.end()));
+    carryover_.clear();
+  }
+
   // ---- Cache coordination (reference controller.cc:125-193) -------------
   // Partition pending requests into cache hits and misses, then agree
   // globally with one bit-vector AND.
@@ -30,6 +39,12 @@ bool Controller::ComputeResponseList(std::vector<Request> pending,
   std::vector<std::pair<size_t, Request>> cached;  // (bit, request)
   for (auto& req : pending) {
     if (req.type == ReqType::JOIN) {
+      uncached.push_back(std::move(req));
+      continue;
+    }
+    if (renegotiate_names_.erase(req.name) > 0) {
+      // Defer bound exceeded last cycle: force the slow path via the
+      // uncached list (clears bit0 -> globally-agreed slow round).
       uncached.push_back(std::move(req));
       continue;
     }
@@ -78,9 +93,19 @@ bool Controller::ComputeResponseList(std::vector<Request> pending,
       if (GetBit(and_bits, kFlagBits + bit)) {
         cache_.CountHit();
         cache_.Touch(bit);  // keep hot steady-state entries off the LRU tail
+        defer_counts_.erase(req.name);
         single.push_back(cache_.Get(bit));
+      } else if (++defer_counts_[req.name] <= kMaxDeferCycles) {
+        // Some peer hasn't set this bit yet (routine cycle skew): HOLD
+        // the request — next cycle usually agrees on the fast path,
+        // saving the gather+bcast renegotiation round.
+        carryover_.push_back(std::move(req));
       } else {
-        uncached.push_back(std::move(req));
+        // Held long enough; renegotiate through next cycle's uncached
+        // list so the slow round stays a globally-derived decision.
+        defer_counts_.erase(req.name);
+        renegotiate_names_.insert(req.name);
+        carryover_.push_back(std::move(req));
       }
     }
   } else {
@@ -91,27 +116,20 @@ bool Controller::ComputeResponseList(std::vector<Request> pending,
 
   // The slow path is a COLLECTIVE round: every rank must enter it whenever
   // any rank has uncached work, so the decision may only depend on the
-  // globally-agreed vectors.  Three triggers:
+  // globally-agreed vectors.  Two triggers:
   //   (1) some rank had uncached requests at submission time (bit0 AND
-  //       cleared);
-  //   (2) a cache bit diverged — set by some ranks, absent on others
-  //       (OR != AND): the setters just moved those tensors into their
-  //       uncached lists, so a round is needed even though bit0 passed;
-  //   (3) a join is in flight (everything renegotiates with join
+  //       cleared) — including requests whose defer bound expired last
+  //       cycle (renegotiate_names_ routes them through uncached);
+  //   (2) a join is in flight (everything renegotiates with join
   //       accounting).
-  bool cache_divergence = false;
-  for (size_t w = 0; w < and_bits.size(); ++w) {
-    uint64_t a = and_bits[w], o = or_bits[w];
-    if (w == 0) {  // mask off the two flag bits
-      a &= ~uint64_t{3};
-      o &= ~uint64_t{3};
-    }
-    if (a != o) {
-      cache_divergence = true;
-      break;
-    }
-  }
-  bool need_slow = !GetBit(and_bits, 0) || cache_divergence || !nobody_joined;
+  // A diverged cache bit (OR set, AND cleared) no longer forces a round:
+  // the holders DEFER the request up to kMaxDeferCycles — routine
+  // submission skew (a peer popping the same tensor one cycle later)
+  // then completes on the fast path instead of paying a gather+bcast,
+  // and genuinely-diverged caches (capacity skew) still self-heal
+  // through the bounded-defer renegotiation.
+  (void)or_bits;
+  bool need_slow = !GetBit(and_bits, 0) || !nobody_joined;
 
   // ---- Slow path: full gather + construct + bcast -----------------------
   if (need_slow) {

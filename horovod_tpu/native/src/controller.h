@@ -106,6 +106,21 @@ class Controller {
   int last_joined_rank_ = -1;
   std::atomic<int> joined_count_{0};
   bool stall_abort_ = false;  // rank 0: stall exceeded the shutdown bound
+
+  // Cache-divergence DEFERRAL: when this rank's cached bit fails the
+  // global AND (a peer popped the same tensor a cycle later — routine
+  // submission skew), the request is HELD for up to kMaxDeferCycles
+  // cycles instead of forcing a slow renegotiation round: the laggard
+  // usually sets the bit next cycle and the tensor completes on the
+  // fast path.  Entries exceeding the bound are marked for forced
+  // renegotiation, which lands them in next cycle's uncached list — so
+  // the resulting slow round is triggered through bit0, i.e. agreed
+  // GLOBALLY (a mid-cycle local trigger could not be: the slow gather
+  // is collective).  Background-thread-only.
+  std::vector<Request> carryover_;
+  std::unordered_map<std::string, int> defer_counts_;
+  std::set<std::string> renegotiate_names_;
+  static constexpr int kMaxDeferCycles = 3;
 };
 
 }  // namespace hvd
