@@ -79,8 +79,11 @@ class TransformerConfig:
     # fused kernel (horovod_tpu.ops.attention); "ring" = sequence-parallel
     # ring attention over the ``sp`` mesh axis (requires running under
     # shard_map with sp bound and sequence sharded over it; chunks run the
-    # flash kernel).  "ring_reference" keeps the masked-XLA chunk math —
-    # the second oracle and the benchmarking control for the kernel path.
+    # flash kernel).  "ring_zigzag" = ring with the zigzag chunk layout
+    # (device i holds global chunks (i, 2P-1-i)): balances the causal
+    # work so no device idles — feed batches permuted by
+    # ops.attention.zigzag_perm.  "ring_reference" keeps the masked-XLA
+    # chunk math — the second oracle and the benchmarking control.
     attention_impl: str = "reference"
     # Rematerialize each layer in the backward pass (jax.checkpoint):
     # activations are recomputed instead of stored, trading ~1/3 more
@@ -201,14 +204,17 @@ def _rmsnorm(x, scale):
     return (out * scale).astype(x.dtype)
 
 
-def _rope(q, k, theta: float, pos_offset=0):
+def _rope(q, k, theta: float, pos_offset=0, positions=None):
     """Rotary position embedding over the head dim (applied to q and k).
     Shapes: (B, S, H, Dh).  ``pos_offset`` shifts positions when the
-    sequence axis is sharded (ring attention: shard r starts at r*S_local)."""
+    sequence axis is sharded (ring attention: shard r starts at
+    r*S_local); ``positions`` overrides with EXPLICIT per-row global
+    positions (zigzag layout: this shard's rows are non-contiguous)."""
     B, S, H, Dh = q.shape
     half = Dh // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    pos = pos_offset + jnp.arange(S, dtype=jnp.float32)
+    pos = (positions.astype(jnp.float32) if positions is not None
+           else pos_offset + jnp.arange(S, dtype=jnp.float32))
     ang = pos[:, None] * freqs[None, :]  # (S, half)
     cos = jnp.cos(ang)[None, :, None, :]
     sin = jnp.sin(ang)[None, :, None, :]
@@ -222,14 +228,14 @@ def _rope(q, k, theta: float, pos_offset=0):
     return rot(q), rot(k)
 
 
-def _qkv_proj(x, p, cfg: TransformerConfig, pos_offset=0):
+def _qkv_proj(x, p, cfg: TransformerConfig, pos_offset=0, positions=None):
     """Project to per-head Q/K/V with RoPE applied -> head-major
     ``(B, H, S, Dh)`` / ``(B, H_kv, S, Dh)`` (shared by the training
     attention, prefill, and decode paths so the math cannot drift)."""
     q = jnp.einsum("bsd,dhk->bshk", x, p["wq"].astype(cfg.dtype))
     k = jnp.einsum("bsd,dhk->bshk", x, p["wk"].astype(cfg.dtype))
     v = jnp.einsum("bsd,dhk->bshk", x, p["wv"].astype(cfg.dtype))
-    q, k = _rope(q, k, cfg.rope_theta, pos_offset)
+    q, k = _rope(q, k, cfg.rope_theta, pos_offset, positions=positions)
     return (jnp.moveaxis(q, 2, 1), jnp.moveaxis(k, 2, 1),
             jnp.moveaxis(v, 2, 1))
 
@@ -241,17 +247,25 @@ def _out_proj(oh, p, cfg: TransformerConfig):
 
 def _attention(x, p, cfg: TransformerConfig):
     B, S, D = x.shape
+    from horovod_tpu.ops import attention as attn
+
     pos_offset = 0
+    positions = None
     if cfg.attention_impl in ("ring", "ring_reference", "ulysses"):
         # Sequence is sharded over sp: this shard's tokens start at
         # sp_index * S_local in the global sequence.
         pos_offset = lax.axis_index("sp") * S
-    from horovod_tpu.ops import attention as attn
+    elif cfg.attention_impl == "ring_zigzag":
+        # Zigzag layout: this shard holds global chunks (i, 2P-1-i) —
+        # non-contiguous positions (feed data permuted by zigzag_perm).
+        positions = attn.zigzag_positions(S, "sp")
 
-    qh, kh, vh = _qkv_proj(x, p, cfg, pos_offset)
+    qh, kh, vh = _qkv_proj(x, p, cfg, pos_offset, positions=positions)
     if cfg.attention_impl == "ring":
         # GQA shards stay small through the ring; expansion is per-chunk.
         oh = attn.ring_attention(qh, kh, vh, axis_name="sp", causal=True)
+    elif cfg.attention_impl == "ring_zigzag":
+        oh = attn.zigzag_ring_attention(qh, kh, vh, axis_name="sp")
     elif cfg.attention_impl == "ring_reference":
         oh = attn.ring_attention(qh, kh, vh, axis_name="sp", causal=True,
                                  impl="reference")

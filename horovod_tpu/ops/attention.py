@@ -728,6 +728,138 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = False,
     return o.astype(q.dtype)
 
 
+def zigzag_perm(S: int, P: int):
+    """Column permutation mapping a CONTIGUOUS global sequence to the
+    zigzag layout: device i holds global chunks ``(i, 2P-1-i)`` of size
+    ``S/(2P)`` — pairing an early and a late chunk so every device owns
+    the same amount of causal work.  Returns (perm, inv): permute data
+    columns by ``perm`` before sharding contiguously over the axis;
+    ``inv`` restores original order."""
+    if S % (2 * P):
+        raise ValueError(f"sequence {S} must divide into 2*{P} chunks")
+    Sc = S // (2 * P)
+    idx = np.arange(S).reshape(2 * P, Sc)
+    perm = np.concatenate(
+        [np.concatenate([idx[i], idx[2 * P - 1 - i]]) for i in range(P)])
+    inv = np.argsort(perm)
+    return perm, inv
+
+
+def zigzag_positions(S_local: int, axis_name: str):
+    """Global position ids for this device's zigzag rows (feed to RoPE):
+    ``[me*Sc + 0..Sc-1, (2P-1-me)*Sc + 0..Sc-1]``."""
+    P = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    Sc = S_local // 2
+    ar = jnp.arange(Sc, dtype=jnp.float32)
+    return jnp.concatenate([me * Sc + ar, (2 * P - 1 - me) * Sc + ar])
+
+
+def zigzag_ring_attention(q, k, v, *, axis_name: str,
+                          sm_scale: Optional[float] = None,
+                          impl: str = "flash",
+                          block_q: int = 1024, block_k: int = 512):
+    """CAUSAL ring attention with the ZIGZAG chunk layout — the causal
+    load-balance fix for sequence parallelism.
+
+    Plain ring + causal is imbalanced: device i's rows attend i+1 of the
+    P shard-pairs, so early devices idle while the last device computes
+    every step — the lockstep ring pays the max every rotation.  Zigzag
+    pairs chunk ``i`` with chunk ``2P-1-i`` on device i (q/k/v rows in
+    zigzag layout — :func:`zigzag_perm`; RoPE positions from
+    :func:`zigzag_positions`), which makes the alive work EXACTLY half
+    the block pairs on every device at every step:
+
+      step with kv from src = chunks (src, 2P-1-src); my q = (me, 2P-1-me)
+        q_early × k_early : alive iff src <= me   (shift-causal kernel)
+        q_early × k_late  : ALWAYS dead           (never issued)
+        q_late  × k_early : always fully alive
+        q_late  × k_late  : alive iff src >= me   (shift-causal kernel)
+
+    Exactly 2 of 4 quarter-blocks compute per device per step — ~2×
+    the causal ring's steady-state throughput at large P.  Dead blocks
+    in the two conditional calls are skipped inside the shifted flash
+    kernel (the ``pl.when`` grid predicate against the runtime shift).
+    ``impl="reference"`` uses one masked-XLA chunk attention over the
+    exact global-position causal mask (the oracle).  Differentiable
+    end-to-end (the VJP rides the transposed ppermutes); GQA supported
+    like :func:`ring_attention`.
+    """
+    P = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    scale = _sm_scale(q, sm_scale)
+    B, H, S, D = q.shape
+    if S % 2:
+        raise ValueError("zigzag shard length must be even (two chunks)")
+    Sc = S // 2
+    perm = [(i, (i + 1) % P) for i in range(P)]
+    use_flash = impl == "flash"
+
+    qa, qb = q[:, :, :Sc], q[:, :, Sc:]
+
+    # Global chunk ids of my q rows.
+    my_a = me           # early chunk
+    my_b = 2 * P - 1 - me  # late chunk
+
+    def merge(o, lse, o_c, lse_c):
+        lse_new = jnp.logaddexp(lse, lse_c)
+        o = (o * jnp.exp(lse - lse_new)[..., None]
+             + o_c * jnp.exp(lse_c - lse_new)[..., None])
+        return o, lse_new
+
+    def block(qx, my_chunk, ks, vs, src_chunk):
+        """(o, lse) of one q-half over one kv-half chunk, with the
+        global-causal relation expressed as a shifted-causal mask."""
+        if use_flash:
+            shift = ((src_chunk - my_chunk) * Sc).astype(jnp.int32)
+            o_c, lse_c = flash_attention_shifted(
+                qx, ks, vs, shift, scale, block_q, block_k)
+            return o_c.astype(jnp.float32), lse_c.astype(jnp.float32)
+        shift = (src_chunk - my_chunk) * Sc
+        rows = lax.broadcasted_iota(jnp.int32, (Sc, Sc), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (Sc, Sc), 1)
+        return _chunk_attn(qx, ks, vs,
+                           (cols + shift <= rows)[None, None], scale)
+
+    def step(carry, s_idx):
+        oa, lsea, ob, lseb, ks_kv, vs_kv = carry
+        src = (me - s_idx) % P
+        last = s_idx == P - 1
+        ks = expand_kv(ks_kv, H)
+        vs = expand_kv(vs_kv, H)
+        ka, va = ks[:, :, :Sc], vs[:, :, :Sc]   # src's early chunk
+        kb, vb = ks[:, :, Sc:], vs[:, :, Sc:]   # src's late chunk
+        src_a = src
+        src_b = 2 * P - 1 - src
+        # q_early x k_early (alive iff src <= me; dead blocks kernel-skip)
+        o_c, l_c = block(qa, my_a, ka, va, src_a)
+        oa, lsea = merge(oa, lsea, o_c, l_c)
+        # q_late x k_early (always fully alive)
+        o_c, l_c = block(qb, my_b, ka, va, src_a)
+        ob, lseb = merge(ob, lseb, o_c, l_c)
+        # q_late x k_late (alive iff src >= me)
+        o_c, l_c = block(qb, my_b, kb, vb, src_b)
+        ob, lseb = merge(ob, lseb, o_c, l_c)
+        # q_early x k_late: provably dead for every (me, src) — not issued.
+        if not last:
+            ks_kv = lax.ppermute(ks_kv, axis_name, perm)
+            vs_kv = lax.ppermute(vs_kv, axis_name, perm)
+        return oa, lsea, ob, lseb, ks_kv, vs_kv
+
+    def zeros_like_half(qx):
+        o0 = jnp.zeros_like(qx, jnp.float32) * 0.0
+        lse0 = qx[..., 0].astype(jnp.float32) * 0.0 + NEG_INF
+        return o0, lse0
+
+    oa, lsea = zeros_like_half(qa)
+    ob, lseb = zeros_like_half(qb)
+    carry = (oa, lsea, ob, lseb, k, v)
+    for s_idx in range(P):  # unrolled like ring_attention (see note there)
+        carry = step(carry, s_idx)
+    out = jnp.concatenate([carry[0], carry[2]], axis=2)
+    return out.astype(q.dtype)
+
+
 def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = False,
                       sm_scale: Optional[float] = None,
                       impl: str = "flash"):
