@@ -79,7 +79,9 @@ def main() -> None:
                 params = PL.stack_to_chunks(w_full, p, v, s)
             else:
                 params = jax.tree_util.tree_map(
-                    lambda l: l[0], PL.stack_to_stages(w_full, p))
+                    lambda l: jax.lax.dynamic_index_in_dim(
+                        l, s, keepdims=False),
+                    PL.stack_to_stages(w_full, p))
             loss, g = PL.pipeline_value_and_grad(
                 stage_fn, params, xs, ts, loss_fn, axis_name="pp",
                 schedule=schedule, n_virtual=v)
@@ -114,6 +116,8 @@ def main() -> None:
             out.block_until_ready()
             dt = (time.perf_counter() - t0) / args.iters
             rows.append((m, m * mb / dt))
+        if not rows:  # e.g. interleaved with no M divisible by P
+            continue
         # Efficiency normalized to this schedule's own per-sample ideal:
         # time/sample extrapolated from the largest-M run's predicted
         # fraction (bubble-free tick cost is schedule-specific).

@@ -65,17 +65,19 @@ def sparse_allreduce(
     vals = np.ascontiguousarray(flat[rows])
 
     name = name or "sparse.grad"
+    # Chip-weighted eager contract (docs/concepts.md): each process's
+    # contribution counts once per ITS OWN local chip — weight BEFORE the
+    # gather, because processes may drive different chip counts (the
+    # dense eager path weights per process the same way,
+    # collectives.py _process_local_counts).
+    weighted = vals * np.asarray(basics.local_size(), vals.dtype)
     all_rows = np.asarray(C.allgather(rows, name=f"{name}.idx"))
-    all_vals = np.asarray(C.allgather(vals, name=f"{name}.val"))
+    all_vals = np.asarray(C.allgather(weighted, name=f"{name}.val"))
 
     out = np.zeros_like(flat)
     np.add.at(out, all_rows, all_vals)
-    # Chip-weighted eager contract (docs/concepts.md): Sum counts each
-    # process's contribution once per local chip; Average divides by the
-    # global chip count.
-    out *= basics.local_size()
     if op == C.Average:
-        out /= basics.size()
+        out /= basics.size()  # global chip count
     out = out.reshape(g.shape).astype(g.dtype)
     if not return_stats:
         return out

@@ -318,6 +318,18 @@ def scenario_localsize():
     np.testing.assert_allclose(
         rs, np.full((1, 3), chip_sum / hvd.size()).reshape(rs.shape))
 
+    # Sparse (row-gathered) reduction must honor the same chip-weighted
+    # contract with local_size() > 1: == the dense eager allreduce.
+    from horovod_tpu.ops import sparse as SP
+    sg = np.zeros((8, 3), np.float32)
+    sg[rank] = rank + 1.0
+    sg[5] = 10.0 * (rank + 1)  # overlapping row
+    for op_ in (hvd.Sum, hvd.Average):
+        np.testing.assert_allclose(
+            SP.sparse_allreduce(sg, op_, name=f"ls.sp.{op_}"),
+            np.asarray(hvd.allreduce(sg, op_, name=f"ls.spd.{op_}")),
+            rtol=1e-6, err_msg=op_)
+
     hvd.barrier()
     hvd.shutdown()
     print(f"NATIVE-WORKER-OK rank={rank}")
