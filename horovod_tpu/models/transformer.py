@@ -802,6 +802,29 @@ def _pipeline_stage_setup(params: Dict, cfg: TransformerConfig,
     return M, my_layers, stage_fn
 
 
+def _varying_value_and_grad(local_loss_fn, params, s, axis_name):
+    """value_and_grad of a replicated-parameter pipeline loss that is
+    EXPLICITLY correct about gradient ownership under ANY shard_map VMA
+    setting: ``local_loss_fn`` returns THIS DEVICE's gated loss
+    contribution (NO psum inside — a psum's transpose is a psum, so a
+    loss combined inside the differentiated function would multiply the
+    seed cotangent by the axis size under ``check_vma=False``), params
+    are made axis-VARYING before differentiation (no reliance on the
+    implicit replicated-VJP psum that check_vma=False disables), and
+    value + per-stage gradient partials combine with explicit psums
+    OUTSIDE the grad.  Each parameter has exactly one owning stage in
+    the gated construction (loss params on the last stage, embedding
+    feed on stage 0, each layer via its dynamic_slice), so the psum
+    adds one real contribution to zeros."""
+    varying = jax.tree_util.tree_map(
+        lambda a: a + (s * 0).astype(a.dtype), params)
+    local, g_local = jax.value_and_grad(local_loss_fn)(varying)
+    loss = lax.psum(local, axis_name)
+    grads = jax.tree_util.tree_map(
+        lambda x: lax.psum(x, axis_name), g_local)
+    return loss, grads
+
+
 def pipelined_value_and_grad(params: Dict, batch: Dict,
                              cfg: TransformerConfig, *,
                              axis_name: str = "pp",
@@ -856,18 +879,18 @@ def pipelined_value_and_grad(params: Dict, batch: Dict,
                                            axis_name=axis_name,
                                            n_microbatches=n_microbatches)
             raw = _xent_sum(logits, batch["targets"]) / batch["targets"].size
-            total = lax.psum(jnp.where(s == P_ - 1, raw, 0.0), axis_name)
+            local = jnp.where(s == P_ - 1, raw, 0.0)
             if aux_on:
                 # Pipelined aux is computed PER MICROBATCH (the dispatch
                 # group switch routing actually sees); the mean over
                 # groups matches loss_fn's full-batch aux scale — and
-                # equals it exactly at n_microbatches=1.
+                # equals it exactly at n_microbatches=1.  This stage's
+                # LOCAL share; the psum happens outside the grad.
                 M_ = n_microbatches or P_
-                total = total + cfg.moe_aux_coeff * lax.psum(
-                    aux_local, axis_name) / M_
-            return total
+                local = local + cfg.moe_aux_coeff * aux_local / M_
+            return local
 
-        return jax.value_and_grad(_loss)(params)
+        return _varying_value_and_grad(_loss, params, s, axis_name)
 
     if schedule == "interleaved":
         from horovod_tpu.parallel import pipeline as _pl
@@ -900,13 +923,12 @@ def pipelined_value_and_grad(params: Dict, batch: Dict,
             y = outs.reshape(B, *x.shape[1:])
             logits = _lm_head(y, p["ln_f"], p["head"], cfg)
             raw = _xent_sum(logits, targets) / targets.size
-            total = lax.psum(jnp.where(s == P_ - 1, raw, 0.0), axis_name)
+            local = jnp.where(s == P_ - 1, raw, 0.0)
             if aux_on:
-                total = total + cfg.moe_aux_coeff * lax.psum(
-                    aux_local, axis_name) / M
-            return total
+                local = local + cfg.moe_aux_coeff * aux_local / M
+            return local
 
-        return jax.value_and_grad(_iloss)(params)
+        return _varying_value_and_grad(_iloss, params, s, axis_name)
     if schedule != "1f1b":
         raise ValueError(f"unknown pipeline schedule {schedule!r}")
 

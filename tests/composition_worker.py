@@ -1,9 +1,11 @@
 """Subprocess body for the pipeline composition tests
 (tests/test_pipeline.py): argv[1] selects the mesh —
 
-  sp      (pp, sp):     1F1B x ring-attention sequence parallelism
-  ep      (pp, ep):     1F1B x expert-parallel switch-MoE
-  triple  (pp, sp, ep): all three in one shard_map
+  sp             (pp, sp):     1F1B x ring-attention sequence parallelism
+  ep             (pp, ep):     1F1B x expert-parallel switch-MoE
+  triple         (pp, sp, ep): all three in one shard_map
+  sp_interleaved (pp, sp):     INTERLEAVED schedule (v=2) x ring attention
+  sp_zigzag      (pp, sp):     1F1B x ZIGZAG ring (causal load balance)
 
 Each asserts loss and EVERY parameter gradient exact vs the unsharded
 single-device reference.  Run in subprocesses because the XLA CPU
@@ -35,10 +37,16 @@ from test_pipeline import (
 
 MODE = sys.argv[1] if len(sys.argv) > 1 else "triple"
 
-if MODE == "sp":
+SCHEDULE = "1f1b"
+ATTN_SP = "ring"
+if MODE in ("sp", "sp_interleaved", "sp_zigzag"):
     pp, sp, ep = 2, 4, 1
     axes, shape = ("pp", "sp"), (2, 4)
     batch_spec = P(None, "sp")  # sequence sharded over sp
+    if MODE == "sp_interleaved":
+        SCHEDULE = "interleaved"  # v=2 virtual stages over pp=2
+    elif MODE == "sp_zigzag":
+        ATTN_SP = "ring_zigzag"
 elif MODE == "ep":
     pp, sp, ep = 2, 1, 4
     axes, shape = ("pp", "ep"), (2, 4)
@@ -56,13 +64,21 @@ cfg = T.TransformerConfig(
     max_seq=16 if sp == 1 else 8 * sp, dtype=jnp.float32,
     n_experts=n_experts, capacity_factor=float(max(n_experts, 1)),
     moe_impl="switch", moe_axis="ep" if ep > 1 else None,
-    attention_impl="ring" if sp > 1 else "reference", n_kv_heads=2)
+    attention_impl=ATTN_SP if sp > 1 else "reference", n_kv_heads=2)
 cfg_ref = dataclasses.replace(cfg, moe_axis=None,
                               attention_impl="reference")
 params = T.init_params(jax.random.PRNGKey(0), cfg)
 batch = T.synthetic_batch(0, cfg, batch=4 if ep == 1 else 8 // sp)
 l_ref, g_ref = jax.value_and_grad(
     lambda p: T.loss_fn(p, batch, cfg_ref))(params)
+if MODE == "sp_zigzag":
+    # Zigzag layout: shard columns permuted so device i holds global
+    # chunks (i, 2P-1-i); the reference above used the UNPERMUTED batch
+    # (loss mean and token/target pairing are permutation-invariant).
+    from horovod_tpu.ops import attention as ATT
+
+    zperm, _ = ATT.zigzag_perm(cfg.max_seq, sp)
+    batch = {k: v[:, zperm] for k, v in batch.items()}
 
 mesh = Mesh(np.array(jax.devices()).reshape(shape), axis_names=axes)
 
@@ -70,7 +86,7 @@ mesh = Mesh(np.array(jax.devices()).reshape(shape), axis_names=axes)
 def inner(pr, b):
     pr_sh = _ep_shard_params(pr, cfg.n_experts, ep) if ep > 1 else pr
     loss, grads = T.pipelined_value_and_grad(
-        pr_sh, b, cfg, axis_name="pp", schedule="1f1b")
+        pr_sh, b, cfg, axis_name="pp", schedule=SCHEDULE, n_virtual=2)
     if ep > 1:
         grads = _ep_unshard_grads(grads, cfg.n_experts, ep)
     data_axes = tuple(a for a in ("sp", "ep") if a in axes)

@@ -533,6 +533,16 @@ class TestPipelineCompositions:
         all_to_all inside every stage."""
         _run_composition_worker("ep")
 
+    def test_interleaved_ring_pp_x_sp_exact(self):
+        """INTERLEAVED schedule (v=2 virtual stages) composed with ring
+        attention over sp — the bubble-divided schedule is as composable
+        as 1F1B."""
+        _run_composition_worker("sp_interleaved")
+
+    def test_1f1b_zigzag_ring_pp_x_sp_exact(self):
+        """1F1B composed with the ZIGZAG (causal load-balanced) ring."""
+        _run_composition_worker("sp_zigzag")
+
     def test_1f1b_ring_moe_pp_x_sp_x_ep_exact(self):
         """(pp, sp, ep): all three in one shard_map."""
         _run_composition_worker("triple")
