@@ -135,15 +135,53 @@ def restore(path: str, template: Any, *, root_rank: int = 0,
     return jax.tree_util.tree_map(_to_jax, tree)
 
 
+def save_async(path: str, tree: Any):
+    """Start a NON-BLOCKING checkpoint write and return a handle with
+    ``wait()`` — the training loop keeps stepping while the host
+    serializes (orbax async checkpointing; the device→host copy happens
+    up front, the file writes on a background thread).  Call ``wait()``
+    (or start the next save) before reading the checkpoint back or
+    exiting.  Single-controller and pod-collaborative regimes both
+    supported (same dispatch as :func:`save`)."""
+    ocp = _ocp()
+    path = os.path.abspath(path)
+
+    class _Handle:
+        def __init__(self, ckptr):
+            self._ckptr = ckptr
+
+        def wait(self):
+            if self._ckptr is not None:
+                self._ckptr.wait_until_finished()
+                self._ckptr.close()
+                self._ckptr = None
+
+    if _spans_processes(tree):
+        ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+        ckptr.save(path, args=ocp.args.StandardSave(tree), force=True)
+        return _Handle(ckptr)
+    if basics.num_processes() > 1 and basics.process_rank() != 0:
+        return _Handle(None)  # non-writers: nothing in flight
+    ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    ckptr.save(path, args=ocp.args.StandardSave(jax.device_get(tree)),
+               force=True)
+    return _Handle(ckptr)
+
+
 class CheckpointManager:
     """Step-numbered checkpoints with retention + latest-resume.
 
     ``save(step, tree)`` on a cadence; ``latest_step()`` / ``restore_latest
-    (template)`` on startup — the estimator/elastic resume contract."""
+    (template)`` on startup — the estimator/elastic resume contract.
+    ``async_saves=True`` makes ``save`` non-blocking (each save first
+    waits out the previous one, so at most one write is in flight)."""
 
-    def __init__(self, directory: str, *, max_to_keep: int = 3) -> None:
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 async_saves: bool = False) -> None:
         self.directory = os.path.abspath(directory)
         self.max_to_keep = max_to_keep
+        self.async_saves = async_saves
+        self._inflight = None
         os.makedirs(self.directory, exist_ok=True)
 
     def _step_dir(self, step: int) -> str:
@@ -165,22 +203,39 @@ class CheckpointManager:
         return steps[-1] if steps else None
 
     def save(self, step: int, tree: Any) -> None:
-        save(self._step_dir(step), tree)
+        if self.async_saves:
+            self.wait()  # at most one write in flight
+            self._inflight = save_async(self._step_dir(step), tree)
+        else:
+            save(self._step_dir(step), tree)
         if basics.num_processes() > 1 and basics.process_rank() != 0:
             return
-        # retention (oldest beyond max_to_keep removed)
+        # retention (oldest beyond max_to_keep removed; an in-flight
+        # async save is never the victim — it is the newest step, and it
+        # counts toward the retention budget even though its directory
+        # only appears when the background write finalizes)
         steps = self.all_steps()
+        if self._inflight is not None and step not in steps:
+            steps.append(step)
         while len(steps) > self.max_to_keep:
             victim = steps.pop(0)
             import shutil
 
             shutil.rmtree(self._step_dir(victim), ignore_errors=True)
 
+    def wait(self) -> None:
+        """Block until the in-flight async save (if any) is durable."""
+        if self._inflight is not None:
+            self._inflight.wait()
+            self._inflight = None
+
     def restore(self, step: int, template: Any) -> Any:
+        self.wait()  # never read past an in-flight write
         return restore(self._step_dir(step), template)
 
     def restore_latest(self, template: Any) -> tuple[Optional[int], Any]:
         """(step, tree) from the newest checkpoint, or (None, template)."""
+        self.wait()
         step = self.latest_step()
         if step is None:
             return None, template
