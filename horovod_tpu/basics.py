@@ -212,7 +212,20 @@ def init(
     _configure_logging()
     _bootstrap_distributed()
     if devices is None:
-        devices = jax.devices()
+        try:
+            devices = jax.devices()
+        except RuntimeError as e:
+            # A configured platform whose plugin is absent in THIS
+            # process (e.g. an accelerator plugin selected by the parent
+            # environment but not registered in launcher-spawned ranks)
+            # should degrade to CPU with a warning, not kill the job.
+            if "Unable to initialize backend" not in str(e):
+                raise
+            logger.warning(
+                "configured JAX platform unavailable (%s); falling back "
+                "to CPU", e)
+            jax.config.update("jax_platforms", "cpu")
+            devices = jax.devices()
     mesh, hier = _build_meshes(devices, axis_name)
     local = [d for d in devices if d.process_index == jax.process_index()]
     _context = _Context(
