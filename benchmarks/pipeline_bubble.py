@@ -46,7 +46,9 @@ def main() -> None:
     ap.add_argument("--ms", type=int, nargs="+", default=[4, 8, 16, 32])
     args = ap.parse_args()
 
-    jax.config.update("jax_num_cpu_devices", args.p)
+    from horovod_tpu._compat import set_cpu_device_count
+
+    set_cpu_device_count(args.p)
 
     import jax.numpy as jnp
     import numpy as np
@@ -129,8 +131,10 @@ def main() -> None:
                   f"{predicted(schedule, m):>9.2f}")
         results[schedule] = rows
     # Headline: throughput gained by interleaving at the smallest common M.
-    common = [m for m, _ in results["interleaved"]
-              if m in dict(results["1f1b"])]
+    # Either schedule may be absent (e.g. no --ms entry divisible by --p
+    # leaves interleaved without rows) — skip the headline, don't KeyError.
+    common = [m for m, _ in results.get("interleaved", [])
+              if m in dict(results.get("1f1b", []))]
     if common:
         m0 = common[0]
         g0 = dict(results["1f1b"])[m0]

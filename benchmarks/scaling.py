@@ -40,7 +40,9 @@ def worker(n_devices: int, batch_per_device: int, iters: int, model: str) -> Non
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_devices)
+    from horovod_tpu._compat import set_cpu_device_count
+
+    set_cpu_device_count(n_devices)
     import jax.numpy as jnp
     import numpy as np
     import optax

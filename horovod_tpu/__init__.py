@@ -11,6 +11,7 @@ Typical use (the Horovod "minimal code change" contract, README.rst:37):
     params = hvd.broadcast_parameters(params, root_rank=0)
 """
 
+from horovod_tpu import _compat  # noqa: F401  (installs JAX version shims)
 from horovod_tpu.basics import (
     AXIS,
     CROSS_AXIS,

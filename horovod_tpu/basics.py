@@ -154,6 +154,15 @@ def _bootstrap_distributed() -> None:
         addr = f"127.0.0.1:{jax_port}"
     elif ":" not in addr:
         addr = f"{addr}:{jax_port}"
+    # Older JAX gates cross-process CPU collectives behind a config
+    # option (newer builds enable them by default; the option is gone).
+    # Without it a multi-process CPU job fails at the first collective
+    # with "Multiprocess computations aren't implemented on the CPU
+    # backend" — enable gloo before the backend initializes.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):
+        pass
     jax.distributed.initialize(
         coordinator_address=addr, num_processes=nproc, process_id=rank
     )
@@ -287,6 +296,24 @@ def shutdown() -> None:
     if _context.timeline is not None:
         _context.timeline.close()
     _context = None
+
+
+def reinit(
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = AXIS,
+) -> None:
+    """Tear down and re-initialize the runtime (elastic restart path).
+
+    Used by ``elastic.run`` after a membership change when the mesh can be
+    rebuilt in-process: stops the eager runtime (closing its control-plane
+    sockets), drops the context, and re-runs :func:`init` over the current
+    environment/devices.  Multi-process jobs cannot re-rendezvous
+    in-process (the JAX coordination service is bound to the dead world's
+    membership) — the ElasticDriver respawns those ranks with fresh epoch
+    env instead."""
+    shutdown()
+    init(devices=devices, axis_name=axis_name)
 
 
 atexit.register(shutdown)

@@ -277,11 +277,18 @@ def start(timeline_path: Optional[str] = None) -> Optional[EagerRuntime]:
         if ":" in addr:
             addr = addr.split(":")[0]
         # Distinct from the rendezvous KV port and the JAX coordination
-        # port (KV+2): the native control plane listens on KV+3.
+        # port (KV+2): the native control plane listens on KV+3.  Elastic
+        # restarts offset by the rendezvous epoch so a relaunch never
+        # races the dead epoch's lingering listener (the ElasticDriver
+        # also exports a fresh HOROVOD_NATIVE_PORT per epoch; this covers
+        # manually relaunched elastic jobs).
         port = os.environ.get("HOROVOD_NATIVE_PORT")
         if port is None:
             base = os.environ.get("HOROVOD_COORDINATOR_PORT")
             port = str(int(base) + 3) if base else "9374"
+            epoch = os.environ.get("HOROVOD_ELASTIC_EPOCH")
+            if epoch:
+                port = str(int(port) + 2 * int(epoch))
         port = int(port)
         rt = native.NativeRuntime()
         rt.init(rank, size, addr, port, timeline_path=timeline_path)

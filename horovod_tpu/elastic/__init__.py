@@ -1,0 +1,39 @@
+"""Elastic training subsystem.
+
+Three layers, ported from Horovod Elastic (the v0.20 successor of the
+reference codebase) onto the fixed-mesh XLA world:
+
+* :class:`State` — synchronizable training state with in-memory
+  ``commit()``/``rollback()`` plus durable ``save()``/``restore()``
+  (:mod:`horovod_tpu.elastic.state`);
+* :func:`run` + :class:`WorkerNotificationManager` — the worker-side
+  retry loop and failure-notice plumbing
+  (:mod:`horovod_tpu.elastic.worker`);
+* the supervisor lives in the runner layer:
+  :class:`horovod_tpu.runner.elastic_driver.ElasticDriver` /
+  :func:`horovod_tpu.runner.elastic_driver.run_elastic`.
+
+See ``docs/elastic.md`` for the full recovery story.
+"""
+
+from horovod_tpu.elastic.interrupts import (  # noqa: F401
+    EXIT_CODE_RESTART,
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+from horovod_tpu.elastic.state import State  # noqa: F401
+from horovod_tpu.elastic.worker import (  # noqa: F401
+    WorkerNotificationManager,
+    notification_manager,
+    run,
+)
+
+__all__ = [
+    "EXIT_CODE_RESTART",
+    "HorovodInternalError",
+    "HostsUpdatedInterrupt",
+    "State",
+    "WorkerNotificationManager",
+    "notification_manager",
+    "run",
+]

@@ -1,0 +1,36 @@
+"""Control-flow exceptions for elastic training (Horovod Elastic's
+``horovod/common/exceptions.py`` equivalents, on the fixed-mesh XLA
+world where a membership change means stop → re-rendezvous → rebuild
+mesh → recompile → resume)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+# A worker that cannot rebuild the mesh in-process (multi-process jobs:
+# the JAX coordination service is bound to the dead world) exits with
+# this code to request a clean respawn from the ElasticDriver.  BSD
+# EX_TEMPFAIL: "temporary failure, retry".
+EXIT_CODE_RESTART = 75
+
+
+class HorovodInternalError(RuntimeError):
+    """A collective failed mid-step (peer death, coordination abort).
+
+    ``elastic.run`` reacts by rolling the state back to the last commit
+    and retrying — the uncommitted step is replayed, never half-applied.
+    """
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Cluster membership changed (peer failed / hosts added or removed).
+
+    Raised at a COMMIT BOUNDARY by ``State.check_host_updates()``, so the
+    state is committed-consistent when ``elastic.run`` re-rendezvouses; no
+    rollback is needed.
+    """
+
+    def __init__(self, message: str = "hosts updated",
+                 updated_hosts: Optional[Sequence[str]] = None) -> None:
+        super().__init__(message)
+        self.updated_hosts = list(updated_hosts or [])

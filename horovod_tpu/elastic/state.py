@@ -1,0 +1,200 @@
+"""Elastic training state: sync, disk checkpoint, and in-memory
+commit/rollback.
+
+The reference (v0.19) predates Horovod Elastic; its fault-tolerance
+primitive is Join (SURVEY.md §5.3) plus the convention that rank 0
+checkpoints and broadcasts restored state (§5.4).  :class:`State` packages
+that convention and extends it with the Elastic-mode contract (the v0.20
+successor of this codebase): ``commit()`` takes an IN-MEMORY snapshot (plus
+an optional durable save) and checks for membership-change notices;
+``rollback()`` restores the last snapshot, so an uncommitted step wrecked
+by a peer failure is cleanly replayed instead of corrupting training.
+
+On TPU a membership change means a new mesh and recompilation — the
+:class:`horovod_tpu.runner.elastic_driver.ElasticDriver` supervises that
+(stop → re-rendezvous → rebuild mesh → recompile → resume); this object
+guarantees the surviving state is consistent when training resumes.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from horovod_tpu import basics
+from horovod_tpu import state as S
+from horovod_tpu.elastic.interrupts import HostsUpdatedInterrupt
+
+
+def _writable(v: Any) -> Any:
+    """Re-own read-only numpy leaves.  Eager broadcasts hand back numpy
+    VIEWS of XLA buffers (read-only); a training loop that updates its
+    params in place (``w -= lr * g``) must keep working after ``sync()``
+    replaced the fields."""
+
+    def leaf(l):
+        if isinstance(l, np.ndarray) and not l.flags.writeable:
+            return l.copy()
+        return l
+
+    return jax.tree_util.tree_map(leaf, v)
+
+
+def _copy_value(v: Any) -> Any:
+    """Snapshot one state field.  ``jax.Array`` leaves are immutable but
+    must still be COPIED: the repo's own train steps donate their input
+    buffers (``spmd.make_train_step`` defaults ``donate=True``), so a
+    snapshot held by reference would be deleted by the very next step and
+    ``rollback()`` would restore dead buffers."""
+
+    def leaf(l):
+        if isinstance(l, np.ndarray):
+            return l.copy()
+        if isinstance(l, jax.Array):
+            try:
+                return l.copy()
+            except Exception:  # already deleted / committed-to-disk only
+                return l
+        return copy.deepcopy(l)
+
+    return jax.tree_util.tree_map(leaf, v)
+
+
+class State:
+    """Synchronizable training state (params, opt_state, epoch, step...).
+
+    Construction takes an implicit first snapshot, so ``rollback()`` before
+    any ``commit()`` restores the initial values."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        self._keys = sorted(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self._commit_lock = threading.Lock()
+        self._host_updated = threading.Event()
+        self._saved: Dict[str, Any] = {}
+        self._warned_memory_only = False
+        self.save_snapshot()
+
+    # ---- membership-change notification (WorkerNotificationManager) ------
+
+    def on_hosts_updated(self) -> None:
+        """Called (from the notification thread) when the supervisor
+        signals a membership change; surfaces as
+        :class:`HostsUpdatedInterrupt` at the next commit boundary."""
+        self._host_updated.set()
+
+    def check_host_updates(self) -> None:
+        """Raise :class:`HostsUpdatedInterrupt` if a membership change was
+        signalled.  Called by :meth:`commit` so the interrupt only fires at
+        a committed-consistent boundary."""
+        if self._host_updated.is_set():
+            self._host_updated.clear()
+            raise HostsUpdatedInterrupt(
+                "cluster membership changed; re-rendezvous required")
+
+    # ---- in-memory snapshot ----------------------------------------------
+
+    def save_snapshot(self) -> None:
+        """Capture the current field values in memory (no disk IO)."""
+        with self._commit_lock:
+            self._saved = {k: _copy_value(getattr(self, k))
+                           for k in self._keys}
+
+    def rollback(self) -> None:
+        """Restore every field from the last in-memory snapshot: the
+        recovery half of the commit/rollback contract — an uncommitted
+        step interrupted by a peer failure is discarded and replayed."""
+        with self._commit_lock:
+            for k in self._keys:
+                setattr(self, k, _copy_value(self._saved[k]))
+
+    def commit(self, path: Optional[str] = None) -> None:
+        """Mark the current state as committed: snapshot in memory, write a
+        durable rank-0 checkpoint when ``path`` is given, then surface any
+        pending membership change (:class:`HostsUpdatedInterrupt`).
+
+        A committed step is never lost: the driver restarts ranks from the
+        last durable commit, and an in-process retry rolls back to the last
+        in-memory commit.  NOTE that in a multi-process job recovery means
+        a driver-supervised RESPAWN, and only a durable commit survives a
+        respawn — committing without ``path`` there is warned once."""
+        self.save_snapshot()
+        if path is not None:
+            self.save(path)
+        elif not self._warned_memory_only:
+            try:
+                multi = basics.is_initialized() and basics.num_processes() > 1
+            except Exception:
+                multi = False
+            if multi:
+                self._warned_memory_only = True
+                import logging
+
+                logging.getLogger("horovod_tpu").warning(
+                    "elastic: State.commit() without a path only snapshots "
+                    "in memory; a driver-supervised respawn restores from "
+                    "the last DURABLE commit — pass a checkpoint path or "
+                    "committed progress will not survive a rank failure")
+        self.check_host_updates()
+
+    # ---- cross-rank sync -------------------------------------------------
+
+    def sync(self, root_rank: int = 0) -> None:
+        """Broadcast every field from ``root_rank`` (restart consistency),
+        then snapshot the synced values."""
+        for k in self._keys:
+            v = getattr(self, k)
+            leaves = jax.tree_util.tree_leaves(v)
+            if leaves and all(
+                isinstance(l, (jax.Array, np.ndarray, float, int)) for l in leaves
+            ):
+                setattr(self, k, _writable(S.broadcast_parameters(v, root_rank)))
+            else:
+                setattr(self, k, S.broadcast_object(v, root_rank))
+        self.save_snapshot()
+
+    # ---- durable checkpoint ----------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Rank-0 checkpoint (host pytree pickle; for large models prefer
+        orbax — this covers the reference's convention, not a storage
+        format)."""
+        if basics.rank() == 0:
+            tmp = path + ".tmp"
+            host = {
+                k: jax.tree_util.tree_map(
+                    lambda l: np.asarray(l)
+                    if isinstance(l, (jax.Array, np.ndarray))
+                    else l,
+                    getattr(self, k),
+                )
+                for k in self._keys
+            }
+            with open(tmp, "wb") as f:
+                pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+
+    def restore(self, path: str, root_rank: int = 0) -> bool:
+        """Rank 0 loads, then broadcast to all.  Returns False if absent."""
+        exists = os.path.exists(path) if basics.rank() == 0 else False
+        exists = bool(S.broadcast_object(exists, root_rank))
+        if not exists:
+            return False
+        if basics.rank() == 0:
+            with open(path, "rb") as f:
+                host = pickle.load(f)
+        else:
+            host = None
+        host = S.broadcast_object(host, root_rank)
+        for k in self._keys:
+            if k in host:
+                setattr(self, k, host[k])
+        self.save_snapshot()
+        return True

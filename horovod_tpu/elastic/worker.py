@@ -1,0 +1,252 @@
+"""Worker-side elastic machinery: failure notification + the retrying
+``elastic.run`` wrapper.
+
+Port of Horovod Elastic's ``WorkerNotificationManager`` /
+``elastic.run`` pair onto the fixed-mesh XLA world.  The supervisor
+(:class:`horovod_tpu.runner.elastic_driver.ElasticDriver`) and the
+workers share the launcher's rendezvous KV:
+
+* each worker publishes a wall-clock heartbeat under
+  ``elastic/heartbeat.<epoch>.<rank>`` so the driver can detect a HUNG
+  rank (a dead one is caught by its exit code);
+* the driver publishes ``elastic/notice.<epoch>`` when membership
+  changes; the notification thread converts that into
+  :class:`HostsUpdatedInterrupt` at the next commit boundary
+  (``State.commit`` → ``check_host_updates``).
+
+``elastic.run(train_fn)`` then implements the recovery contract: a
+committed step is never lost, an uncommitted one is cleanly replayed —
+on :class:`HorovodInternalError` (peer died mid-collective) the state
+rolls back to the last commit; on :class:`HostsUpdatedInterrupt` the
+state is already committed-consistent.  Single-process jobs rebuild the
+runtime in-process (``basics.reinit``); multi-process jobs exit with
+``EXIT_CODE_RESTART`` so the driver respawns them over the surviving
+mesh (re-``init()`` with the new world, fresh rendezvous epoch keys).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from horovod_tpu.elastic.interrupts import (
+    EXIT_CODE_RESTART,
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+)
+
+logger = logging.getLogger("horovod_tpu")
+
+KV_SCOPE = "elastic"
+
+
+def heartbeat_key(epoch: int, rank: int) -> str:
+    return f"heartbeat.{epoch}.{rank}"
+
+
+def notice_key(epoch: int) -> str:
+    return f"notice.{epoch}"
+
+
+def state_key(epoch: int) -> str:
+    return f"state.{epoch}"
+
+
+class WorkerNotificationManager:
+    """Per-process singleton: heartbeat publisher + notice poller.
+
+    ``init()`` is a no-op unless the launcher exported
+    ``HOROVOD_ELASTIC=1`` (the ElasticDriver does), so non-elastic jobs
+    pay nothing.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._listeners: List[object] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._notified = False
+
+    def init(self) -> None:
+        with self._lock:
+            if self._thread is not None:
+                return
+            if os.environ.get("HOROVOD_ELASTIC", "0") in ("", "0", "false"):
+                return
+            addr = os.environ.get("HOROVOD_COORDINATOR_ADDR", "127.0.0.1")
+            if ":" in addr:
+                addr = addr.split(":")[0]
+            port = os.environ.get("HOROVOD_COORDINATOR_PORT")
+            if not port:
+                return
+            self._rank = int(os.environ.get("HOROVOD_RANK", "0"))
+            self._epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0"))
+            self._interval = float(
+                os.environ.get("HOROVOD_ELASTIC_HEARTBEAT", "1.0") or 0.0)
+            from horovod_tpu.runner.rendezvous import KVClient
+
+            self._kv = KVClient(addr, int(port), timeout=5.0)
+            self._stop.clear()
+            self._notified = False
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="hvd-elastic-notification")
+            self._thread.start()
+
+    def register_listener(self, listener: object) -> None:
+        """``listener`` needs an ``on_hosts_updated()`` method (State)."""
+        with self._lock:
+            if listener not in self._listeners:
+                self._listeners.append(listener)
+            if self._notified:
+                listener.on_hosts_updated()
+
+    def remove_listener(self, listener: object) -> None:
+        with self._lock:
+            if listener in self._listeners:
+                self._listeners.remove(listener)
+
+    def handle_hosts_updated(self) -> None:
+        """Deliver a membership-change signal to every listener (also the
+        test seam: callable directly to simulate a driver notice)."""
+        with self._lock:
+            self._notified = True
+            listeners = list(self._listeners)
+        for l in listeners:
+            l.on_hosts_updated()
+
+    def stop(self) -> None:
+        with self._lock:
+            thread = self._thread
+            self._thread = None
+        self._stop.set()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    # ---- background thread ----------------------------------------------
+
+    def _loop(self) -> None:
+        tick = max(0.1, min(self._interval or 1.0, 1.0))
+        next_beat = 0.0
+        while not self._stop.wait(tick):
+            now = time.time()
+            try:
+                if self._interval > 0 and now >= next_beat:
+                    self._kv.put(KV_SCOPE,
+                                 heartbeat_key(self._epoch, self._rank),
+                                 repr(now).encode())
+                    next_beat = now + self._interval
+                if not self._notified:
+                    if self._kv.get(KV_SCOPE,
+                                    notice_key(self._epoch)) is not None:
+                        self.handle_hosts_updated()
+            except Exception:
+                # KV unreachable (driver tearing down / transient): the
+                # driver's exit-code monitoring covers us; keep trying.
+                continue
+
+
+notification_manager = WorkerNotificationManager()
+
+
+def _exit_for_respawn() -> None:
+    """Leave the process for a driver-supervised respawn: attempt a clean
+    runtime teardown (closing the native control-plane sockets promptly
+    unblocks peers mid-negotiation) but never hang on it — the teardown
+    runs on a daemon thread with a bounded join, then the process exits
+    with ``EXIT_CODE_RESTART``."""
+    from horovod_tpu import basics
+
+    t = threading.Thread(target=basics.shutdown, daemon=True)
+    t.start()
+    t.join(timeout=5.0)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(EXIT_CODE_RESTART)
+
+
+def _rebuild_in_process() -> bool:
+    """Tear down and re-initialize the runtime inside this process.
+
+    Only supported for single-process jobs: with multiple processes the
+    JAX coordination service and the native control plane are bound to
+    the dead world's ports/membership, so the honest recovery is a
+    respawn by the ElasticDriver (which exports fresh epoch env)."""
+    from horovod_tpu import basics
+
+    try:
+        if basics.is_initialized() and basics.num_processes() > 1:
+            return False
+    except Exception:
+        return False
+    basics.reinit()
+    return True
+
+
+def run(train_fn):
+    """Decorator implementing Horovod Elastic's ``run`` contract.
+
+    ``wrapped(state, *args, **kwargs)``:
+
+    1. starts the notification manager and registers ``state``;
+    2. ``state.sync()`` (broadcast from rank 0 — restart consistency);
+    3. calls ``train_fn``; on a clean return, returns its value;
+    4. on :class:`HostsUpdatedInterrupt` (commit-boundary membership
+       change): state is committed-consistent — re-sync and retry;
+    5. on :class:`HorovodInternalError` / eager ``CollectiveError``
+       (peer died mid-step): ``state.rollback()`` to the last commit,
+       then re-sync and retry;
+    6. when the mesh cannot be rebuilt in-process (multi-process job),
+       exits with ``EXIT_CODE_RESTART`` so the supervising ElasticDriver
+       respawns this rank over the surviving hosts.
+    """
+
+    def wrapped(state, *args, **kwargs):
+        from horovod_tpu.eager_runtime import CollectiveError
+
+        notification_manager.init()
+        notification_manager.register_listener(state)
+        # In-process retries are bounded (a persistently failing step
+        # must not loop forever); the driver-supervised respawn path has
+        # its own reset_limit.  0/unset = unbounded, like Horovod.
+        reset_limit = int(
+            os.environ.get("HOROVOD_ELASTIC_RESET_LIMIT", "0") or 0)
+        resets = 0
+        try:
+            while True:
+                try:
+                    # sync() is INSIDE the protected region: a peer can die
+                    # while we are in the restart broadcast itself, and
+                    # that failure must take the recovery path (respawn /
+                    # retry), not crash this healthy rank with a plain
+                    # exit 1 that the driver would blame on its host.
+                    state.sync()
+                    return train_fn(state, *args, **kwargs)
+                except HostsUpdatedInterrupt:
+                    logger.warning(
+                        "elastic: hosts updated at commit boundary; "
+                        "re-rendezvousing")
+                except (HorovodInternalError, CollectiveError) as e:
+                    logger.warning(
+                        "elastic: collective failed mid-step (%s); rolling "
+                        "back to last commit", e)
+                    state.rollback()
+                    resets += 1
+                    if reset_limit and resets > reset_limit:
+                        raise
+                if not _rebuild_in_process():
+                    logger.warning(
+                        "elastic: cannot rebuild the mesh in-process; "
+                        "exiting for supervised respawn (code %d)",
+                        EXIT_CODE_RESTART)
+                    _exit_for_respawn()
+        finally:
+            notification_manager.remove_listener(state)
+
+    wrapped.__name__ = getattr(train_fn, "__name__", "wrapped")
+    wrapped.__doc__ = train_fn.__doc__
+    return wrapped

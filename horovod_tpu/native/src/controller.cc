@@ -2,6 +2,8 @@
 
 #include <algorithm>
 
+#include "logging.h"
+
 namespace hvd {
 
 namespace {
@@ -99,10 +101,24 @@ bool Controller::ComputeResponseList(std::vector<Request> pending,
         // Some peer hasn't set this bit yet (routine cycle skew): HOLD
         // the request — next cycle usually agrees on the fast path,
         // saving the gather+bcast renegotiation round.
+        if (defer_counts_[req.name] == 1) {
+          // Entry into deferral is visible at debug so deferred-latency
+          // stalls are diagnosable before the stall inspector fires
+          // (routine one-cycle skew is common; don't warn).
+          HVD_LOG(Debug) << "deferring cached tensor '" << req.name
+                         << "' (peer cache-bit mismatch)";
+        }
         carryover_.push_back(std::move(req));
       } else {
         // Held long enough; renegotiate through next cycle's uncached
         // list so the slow round stays a globally-derived decision.
+        // Exceeding the bound means genuine cache divergence (e.g.
+        // capacity skew), worth surfacing: completion for this tensor
+        // lagged ~kMaxDeferCycles cycles and now pays a slow round.
+        HVD_LOG(Warning) << "cached tensor '" << req.name
+                         << "' exceeded the defer bound ("
+                         << kMaxDeferCycles
+                         << " cycles); forcing renegotiation";
         defer_counts_.erase(req.name);
         renegotiate_names_.insert(req.name);
         carryover_.push_back(std::move(req));

@@ -61,6 +61,11 @@ def sparse_allreduce(
     if g.ndim < 1:
         raise ValueError("sparse_allreduce needs a row dimension")
     flat = g.reshape(g.shape[0], -1)
+    # A rank may legitimately touch ZERO rows this step (an all-zero
+    # embedding grad): rows is then (0,) and vals (0, D), and both ride
+    # the same allgatherv round as the peers' nonzero contributions (the
+    # eager allgather negotiates per-process first dims, 0 included), so
+    # no rank ever skips the collective and deadlocks its peers.
     rows = np.flatnonzero(np.any(flat != 0, axis=1)).astype(np.int32)
     vals = np.ascontiguousarray(flat[rows])
 

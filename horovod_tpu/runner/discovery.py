@@ -1,4 +1,15 @@
-"""NIC / route discovery: find addresses every host can actually reach.
+"""Host and NIC discovery.
+
+Two subsystems share this module:
+
+* **Host discovery** (elastic membership): where does the job's host set
+  come from?  :class:`FixedHostDiscovery` wraps a static ``-H``/hostfile
+  list; :class:`ScriptHostDiscovery` re-runs a user script each poll
+  (Horovod Elastic's ``--host-discovery-script`` contract: one
+  ``hostname:slots`` line per available host) so the ElasticDriver can
+  admit replacement hosts between restarts.
+
+* **NIC / route discovery**: find addresses every host can actually reach.
 
 Reference: the driver/task service handshake (``run/run.py:118-270``,
 ``run/driver/driver_service.py``, ``run/task/task_service.py``): each task
@@ -24,9 +35,80 @@ import socket
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from horovod_tpu.runner.hosts import HostSpec
 from horovod_tpu.runner.rendezvous import KVClient
 
 SCOPE = "discovery"
+
+
+# ---- host discovery (elastic membership) ------------------------------------
+
+
+class HostDiscovery:
+    """Source of the currently-available host set (Horovod Elastic's
+    ``HostDiscovery`` interface)."""
+
+    def find_available_hosts(self) -> List[HostSpec]:
+        raise NotImplementedError
+
+
+class FixedHostDiscovery(HostDiscovery):
+    """A static host list (``-H``/``--hostfile``): membership can only
+    shrink (by blacklisting) and recover (by cooldown expiry)."""
+
+    def __init__(self, specs: List[HostSpec]) -> None:
+        self._specs = list(specs)
+
+    def find_available_hosts(self) -> List[HostSpec]:
+        return list(self._specs)
+
+
+class ScriptHostDiscovery(HostDiscovery):
+    """Polls a user script for the live host set (Horovod Elastic's
+    ``--host-discovery-script``).  The script prints one host per line as
+    ``hostname`` or ``hostname:slots``; exit code 0 with no output means
+    "no hosts currently available".  A failing or hanging script yields
+    the empty set (the driver treats that as below ``min_np`` and keeps
+    polling until its discovery timeout)."""
+
+    def __init__(self, script: str, timeout: float = 10.0) -> None:
+        self._script = script
+        self._timeout = timeout
+
+    def find_available_hosts(self) -> List[HostSpec]:
+        import subprocess
+
+        try:
+            out = subprocess.run(
+                self._script, shell=True, capture_output=True,
+                timeout=self._timeout)
+        except subprocess.TimeoutExpired:
+            return []
+        if out.returncode != 0:
+            return []
+        import logging
+
+        specs: List[HostSpec] = []
+        for line in out.stdout.decode(errors="replace").splitlines():
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            name, slots = line, 1
+            if ":" in line:
+                head, tail = line.rsplit(":", 1)
+                head = head.strip()
+                # Only a digit tail after a non-":"-terminated head is a
+                # slot count; anything else ("::1", "fe80::1", malformed
+                # text) is a whole hostname — a bad line must not crash
+                # the supervising driver.
+                if head and not head.endswith(":") and tail.isdigit():
+                    name, slots = head, int(tail)
+                elif not tail.isdigit():
+                    logging.getLogger("horovod_tpu").warning(
+                        "host discovery: no slot count in line %r; "
+                        "assuming 1 slot", line)
+            specs.append(HostSpec(name, slots))
+        return specs
 
 
 def local_addresses() -> List[str]:

@@ -1,0 +1,375 @@
+"""ElasticDriver: supervised restart instead of kill-all.
+
+The reference launcher's only fault policy is any-failure-kills-all
+(``gloo_run.py:162-259``, mirrored by :func:`launch_job`).  This module
+ports Horovod Elastic's driver (the v0.20 successor of that codebase)
+onto the fixed-mesh XLA world, where a membership change means
+**stop → re-rendezvous → rebuild mesh → recompile → resume from the
+last committed state**:
+
+* per-rank monitoring: exit codes from the spawn watchers, plus optional
+  heartbeat staleness over the rendezvous KV (a dead rank exits; a HUNG
+  rank only stops heartbeating);
+* failed-host blacklisting with an expiring cooldown
+  (:class:`horovod_tpu.runner.hosts.Blacklist`);
+* :class:`HostDiscovery` (static list or periodically polled script) to
+  admit replacement hosts between rendezvous epochs;
+* bounded restart: ``min_np`` / ``max_np`` / ``reset_limit`` knobs and
+  exponential backoff between epochs;
+* clean teardown/restart of the per-rank runtime: on failure the driver
+  publishes an ``elastic/notice.<epoch>`` key so surviving ranks exit at
+  their next commit boundary (``EXIT_CODE_RESTART``), waits a grace
+  period, then terminates stragglers; the next epoch gets distinct
+  rendezvous epoch keys and fresh coordination-service ports, and ranks
+  re-``init()`` over the surviving mesh, restoring the last durable
+  ``State.commit()``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+from horovod_tpu.elastic.interrupts import EXIT_CODE_RESTART
+from horovod_tpu.elastic.worker import KV_SCOPE, heartbeat_key, notice_key, state_key
+from horovod_tpu.runner import safe_shell_exec
+from horovod_tpu.runner.discovery import FixedHostDiscovery, HostDiscovery
+from horovod_tpu.runner.hosts import Blacklist, HostSpec, allocate, parse_hosts
+from horovod_tpu.runner.launch import spawn_ranks
+from horovod_tpu.runner.rendezvous import RendezvousServer
+from horovod_tpu.runner.run_func import _free_port
+
+logger = logging.getLogger("horovod_tpu")
+
+
+class ElasticJobError(RuntimeError):
+    """The elastic job cannot continue (below ``min_np`` or over
+    ``reset_limit``) — raised with a clear reason instead of hanging."""
+
+
+class ElasticDriver:
+    """Supervise an elastic job: launch, monitor, re-rendezvous, restart.
+
+    Parameters mirror ``horovodrun --min-np/--max-np`` (Horovod Elastic):
+
+    * ``min_np`` — abort (clearly) when fewer hosts remain available;
+    * ``max_np`` — cap the hosts used per epoch;
+    * ``reset_limit`` — abort after this many restarts (None = unbounded);
+    * ``blacklist_cooldown`` — seconds a failed host stays excluded
+      (None = forever);
+    * ``heartbeat_timeout`` — treat a rank as failed when its KV
+      heartbeat stops changing for this long, on the driver's clock
+      (None disables; exit codes are always monitored);
+    * ``startup_timeout`` — bound on a spawned rank never heartbeating
+      at all (hung inside startup); defaults to
+      ``max(60, 10 * heartbeat_timeout)`` when heartbeats are on;
+    * ``discovery_timeout`` — how long to keep polling discovery for
+      enough hosts before aborting below ``min_np``.  Default 0 =
+      fail-fast (right for a static ``-H`` list); with a discovery
+      script use a nonzero timeout so one transient script failure
+      (which legitimately yields the empty set) does not abort a
+      healthy job — the horovodrun CLI defaults it to 60 s there.
+    """
+
+    def __init__(
+        self,
+        command: List[str],
+        discovery: HostDiscovery,
+        *,
+        min_np: int = 1,
+        max_np: Optional[int] = None,
+        env: Optional[Dict[str, str]] = None,
+        reset_limit: Optional[int] = None,
+        blacklist_cooldown: Optional[float] = 600.0,
+        backoff_initial: float = 1.0,
+        backoff_max: float = 30.0,
+        shutdown_grace: float = safe_shell_exec.GRACEFUL_TERMINATION_TIME_S + 5.0,
+        heartbeat_interval: float = 1.0,
+        heartbeat_timeout: Optional[float] = None,
+        startup_timeout: Optional[float] = None,
+        discovery_timeout: float = 0.0,
+        discovery_interval: float = 1.0,
+        output_filename: Optional[str] = None,
+        coordinator_port: int = 0,
+        _executor=safe_shell_exec.execute,
+        _sleep=time.sleep,
+    ) -> None:
+        if min_np < 1:
+            raise ValueError("min_np must be >= 1")
+        if max_np is not None and max_np < min_np:
+            raise ValueError("max_np must be >= min_np")
+        self._command = list(command)
+        self._discovery = discovery
+        self._min_np = min_np
+        self._max_np = max_np
+        self._env = env
+        self._reset_limit = reset_limit
+        self.blacklist = Blacklist(cooldown=blacklist_cooldown)
+        self._backoff_initial = backoff_initial
+        self._backoff_max = backoff_max
+        self._shutdown_grace = shutdown_grace
+        self._heartbeat_interval = heartbeat_interval
+        self._heartbeat_timeout = heartbeat_timeout
+        # Bound on "spawned but never heartbeated" (hung inside startup,
+        # before the worker can publish): generous, because a cold rank
+        # pays imports + mesh compile before its first beat.
+        if startup_timeout is None and heartbeat_timeout is not None:
+            startup_timeout = max(60.0, 10.0 * heartbeat_timeout)
+        self._startup_timeout = startup_timeout
+        self._discovery_timeout = discovery_timeout
+        self._discovery_interval = discovery_interval
+        self._output_filename = output_filename
+        self._coordinator_port = coordinator_port
+        self._executor = _executor
+        self._sleep = _sleep
+        self.epoch = 0
+        self.resets = 0
+        self.epoch_sizes: List[int] = []  # world size used per epoch
+
+    # ---- public ----------------------------------------------------------
+
+    def run(self) -> int:
+        """Run the job to completion; returns 0 on success.  Raises
+        :class:`ElasticJobError` when the job cannot continue."""
+        env = dict(self._env if self._env is not None else os.environ)
+        if "HOROVOD_SECRET_KEY" not in env:
+            from horovod_tpu.runner import secret
+
+            env["HOROVOD_SECRET_KEY"] = secret.make_secret_key()
+        server = RendezvousServer(
+            self._coordinator_port,
+            secret_key=env["HOROVOD_SECRET_KEY"].encode())
+        port = server.start()
+        addr = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
+        try:
+            while True:
+                specs = self._wait_for_available_hosts()
+                ok, culprits, restart_requested = self._run_epoch(
+                    specs, env, addr, port, server)
+                if ok:
+                    return 0
+                for h in sorted(culprits):
+                    logger.warning(
+                        "elastic: blacklisting host %s (failure #%d)",
+                        h, self.blacklist.failure_count(h) + 1)
+                    self.blacklist.add(h)
+                self._register_reset(culprits, restart_requested)
+                self.epoch += 1
+        finally:
+            server.stop()
+
+    # ---- membership ------------------------------------------------------
+
+    def _wait_for_available_hosts(self) -> List[HostSpec]:
+        """Poll discovery until at least ``min_np`` non-blacklisted hosts
+        are available, or ``discovery_timeout`` elapses — then abort with
+        a clear error instead of hanging."""
+        deadline = time.monotonic() + self._discovery_timeout
+        while True:
+            discovered = self._discovery.find_available_hosts()
+            specs = self.blacklist.filter(discovered)
+            if len(specs) >= self._min_np:
+                if self._max_np is not None:
+                    specs = specs[: self._max_np]
+                return specs
+            if time.monotonic() >= deadline:
+                raise ElasticJobError(
+                    f"elastic job cannot continue: {len(specs)} host(s) "
+                    f"available, below min_np={self._min_np} "
+                    f"(discovered={[s.hostname for s in discovered]}, "
+                    f"blacklisted={self.blacklist.hosts()})")
+            self._sleep(self._discovery_interval)
+
+    def _register_reset(self, culprits: Set[str], restart_requested: bool) -> None:
+        self.resets += 1
+        if self._reset_limit is not None and self.resets > self._reset_limit:
+            raise ElasticJobError(
+                f"elastic job aborted: reset_limit={self._reset_limit} "
+                f"exceeded after {self.resets} restarts "
+                f"(last failure: hosts={sorted(culprits)}, "
+                f"restart_requested={restart_requested})")
+        backoff = min(self._backoff_initial * (2.0 ** (self.resets - 1)),
+                      self._backoff_max)
+        logger.warning(
+            "elastic: restart #%d (epoch %d -> %d) in %.1fs",
+            self.resets, self.epoch, self.epoch + 1, backoff)
+        self._sleep(backoff)
+
+    # ---- one rendezvous epoch --------------------------------------------
+
+    def _epoch_env(self, env: Dict[str, str]) -> Dict[str, str]:
+        eenv = dict(env)
+        eenv["HOROVOD_ELASTIC"] = "1"
+        eenv["HOROVOD_ELASTIC_EPOCH"] = str(self.epoch)
+        eenv["HOROVOD_ELASTIC_MIN_NP"] = str(self._min_np)
+        eenv.setdefault("HOROVOD_ELASTIC_HEARTBEAT",
+                        repr(self._heartbeat_interval))
+        # The dead epoch's coordination sockets (JAX gRPC service, native
+        # control plane) may linger in TIME_WAIT; every epoch gets fresh
+        # ports.  A user-provided port becomes the epoch-0 base.
+        for var in ("HOROVOD_JAX_PORT", "HOROVOD_NATIVE_PORT"):
+            if env.get(var):
+                eenv[var] = str(int(env[var]) + 2 * self.epoch)
+            else:
+                eenv[var] = str(_free_port())
+        return eenv
+
+    def _run_epoch(self, specs, env, addr, port, server):
+        """Returns ``(success, culprit_hosts, restart_requested)``."""
+        slots = allocate(specs)
+        eenv = self._epoch_env(env)
+        # Stale NIC-discovery reports from the dead world must not leak
+        # into this rendezvous.
+        server.clear_scope("discovery")
+        server.put(KV_SCOPE, state_key(self.epoch), json.dumps({
+            "epoch": self.epoch,
+            "size": len(slots),
+            "hosts": [s.hostname for s in specs],
+        }).encode())
+        self.epoch_sizes.append(len(slots))
+        logger.warning(
+            "elastic: epoch %d starting with %d host(s): %s",
+            self.epoch, len(specs), [s.hostname for s in specs])
+
+        out_dir = None
+        if self._output_filename:
+            out_dir = os.path.join(self._output_filename,
+                                   f"epoch.{self.epoch}")
+
+        failure = threading.Event()
+        lock = threading.Lock()
+        culprits: Set[str] = set()
+        restart_requested = False
+        first_failure: List[Optional[float]] = [None]
+        notice_sent = [False]
+
+        def _notify_failure(reason: str) -> None:
+            # Publish the membership-change notice so surviving ranks
+            # exit at their next commit boundary instead of being killed
+            # mid-step; stragglers are terminated after the grace period.
+            if not notice_sent[0]:
+                notice_sent[0] = True
+                server.put(KV_SCOPE, notice_key(self.epoch),
+                           json.dumps({"reason": reason}).encode())
+            if first_failure[0] is None:
+                first_failure[0] = time.monotonic()
+
+        def _on_exit(i: int, slot, rc: int) -> None:
+            nonlocal restart_requested
+            with lock:
+                if rc == 0:
+                    return
+                if rc == EXIT_CODE_RESTART:
+                    restart_requested = True
+                    _notify_failure(f"rank {slot.rank} requested restart")
+                elif rc < 0 or rc in (128 + 15, 128 + 9):
+                    # A signal death AFTER another failure is (almost
+                    # always) the driver's own TERM/KILL escalation — not
+                    # the culprit.  As the FIRST failure it is the real
+                    # event (OOM killer, external kill): blame the host,
+                    # or a persistently dying host would never blacklist
+                    # and the job would crash-loop on it forever.
+                    if first_failure[0] is None:
+                        culprits.add(slot.hostname)
+                        logger.warning(
+                            "elastic: rank %d on %s killed by signal "
+                            "(code %d)", slot.rank, slot.hostname, rc)
+                    _notify_failure(f"rank {slot.rank} terminated")
+                else:
+                    culprits.add(slot.hostname)
+                    logger.warning(
+                        "elastic: rank %d on %s exited with code %d",
+                        slot.rank, slot.hostname, rc)
+                    _notify_failure(
+                        f"rank {slot.rank} on {slot.hostname} failed ({rc})")
+
+        threads, exit_codes = spawn_ranks(
+            self._command, slots, eenv, addr, port,
+            output_filename=out_dir, failure=failure,
+            on_rank_exit=_on_exit, _executor=self._executor)
+
+        epoch_start = time.monotonic()
+        hb_seen: Dict[int, tuple] = {}  # rank -> (value, driver mono time)
+        while any(rc is None for rc in exit_codes):
+            self._sleep(0.1)
+            now = time.monotonic()
+            if self._heartbeat_timeout is not None:
+                self._check_heartbeats(server, slots, exit_codes, lock,
+                                       culprits, _notify_failure,
+                                       hb_seen, epoch_start)
+            with lock:
+                expired = (first_failure[0] is not None
+                           and now - first_failure[0] >= self._shutdown_grace)
+            if expired:
+                failure.set()
+        for t in threads:
+            t.join()
+
+        success = all(rc == 0 for rc in exit_codes)
+        return success, culprits, restart_requested
+
+    def _check_heartbeats(self, server, slots, exit_codes, lock, culprits,
+                          notify, hb_seen, epoch_start) -> None:
+        """A rank whose KV heartbeat went stale is HUNG (it would never
+        produce an exit code): mark its host as the culprit and trigger
+        the notice → grace → terminate sequence.
+
+        Staleness is measured on the DRIVER's monotonic clock from when
+        each heartbeat VALUE was first observed to change — immune to
+        worker-host wall-clock skew.  A rank that never heartbeats at all
+        (hung inside startup, before the notification manager runs) goes
+        stale ``startup_timeout`` after the epoch began."""
+        now = time.monotonic()
+
+        def _stale(slot, age):
+            with lock:
+                if slot.hostname not in culprits:
+                    logger.warning(
+                        "elastic: rank %d on %s heartbeat stale (%.1fs); "
+                        "treating as failed", slot.rank, slot.hostname, age)
+                    culprits.add(slot.hostname)
+                    notify(f"rank {slot.rank} on {slot.hostname} "
+                           "heartbeat stale")
+
+        for i, slot in enumerate(slots):
+            if exit_codes[i] is not None:
+                continue
+            raw = server.get(KV_SCOPE, heartbeat_key(self.epoch, slot.rank))
+            if raw is None:
+                # Never heartbeated: hung before the worker-side manager
+                # started (e.g. wedged inside init()).
+                if now - epoch_start >= self._startup_timeout:
+                    _stale(slot, now - epoch_start)
+                continue
+            prev = hb_seen.get(slot.rank)
+            if prev is None or prev[0] != raw:
+                hb_seen[slot.rank] = (raw, now)
+                continue
+            if now - prev[1] >= self._heartbeat_timeout:
+                _stale(slot, now - prev[1])
+
+
+def run_elastic(
+    command: List[str],
+    *,
+    discovery: Optional[HostDiscovery] = None,
+    hosts: Optional[str] = None,
+    hostfile: Optional[str] = None,
+    min_np: int = 1,
+    max_np: Optional[int] = None,
+    env: Optional[Dict[str, str]] = None,
+    **driver_kwargs,
+) -> int:
+    """Programmatic / CLI entry point: build a :class:`HostDiscovery`
+    from a static host list unless one is given, then supervise the job
+    with an :class:`ElasticDriver`.  Returns the job's exit code; raises
+    :class:`ElasticJobError` when the job cannot continue."""
+    if discovery is None:
+        discovery = FixedHostDiscovery(parse_hosts(hosts, hostfile))
+    driver = ElasticDriver(command, discovery, min_np=min_np, max_np=max_np,
+                           env=env, **driver_kwargs)
+    return driver.run()

@@ -9,8 +9,9 @@ rank/local_rank/cross_rank per process (``run/gloo_run.py:53-111``
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 
 @dataclass(frozen=True)
@@ -75,6 +76,45 @@ def parse_hosts(hosts: Optional[str] = None, hostfile: Optional[str] = None) -> 
         else:
             specs.append(HostSpec(item, 1))
     return specs
+
+
+class Blacklist:
+    """Failed-host blacklist with an expiring cooldown (Horovod Elastic's
+    ``HostManager`` blacklist semantics): a host that killed a rank is
+    excluded from re-rendezvous for ``cooldown`` seconds, then allowed
+    back (transient failures — a rebooting machine, a flaky NIC — heal;
+    a persistently bad host re-blacklists itself on the next failure).
+    ``cooldown=None`` blacklists forever."""
+
+    def __init__(self, cooldown: Optional[float] = 600.0,
+                 _clock=time.monotonic) -> None:
+        self._cooldown = cooldown
+        self._clock = _clock
+        self._entries: Dict[str, float] = {}  # hostname -> blacklist time
+        self._counts: Dict[str, int] = {}
+
+    def add(self, hostname: str) -> None:
+        self._entries[hostname] = self._clock()
+        self._counts[hostname] = self._counts.get(hostname, 0) + 1
+
+    def __contains__(self, hostname: str) -> bool:
+        t = self._entries.get(hostname)
+        if t is None:
+            return False
+        if self._cooldown is not None and self._clock() - t >= self._cooldown:
+            del self._entries[hostname]  # cooldown expired: host may retry
+            return False
+        return True
+
+    def hosts(self) -> List[str]:
+        """Currently-blacklisted hostnames (expired entries dropped)."""
+        return [h for h in list(self._entries) if h in self]
+
+    def failure_count(self, hostname: str) -> int:
+        return self._counts.get(hostname, 0)
+
+    def filter(self, specs: List[HostSpec]) -> List[HostSpec]:
+        return [s for s in specs if s.hostname not in self]
 
 
 def allocate(specs: List[HostSpec]) -> List[SlotInfo]:

@@ -14,7 +14,7 @@ import shlex
 import signal
 import sys
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from horovod_tpu.runner import safe_shell_exec
 from horovod_tpu.runner.hosts import HostSpec, SlotInfo, allocate
@@ -24,7 +24,14 @@ SSH_COMMAND_PREFIX = "ssh -o PasswordAuthentication=no -o StrictHostKeyChecking=
 
 
 def _is_local(hostname: str) -> bool:
-    return hostname in ("localhost", "127.0.0.1", os.uname().nodename)
+    # "localhost-<suffix>" names are also local: distinct LOGICAL hosts on
+    # one machine, used by elastic fault-injection drills and
+    # single-machine simulation where host-level blacklisting must
+    # distinguish the "hosts".  The dash is deliberate — a real cluster
+    # host named e.g. "localhost2" must still go over ssh.
+    return (hostname in ("localhost", "localhost.localdomain", "127.0.0.1",
+                         os.uname().nodename)
+            or hostname.startswith("localhost-"))
 
 
 def build_command(
@@ -73,6 +80,81 @@ def build_command(
             stdin_data)
 
 
+def spawn_ranks(
+    command: List[str],
+    slots: List[SlotInfo],
+    env: Dict[str, str],
+    coordinator_addr: str,
+    coordinator_port: int,
+    *,
+    output_filename: Optional[str] = None,
+    failure: Optional[threading.Event] = None,
+    on_rank_exit=None,
+    _executor=safe_shell_exec.execute,
+) -> Tuple[List[threading.Thread], List[Optional[int]]]:
+    """Start one supervised spawn thread per slot; returns the (started)
+    threads and the shared exit-code list they fill in.
+
+    The per-epoch core shared by :func:`launch_job` (single round,
+    kill-all) and the ElasticDriver (round per rendezvous epoch,
+    supervised restart).  ``failure`` set → every rank's process group is
+    terminated (TERM → grace → KILL); ``on_rank_exit(index, slot, rc)``
+    fires as each rank exits, from that rank's watcher thread."""
+    exit_codes: List[Optional[int]] = [None] * len(slots)
+
+    def _run(i: int, slot: SlotInfo) -> None:
+        # EVERY exit path must record an exit code: a None left behind
+        # would wedge supervisors polling this list (the ElasticDriver's
+        # epoch monitor) and read as success in launch_job's rollup.
+        out = err = None
+        try:
+            try:
+                cmd, slot_env, stdin_data = build_command(
+                    slot, command, env, coordinator_addr, coordinator_port)
+                if output_filename:
+                    os.makedirs(output_filename, exist_ok=True)
+                    out = open(os.path.join(
+                        output_filename, f"rank.{slot.rank}.stdout"), "w")
+                    err = open(os.path.join(
+                        output_filename, f"rank.{slot.rank}.stderr"), "w")
+                prefix = (f"[{slot.rank}]<stdout>:"
+                          if len(slots) > 1 else None)
+                rc = _executor(
+                    cmd,
+                    env=slot_env,
+                    stdout=out or sys.stdout,
+                    stderr=err or sys.stderr,
+                    prefix=prefix,
+                    events=[failure] if failure is not None else [],
+                    stdin_data=stdin_data,
+                )
+            except Exception:
+                import traceback
+
+                try:
+                    traceback.print_exc(file=err or sys.stderr)
+                except OSError:
+                    pass
+                rc = 1
+        finally:
+            for f in (out, err):
+                if f:
+                    try:
+                        f.close()
+                    except OSError:  # e.g. ENOSPC on the buffered flush
+                        pass
+            exit_codes[i] = rc
+            if on_rank_exit is not None:
+                on_rank_exit(i, slot, rc)
+
+    threads = []
+    for i, slot in enumerate(slots):
+        t = threading.Thread(target=_run, args=(i, slot), daemon=True)
+        t.start()
+        threads.append(t)
+    return threads, exit_codes
+
+
 def launch_job(
     command: List[str],
     host_specs: List[HostSpec],
@@ -84,7 +166,9 @@ def launch_job(
 ) -> int:
     """Launch ``command`` on every host; returns first nonzero exit code
     (and terminates all other ranks when any rank fails — the reference's
-    any-failure-kills-all policy, ``gloo_run.py:162-259``)."""
+    any-failure-kills-all policy, ``gloo_run.py:162-259``).  For
+    supervised restart instead of kill-all, see
+    :mod:`horovod_tpu.runner.elastic_driver`."""
     env = dict(env if env is not None else os.environ)
     # Per-job HMAC secret so only this job's ranks can write rendezvous
     # state (reference run/common/util/secret.py usage in gloo_run).
@@ -98,34 +182,12 @@ def launch_job(
     port = server.start()
     addr = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
 
-    exit_codes: List[Optional[int]] = [None] * len(slots)
     failure = threading.Event()
-    threads = []
+    # Pre-sized so the signal handler's "wait for the watchers" loop is
+    # correct even if a signal lands before spawn_ranks rebinds it.
+    exit_codes: List[Optional[int]] = [None] * len(slots)
 
-    def _run(i: int, slot: SlotInfo) -> None:
-        cmd, slot_env, stdin_data = build_command(slot, command, env, addr,
-                                                  port)
-        out = err = None
-        if output_filename:
-            os.makedirs(output_filename, exist_ok=True)
-            out = open(os.path.join(output_filename, f"rank.{slot.rank}.stdout"), "w")
-            err = open(os.path.join(output_filename, f"rank.{slot.rank}.stderr"), "w")
-        prefix = f"[{slot.rank}]<stdout>:" if len(slots) > 1 else None
-        try:
-            rc = _executor(
-                cmd,
-                env=slot_env,
-                stdout=out or sys.stdout,
-                stderr=err or sys.stderr,
-                prefix=prefix,
-                events=[failure],
-                stdin_data=stdin_data,
-            )
-        finally:
-            for f in (out, err):
-                if f:
-                    f.close()
-        exit_codes[i] = rc
+    def _on_exit(i: int, slot: SlotInfo, rc: int) -> None:
         if rc != 0:
             failure.set()
 
@@ -162,10 +224,10 @@ def launch_job(
                 pass
 
     try:
-        for i, slot in enumerate(slots):
-            t = threading.Thread(target=_run, args=(i, slot), daemon=True)
-            t.start()
-            threads.append(t)
+        threads, exit_codes = spawn_ranks(
+            command, slots, env, addr, port,
+            output_filename=output_filename, failure=failure,
+            on_rank_exit=_on_exit, _executor=_executor)
         for t in threads:
             t.join()
     finally:

@@ -58,6 +58,20 @@ class _KVHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         scope, key = self._key()
+        if key == "":
+            # Scope listing (GET /scope/): JSON array of keys.  Lets the
+            # elastic driver scan per-rank heartbeat keys without knowing
+            # the live rank set in advance.
+            import json
+
+            with self.server._lock:
+                keys = sorted(self.server._store.get(scope, {}))
+            body = json.dumps(keys).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         with self.server._lock:
             value = self.server._store.get(scope, {}).get(key)
         if value is None:
@@ -126,6 +140,41 @@ class RendezvousServer:
             self._thread.join(timeout=5)
         self._httpd.server_close()
 
+    # ---- in-process access (supervisor side) ----------------------------
+    # The ElasticDriver owns this server, so it reads/writes the store
+    # directly instead of looping through HTTP.  Values written by
+    # clients are stored signed; these helpers sign/verify symmetrically.
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        from horovod_tpu.runner import secret
+
+        signed = secret.sign(value, self._httpd._secret_key)
+        with self._httpd._lock:
+            self._httpd._store.setdefault(scope, {})[key] = signed
+
+    def get(self, scope: str, key: str) -> Optional[bytes]:
+        from horovod_tpu.runner import secret
+
+        with self._httpd._lock:
+            value = self._httpd._store.get(scope, {}).get(key)
+        if value is None:
+            return None
+        try:
+            return secret.verify(value, self._httpd._secret_key)
+        except ValueError:
+            return None
+
+    def keys(self, scope: str) -> list:
+        with self._httpd._lock:
+            return sorted(self._httpd._store.get(scope, {}))
+
+    def clear_scope(self, scope: str) -> None:
+        """Drop a scope's keys (epoch turnover: stale NIC-discovery or
+        run-function results from a dead world must not leak into the
+        next rendezvous)."""
+        with self._httpd._lock:
+            self._httpd._store.pop(scope, None)
+
 
 class KVClient:
     """Blocking KV client (``run/http/http_client.py`` equivalents)."""
@@ -168,6 +217,15 @@ class KVClient:
                 return v
             time.sleep(0.1)
         raise TimeoutError(f"rendezvous key {scope}/{key} not published")
+
+    def keys(self, scope: str) -> list:
+        """List a scope's keys (GET /scope/)."""
+        import json
+
+        payload = urlrequest.urlopen(
+            f"{self._base}/{scope}/", timeout=self._timeout
+        ).read()
+        return json.loads(payload)
 
     def delete_scope(self, scope: str) -> None:
         from horovod_tpu.runner import secret

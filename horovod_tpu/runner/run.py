@@ -116,6 +116,35 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     default=None,
                     choices=["TRACE", "DEBUG", "INFO", "WARNING", "ERROR", "FATAL"])
 
+    el = p.add_argument_group(
+        "elastic arguments",
+        "supervised restart instead of kill-all: survive rank failure by "
+        "re-rendezvousing over the remaining (non-blacklisted) hosts and "
+        "resuming from the last committed elastic.State")
+    el.add_argument("--min-np", type=int, dest="min_np", default=None,
+                    help="minimum hosts to keep the job alive; enables "
+                         "elastic mode")
+    el.add_argument("--max-np", type=int, dest="max_np", default=None,
+                    help="maximum hosts to use per rendezvous epoch")
+    el.add_argument("--reset-limit", type=int, dest="reset_limit",
+                    default=None,
+                    help="abort after this many supervised restarts")
+    el.add_argument("--blacklist-cooldown", type=float,
+                    dest="blacklist_cooldown", default=600.0,
+                    help="seconds a failed host stays blacklisted "
+                         "(0 = forever)")
+    el.add_argument("--host-discovery-script", dest="host_discovery_script",
+                    default=None,
+                    help="script printing one available host per line as "
+                         "hostname[:slots]; polled before each epoch; "
+                         "enables elastic mode")
+    el.add_argument("--discovery-timeout", type=float,
+                    dest="discovery_timeout", default=None,
+                    help="seconds to keep polling discovery for min-np "
+                         "hosts before aborting (default: 60 with a "
+                         "discovery script — one transient script failure "
+                         "must not kill the job — else 0)")
+
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command to launch")
     args = p.parse_args(argv)
@@ -253,6 +282,40 @@ def _run(args: argparse.Namespace) -> int:
     config_parser.set_env_from_args(env, args)
     check_hosts_ssh([h.hostname for h in host_specs],
                     use_cache=not args.disable_cache)
+    elastic = (args.min_np is not None
+               or args.host_discovery_script is not None)
+    if elastic:
+        from horovod_tpu.runner.discovery import (
+            FixedHostDiscovery, ScriptHostDiscovery)
+        from horovod_tpu.runner.elastic_driver import (
+            ElasticJobError, run_elastic)
+
+        if args.host_discovery_script:
+            discovery = ScriptHostDiscovery(args.host_discovery_script)
+            discovery_timeout = (args.discovery_timeout
+                                 if args.discovery_timeout is not None
+                                 else 60.0)
+        else:
+            discovery = FixedHostDiscovery(host_specs)
+            discovery_timeout = args.discovery_timeout or 0.0
+        if args.verbose:
+            print(f"horovodrun: elastic launch "
+                  f"(min_np={args.min_np or 1}, max_np={args.max_np})")
+        try:
+            return run_elastic(
+                args.command,
+                discovery=discovery,
+                min_np=args.min_np or 1,
+                max_np=args.max_np,
+                env=env,
+                reset_limit=args.reset_limit,
+                blacklist_cooldown=args.blacklist_cooldown or None,
+                discovery_timeout=discovery_timeout,
+                output_filename=args.output_filename,
+                coordinator_port=args.start_port,
+            )
+        except ElasticJobError as e:
+            raise SystemExit(f"horovodrun: {e}")
     if args.verbose:
         print(f"horovodrun: launching on {len(host_specs)} host(s)")
     return launch_job(

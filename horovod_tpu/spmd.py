@@ -22,13 +22,21 @@ from jax.sharding import PartitionSpec as P
 
 from horovod_tpu import basics
 
-try:  # jax >= 0.8 stable API
+try:  # jax >= 0.8 stable API (or the _compat re-export on older jax)
     _shard_map = jax.shard_map
-    _SHARD_MAP_KW = True
 except AttributeError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map as _shard_map
 
-    _SHARD_MAP_KW = False
+# The replication-check kwarg was renamed check_rep -> check_vma when
+# shard_map was promoted to the stable namespace; sniff the signature
+# rather than the attribute location (horovod_tpu._compat re-exports the
+# experimental one as jax.shard_map on older runtimes).
+import inspect as _inspect
+
+try:
+    _SHARD_MAP_KW = "check_vma" in _inspect.signature(_shard_map).parameters
+except (TypeError, ValueError):  # pragma: no cover - exotic wrappers
+    _SHARD_MAP_KW = True
 
 
 def shard(fn, *, in_specs, out_specs, mesh=None, check_replication: bool = False):

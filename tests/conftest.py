@@ -11,10 +11,23 @@ the TPU platform selected, so env vars (XLA_FLAGS/JAX_PLATFORMS) are too
 late — we must use jax.config.update before any backend is touched.
 """
 
-import jax
+import os
+
+# Must precede backend initialization: on JAX builds without the
+# jax_num_cpu_devices config option the XLA flag is the only way to get
+# virtual CPU devices, and it is read when the CPU backend spins up.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:  # older JAX: XLA_FLAGS above does the job
+    pass
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,79 @@
+"""ElasticDriver fault-injection worker: one rank of a supervised
+elastic job.
+
+Driven by tests/test_elastic.py::TestElasticDriver — the full recovery
+loop: ELASTIC_CRASH_RANK dies mid-training in epoch ELASTIC_CRASH_EPOCH
+(after a commit), the driver detects it, survivors hit a CollectiveError
+(peer gone mid-negotiation), roll back via ``elastic.run`` and exit with
+EXIT_CODE_RESTART; the driver blacklists the failed host, re-rendezvouses
+over the survivors (fresh epoch env/ports), and the respawned ranks
+restore the last committed State and run to completion.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.environ["REPO"])
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu import elastic  # noqa: E402
+
+CKPT = os.environ["ELASTIC_CKPT"]
+RESULTS = os.environ["ELASTIC_RESULTS"]
+EPOCH = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0"))
+CRASH_RANK = int(os.environ.get("ELASTIC_CRASH_RANK", "-1"))
+CRASH_EPOCH = int(os.environ.get("ELASTIC_CRASH_EPOCH", "0"))
+CRASH_AT_STEP = int(os.environ.get("ELASTIC_CRASH_AT_STEP", "7"))
+COMMIT_EVERY = 5
+TOTAL_STEPS = 10
+
+hvd.init()
+rank = hvd.process_rank()
+size = hvd.num_processes()
+
+journal = open(os.path.join(RESULTS, f"journal.e{EPOCH}.r{rank}"), "w")
+
+state = elastic.State(
+    params={"w": np.zeros(8, np.float32)},
+    step=0,
+)
+resumed_from = int(state.step) if state.restore(CKPT) else None
+
+
+@elastic.run
+def train(state):
+    while int(state.step) < TOTAL_STEPS:
+        step = int(state.step)
+        grad = np.full(8, float(rank + 1), np.float32)
+        reduced = hvd.allreduce(grad, hvd.Average, name=f"e{EPOCH}.g.{step}")
+        state.params["w"] = state.params["w"] - 0.1 * np.asarray(reduced)
+        state.step = step + 1
+        journal.write(f"{step + 1}\n")
+        journal.flush()
+        if state.step % COMMIT_EVERY == 0:
+            state.commit(CKPT)
+            hvd.barrier()  # commit durable before anyone can crash past it
+        if (rank == CRASH_RANK and EPOCH == CRASH_EPOCH
+                and state.step == CRASH_AT_STEP):
+            print(f"ELASTIC-WORKER-CRASH rank={rank} step={state.step}",
+                  flush=True)
+            os._exit(17)  # simulated host failure: no cleanup, no shutdown
+    return int(state.step)
+
+
+final_step = train(state)
+checksum = float(np.sum(state.params["w"]))
+with open(os.path.join(RESULTS, f"final.e{EPOCH}.r{rank}.json"), "w") as f:
+    json.dump({"rank": rank, "size": size, "epoch": EPOCH,
+               "step": final_step, "resumed_from": resumed_from,
+               "checksum": checksum}, f)
+journal.close()
+hvd.shutdown()
+print(f"ELASTIC-WORKER-OK rank={rank} step={final_step}", flush=True)

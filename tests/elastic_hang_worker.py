@@ -1,0 +1,34 @@
+"""Heartbeat-monitoring worker: publishes KV heartbeats like the
+WorkerNotificationManager, and ELASTIC_HANG_RANK (epoch 0 only) stops
+heartbeating while staying alive — the only failure mode exit-code
+monitoring cannot see.  Deliberately JAX-free so the heartbeat test
+stays fast."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ["REPO"])
+
+from horovod_tpu.elastic.worker import KV_SCOPE, heartbeat_key  # noqa: E402
+from horovod_tpu.runner.rendezvous import KVClient  # noqa: E402
+
+rank = int(os.environ["HOROVOD_RANK"])
+epoch = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0"))
+hang_rank = int(os.environ.get("ELASTIC_HANG_RANK", "-1"))
+interval = float(os.environ.get("HOROVOD_ELASTIC_HEARTBEAT", "0.2"))
+
+kv = KVClient(os.environ["HOROVOD_COORDINATOR_ADDR"],
+              int(os.environ["HOROVOD_COORDINATOR_PORT"]), timeout=5.0)
+
+hang = rank == hang_rank and epoch == 0
+# Everyone heartbeats for ~1s; then the hang rank goes silent but stays
+# alive (a wedged process), while the others finish cleanly.
+for _ in range(max(2, int(1.0 / interval))):
+    kv.put(KV_SCOPE, heartbeat_key(epoch, rank), repr(time.time()).encode())
+    time.sleep(interval)
+if hang:
+    print(f"ELASTIC-HANG rank={rank}", flush=True)
+    while True:  # silent forever: only stale-heartbeat detection sees this
+        time.sleep(1.0)
+print(f"ELASTIC-HANG-WORKER-OK rank={rank}", flush=True)

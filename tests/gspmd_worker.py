@@ -37,9 +37,10 @@ sys.path.insert(0, os.environ["REPO"])
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update(
-    "jax_num_cpu_devices", int(os.environ.get("GSPMD_LOCAL_DEVICES", "4"))
-)
+
+from horovod_tpu._compat import set_cpu_device_count  # noqa: E402
+
+set_cpu_device_count(int(os.environ.get("GSPMD_LOCAL_DEVICES", "4")))
 
 import numpy as np  # noqa: E402
 import optax  # noqa: E402

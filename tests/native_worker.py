@@ -20,7 +20,9 @@ SCENARIO = sys.argv[1] if len(sys.argv) > 1 else "full"
 if SCENARIO == "localsize":
     # 2 chips per process: the worker-count seam scenario (size() = 2 *
     # num_processes) — must be configured before hvd.init() builds the mesh.
-    jax.config.update("jax_num_cpu_devices", 2)
+    from horovod_tpu._compat import set_cpu_device_count
+
+    set_cpu_device_count(2)
 
 import numpy as np  # noqa: E402
 
@@ -254,6 +256,27 @@ def scenario_full():
     np.testing.assert_allclose(sp_out, dense_ref, rtol=1e-6)
     assert sp_stats["rows"] == 3 and sp_stats["total_rows"] == 32
     assert sp_stats["sparse_bytes"] < sp_stats["dense_bytes"] / 2
+
+    # Empty contribution (ADVICE r5): the last rank touched ZERO rows
+    # this step (an all-zero embedding grad is possible in real training)
+    # — its (0,) / (0, D) submissions must ride the same allgatherv
+    # round as its peers' nonzero contributions.
+    emb2 = np.zeros((32, 4), np.float32)
+    if rank != size - 1:
+        emb2[2 * rank] = rank + 1.0
+        emb2[11] = 3.0
+    dense_ref2 = hvd.allreduce(emb2, hvd.Sum, name="spg.empty.ref")
+    sp_out2, sp_stats2 = SP.sparse_allreduce(
+        emb2, hvd.Sum, name="spg.empty", return_stats=True)
+    np.testing.assert_allclose(sp_out2, dense_ref2, rtol=1e-6)
+    expect_rows = 0 if rank == size - 1 else 2
+    assert sp_stats2["rows"] == expect_rows, sp_stats2
+
+    # All ranks empty: the degenerate gather (every contribution zero
+    # rows) must return the zero gradient, not divide-by-zero or hang.
+    zero = np.zeros((8, 2), np.float32)
+    sp_out3 = SP.sparse_allreduce(zero, hvd.Average, name="spg.allempty")
+    np.testing.assert_allclose(sp_out3, zero)
 
     hvd.barrier()
     hvd.shutdown()

@@ -242,6 +242,75 @@ class TestRendezvous:
         finally:
             server.stop()
 
+    def test_scope_listing_and_server_side_access(self):
+        """GET /scope/ lists keys (elastic heartbeat scanning), and the
+        supervisor-side server helpers interoperate with signed client
+        writes."""
+        server = rendezvous.RendezvousServer()
+        port = server.start()
+        try:
+            client = rendezvous.KVClient("127.0.0.1", port)
+            client.put("hb", "r0", b"1.0")
+            client.put("hb", "r1", b"2.0")
+            assert client.keys("hb") == ["r0", "r1"]
+            assert server.keys("hb") == ["r0", "r1"]
+            assert server.get("hb", "r1") == b"2.0"
+            server.put("hb", "r2", b"3.0")
+            assert client.get("hb", "r2") == b"3.0"
+            server.clear_scope("hb")
+            assert client.keys("hb") == []
+            assert client.get("hb", "r0") is None
+        finally:
+            server.stop()
+
+
+class TestHostDiscovery:
+    def test_fixed(self):
+        from horovod_tpu.runner.discovery import FixedHostDiscovery
+
+        specs = [HostSpec("a", 4), HostSpec("b", 4)]
+        assert FixedHostDiscovery(specs).find_available_hosts() == specs
+
+    def test_script(self, tmp_path):
+        from horovod_tpu.runner.discovery import ScriptHostDiscovery
+
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\n"
+                          "echo 'node1:4'\n"
+                          "echo '# stale entry'\n"
+                          "echo 'node2'\n")
+        script.chmod(0o755)
+        specs = ScriptHostDiscovery(str(script)).find_available_hosts()
+        assert specs == [HostSpec("node1", 4), HostSpec("node2", 1)]
+
+    def test_failing_script_yields_empty(self, tmp_path):
+        from horovod_tpu.runner.discovery import ScriptHostDiscovery
+
+        assert ScriptHostDiscovery("exit 3").find_available_hosts() == []
+
+
+class TestBlacklist:
+    def test_cooldown_expiry(self):
+        from horovod_tpu.runner.hosts import Blacklist
+
+        clock = [0.0]
+        b = Blacklist(cooldown=5.0, _clock=lambda: clock[0])
+        b.add("bad")
+        assert "bad" in b and b.hosts() == ["bad"]
+        assert b.filter([HostSpec("bad", 1), HostSpec("ok", 1)]) == [
+            HostSpec("ok", 1)]
+        clock[0] = 5.1  # cooldown elapsed: host readmitted
+        assert "bad" not in b and b.hosts() == []
+        b.add("bad")
+        assert b.failure_count("bad") == 2
+
+    def test_forever(self):
+        from horovod_tpu.runner.hosts import Blacklist
+
+        b = Blacklist(cooldown=None)
+        b.add("bad")
+        assert "bad" in b
+
 
 class TestLaunch:
     def test_command_construction_local(self):

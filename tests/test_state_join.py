@@ -140,3 +140,94 @@ class TestElasticState:
             return state.x + 1
 
         assert train(st) == 2
+
+
+class TestElasticCommitRollback:
+    def test_rollback_restores_last_commit(self):
+        st = elastic.State(params={"w": np.arange(4, dtype=np.float32)}, step=0)
+        st.params["w"] = st.params["w"] + 1.0
+        st.step = 5
+        st.commit()
+        st.params["w"] = st.params["w"] * 100.0  # uncommitted wreckage
+        st.step = 6
+        st.rollback()
+        assert st.step == 5
+        np.testing.assert_allclose(st.params["w"],
+                                   np.arange(4, dtype=np.float32) + 1.0)
+
+    def test_rollback_before_commit_restores_init(self):
+        st = elastic.State(x=[1, 2], step=0)
+        st.x.append(3)
+        st.step = 9
+        st.rollback()
+        assert st.x == [1, 2] and st.step == 0
+
+    def test_snapshot_survives_donated_buffers(self):
+        """make_train_step donates its input buffers by default; the
+        committed snapshot must hold its own copies, not references that
+        the next step deletes."""
+        w = jnp.arange(4, dtype=jnp.float32)
+        st = elastic.State(params={"w": w}, step=0)
+        st.commit()
+        w.delete()  # what donation does to the committed reference
+        st.rollback()
+        np.testing.assert_allclose(np.asarray(st.params["w"]),
+                                   [0.0, 1.0, 2.0, 3.0])
+
+    def test_commit_also_writes_durable_checkpoint(self, tmp_path):
+        path = str(tmp_path / "st.pkl")
+        st = elastic.State(step=7)
+        st.commit(path)
+        st2 = elastic.State(step=0)
+        assert st2.restore(path) and st2.step == 7
+
+    def test_hosts_updated_interrupt_at_commit_boundary(self):
+        st = elastic.State(step=1)
+        st.on_hosts_updated()
+        with pytest.raises(elastic.HostsUpdatedInterrupt):
+            st.commit()
+        st.commit()  # one-shot: cleared after raising
+        st.rollback()
+        assert st.step == 1  # the interrupting commit still snapshotted
+
+    def test_run_replays_uncommitted_step_after_internal_error(self):
+        """The elastic.run contract: a committed step is never lost, an
+        uncommitted one is cleanly replayed after a collective failure."""
+        st = elastic.State(acc=0.0, step=0)
+        attempts = []
+
+        @elastic.run
+        def train(state):
+            attempts.append(int(state.step))
+            while state.step < 4:
+                state.acc = float(state.acc) + 1.0
+                state.step = int(state.step) + 1
+                if state.step == 2:
+                    state.commit()
+                if state.step == 3 and len(attempts) == 1:
+                    # uncommitted step 3 dies mid-collective
+                    raise elastic.HorovodInternalError("peer died")
+            return int(state.step)
+
+        assert train(st) == 4
+        assert attempts == [0, 2]  # replay resumed from the commit
+        assert st.acc == 4.0  # step 3's first, discarded attempt not double-counted
+
+    def test_run_resyncs_after_hosts_updated(self):
+        st = elastic.State(step=0)
+        seen = []
+
+        @elastic.run
+        def train(state):
+            seen.append(int(state.step))
+            while state.step < 3:
+                state.step = int(state.step) + 1
+                if state.step == 2 and len(seen) == 1:
+                    state.on_hosts_updated()
+                state.commit()  # boundary: interrupt surfaces here
+            return int(state.step)
+
+        assert train(st) == 3
+        # second attempt resumed from the committed step 2 (no rollback
+        # on a hosts-updated interrupt: the state is commit-consistent)
+        assert seen == [0, 2]
