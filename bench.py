@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import sys
 import time
 
 import jax
@@ -59,6 +60,22 @@ def main() -> None:
     from horovod_tpu.models import inception, resnet
 
     hvd.init()
+
+    # CPU fallback (configured TPU platform unavailable): a TPU-sized run
+    # burns the whole harness budget before emitting its JSON line
+    # (BENCH_r05: rc=124 at batch 384 on CPU) — clamp to a smoke
+    # configuration so the line is ALWAYS emitted within the time budget.
+    # The metric string and cpu_smoke flag disclose the clamp.
+    cpu_smoke = jax.devices()[0].platform == "cpu"
+    if cpu_smoke:
+        smoke = {"batch_size": 8, "num_warmup_batches": 2,
+                 "num_batches_per_iter": 2, "num_iters": 2}
+        clamped = {k: v for k, v in smoke.items() if getattr(args, k) > v}
+        for k, v in clamped.items():
+            setattr(args, k, v)
+        if clamped:
+            print(f"TPU unavailable — running on CPU; clamped {clamped} "
+                  "to a smoke configuration", file=sys.stderr)
 
     models_mod = inception if args.model == "InceptionV3" else resnet
     if args.model == "InceptionV3" and args.image_size == 224:
@@ -243,6 +260,7 @@ def main() -> None:
         "xla_flops_per_img": round(flops_per_img / 1e9, 2),
         "chip": kind,
         "peak_bf16_tflops": peak / 1e12 if peak else None,
+        "cpu_smoke": cpu_smoke,
     }
     if fed_img_secs:
         fed = float(np.median(fed_img_secs))

@@ -208,16 +208,20 @@ def _rope(q, k, theta: float, pos_offset=0, positions=None):
     """Rotary position embedding over the head dim (applied to q and k).
     Shapes: (B, S, H, Dh).  ``pos_offset`` shifts positions when the
     sequence axis is sharded (ring attention: shard r starts at
-    r*S_local); ``positions`` overrides with EXPLICIT per-row global
-    positions (zigzag layout: this shard's rows are non-contiguous)."""
+    r*S_local); ``positions`` overrides with EXPLICIT global positions —
+    ``(S,)`` per sequence row (zigzag layout: this shard's rows are
+    non-contiguous) or ``(B, S)`` per BATCH row (continuous-batching
+    decode: every cache slot sits at a different depth)."""
     B, S, H, Dh = q.shape
     half = Dh // 2
     freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
     pos = (positions.astype(jnp.float32) if positions is not None
            else pos_offset + jnp.arange(S, dtype=jnp.float32))
-    ang = pos[:, None] * freqs[None, :]  # (S, half)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = pos[..., None] * freqs  # (S, half) or (B, S, half)
+    if ang.ndim == 2:
+        ang = ang[None]
+    cos = jnp.cos(ang)[:, :, None, :]  # (1 | B, S, 1, half)
+    sin = jnp.sin(ang)[:, :, None, :]
 
     def rot(x):
         x1, x2 = x[..., :half], x[..., half:]
@@ -526,9 +530,12 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int = 0) -> Dict:
     }
 
 
-def _attention_decode(x, p, cfg: TransformerConfig, k_cache, v_cache, pos):
-    """One-token attention against the cache: write this position's K/V
-    at ``pos``, attend q over positions <= pos (static-shape mask).
+def _cache_attend(qh, k_cache, v_cache, mask):
+    """One query token per row against the full cache — the ONE copy of
+    the decode attention math, shared by the scalar-position path
+    (:func:`_attention_decode`) and the per-slot path
+    (:func:`_attention_decode_slots`) so the bandwidth discipline cannot
+    fork.  ``mask`` is broadcastable to ``(B, H_kv, G, T)``.
 
     Bandwidth discipline (decode is cache-bandwidth-bound): the cache is
     dotted IN ITS STORED DTYPE with f32 MXU accumulation
@@ -539,25 +546,31 @@ def _attention_decode(x, p, cfg: TransformerConfig, k_cache, v_cache, pos):
     throughput on chip.  For f32 caches the math is bit-identical to the
     upcast formulation; for bf16 caches the products round to bf16
     (standard TPU practice; accumulation stays f32)."""
-    qh, k_t, v_t = _qkv_proj(x, p, cfg, pos)        # qh: (B, H, 1, Dh)
-    k_cache = lax.dynamic_update_slice_in_dim(
-        k_cache, k_t.astype(k_cache.dtype), pos, axis=2)
-    v_cache = lax.dynamic_update_slice_in_dim(
-        v_cache, v_t.astype(v_cache.dtype), pos, axis=2)
-
     B, H, _, Dh = qh.shape
     Hkv = k_cache.shape[1]
     G = H // Hkv
     qg = qh.reshape(B, Hkv, G, Dh)                  # one token: drop q dim
     s = jnp.einsum("bkgd,bktd->bkgt", qg.astype(k_cache.dtype), k_cache,
                    preferred_element_type=jnp.float32) / np.sqrt(Dh)
-    T = k_cache.shape[2]
-    mask = (lax.broadcasted_iota(jnp.int32, (T,), 0) <= pos)
-    s = jnp.where(mask[None, None, None, :], s, -1e30)
+    s = jnp.where(mask, s, -1e30)
     w = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bkgt,bktd->bkgd", w.astype(v_cache.dtype), v_cache,
                    preferred_element_type=jnp.float32)
-    o = o.reshape(B, H, 1, Dh)
+    return o.reshape(B, H, 1, Dh)
+
+
+def _attention_decode(x, p, cfg: TransformerConfig, k_cache, v_cache, pos):
+    """One-token attention against the cache: write this position's K/V
+    at ``pos``, attend q over positions <= pos (static-shape mask; the
+    attention math itself lives in :func:`_cache_attend`)."""
+    qh, k_t, v_t = _qkv_proj(x, p, cfg, pos)        # qh: (B, H, 1, Dh)
+    k_cache = lax.dynamic_update_slice_in_dim(
+        k_cache, k_t.astype(k_cache.dtype), pos, axis=2)
+    v_cache = lax.dynamic_update_slice_in_dim(
+        v_cache, v_t.astype(v_cache.dtype), pos, axis=2)
+    T = k_cache.shape[2]
+    mask = (lax.broadcasted_iota(jnp.int32, (T,), 0) <= pos)
+    o = _cache_attend(qh, k_cache, v_cache, mask[None, None, None, :])
     return _out_proj(o.astype(cfg.dtype), p, cfg), k_cache, v_cache
 
 
@@ -596,6 +609,78 @@ def decode_step(params: Dict, tokens_t, cache: Dict, cfg: TransformerConfig):
     return logits[:, 0], {"k": k_all, "v": v_all, "pos": pos + 1}
 
 
+def _attention_decode_slots(x, p, cfg: TransformerConfig, k_cache, v_cache,
+                            pos):
+    """Per-slot positioned one-token attention: row ``b`` writes its K/V
+    at ``pos[b]`` and attends positions ``<= pos[b]`` — continuous
+    batching, where every batch row is an independent request at its own
+    depth.  The attention math (and its bandwidth discipline) is the
+    shared :func:`_cache_attend`; the per-row write is a vmapped
+    ``dynamic_update_slice`` (a scatter touching one position per row,
+    not a cache-sized ``where``)."""
+    qh, k_t, v_t = _qkv_proj(x, p, cfg, positions=pos[:, None])
+    upd = jax.vmap(
+        lambda c, t, p_: lax.dynamic_update_slice_in_dim(c, t, p_, axis=1))
+    k_cache = upd(k_cache, k_t.astype(k_cache.dtype), pos)
+    v_cache = upd(v_cache, v_t.astype(v_cache.dtype), pos)
+    T = k_cache.shape[2]
+    mask = lax.broadcasted_iota(jnp.int32, (T,), 0)[None, :] <= pos[:, None]
+    o = _cache_attend(qh, k_cache, v_cache, mask[:, None, None, :])
+    return _out_proj(o.astype(cfg.dtype), p, cfg), k_cache, v_cache
+
+
+def decode_step_slots(params: Dict, tokens_t, cache: Dict,
+                      cfg: TransformerConfig, active):
+    """One continuous-batching decode tick over a pool of S cache slots.
+
+    ``tokens_t``: (S,) int32 — each slot's last emitted token;
+    ``cache``: a SLOT cache (:func:`horovod_tpu.serving.cache.
+    init_slot_cache`) whose ``pos`` is a PER-SLOT (S,) int32 vector;
+    ``active``: (S,) bool — which slots hold live requests.  Returns
+    ``(logits (S, V) float32, updated cache)``.
+
+    Inactive rows compute on zeros (the Join-style zero-substitution the
+    eager runtime uses for absent ranks — ``horovod_tpu/join.py``) and
+    their positions do not advance, so ONE compiled executable serves
+    every admit/retire pattern: shapes are static in S and the live set
+    is data, not structure.  Row ``s`` of the logits equals
+    :func:`decode_step`'s for the same request decoded alone at position
+    ``pos[s]`` (token-identity: ``tests/test_serving.py``).
+
+    Inactive rows still scatter their (zero-computed) K/V at their stale
+    position — harmless by construction: decode always writes position
+    ``p`` in the same step that first attends it, so anything a freed
+    slot left behind is overwritten before the next tenant can see it
+    (the same argument that makes right-padded bucketed prefill safe;
+    see :func:`prefill`)."""
+    pos = cache["pos"]
+    T_cache = cache["k"].shape[3]
+    if not isinstance(pos, jax.core.Tracer) and not isinstance(
+            active, jax.core.Tracer):
+        over = np.asarray(active) & (np.asarray(pos) >= T_cache)
+        if over.any():
+            raise ValueError(
+                f"decode_step_slots past cache capacity (slots "
+                f"{np.nonzero(over)[0].tolist()} at pos >= {T_cache}); "
+                "init_slot_cache with a larger max_len")
+    x = params["embed"].astype(cfg.dtype)[tokens_t][:, None]  # (S, 1, D)
+    x = jnp.where(active[:, None, None], x, jnp.zeros_like(x))
+
+    def layer(x, inp):
+        p, k_c, v_c = inp
+        h, k_new, v_new = _attention_decode_slots(
+            _rmsnorm(x, p["ln1"]), p, cfg, k_c, v_c, pos)
+        return _mlp_block(x + h, p, cfg, moe_impl="dense"), (k_new, v_new)
+
+    x, (k_all, v_all) = lax.scan(
+        layer, x, (params["layers"], cache["k"], cache["v"]))
+    logits = _lm_head(x, params["ln_f"], params["head"], cfg)
+    return logits[:, 0], {
+        "k": k_all, "v": v_all,
+        "pos": pos + active.astype(jnp.int32),
+    }
+
+
 def _attention_prefill(x, p, cfg: TransformerConfig):
     """Full-sequence attention that ALSO returns the (unexpanded,
     post-RoPE) per-layer K/V for cache filling.  Shares the projection
@@ -617,7 +702,7 @@ def _attention_prefill(x, p, cfg: TransformerConfig):
 
 
 def prefill(params: Dict, prompt, cache: Dict, cfg: TransformerConfig,
-            *, moe_impl: str = "dropless"):
+            *, moe_impl: str = "dropless", true_len=None):
     """Fill a FRESH cache with a (B, S0) prompt in ONE forward pass
     (the serving-shape prefill: batched MXU work instead of S0 serial
     decode steps) and return ``(last-position logits (B, V), cache)``
@@ -625,7 +710,18 @@ def prefill(params: Dict, prompt, cache: Dict, cfg: TransformerConfig,
 
     ``moe_impl`` selects the MoE dispatch for MoE configs: "dropless"
     (grouped ragged matmuls — exact at 1/E of dense FLOPs, the default)
-    or "dense" (the every-expert oracle; benchmarking/fallback)."""
+    or "dense" (the every-expert oracle; benchmarking/fallback).
+
+    ``true_len`` supports BUCKETED prefill (the serving engine's
+    compile-stability lever): the prompt is RIGHT-padded to a bucket
+    length S0 and ``true_len`` (int or traced scalar) is its real token
+    count — logits come from position ``true_len - 1`` and the returned
+    ``pos`` is ``true_len``, so one compiled prefill per bucket serves
+    every length in the bucket.  Causality makes the padding inert for
+    the logits (position ``true_len - 1`` never attends past itself),
+    and the junk K/V it leaves at positions ``>= true_len`` is never
+    read: decode writes position ``p`` in the same step that first
+    attends it."""
     pos = cache["pos"]
     if not isinstance(pos, jax.core.Tracer) and int(pos) != 0:
         raise ValueError("prefill requires a fresh cache (pos == 0)")
@@ -646,15 +742,22 @@ def prefill(params: Dict, prompt, cache: Dict, cfg: TransformerConfig,
         return _mlp_block(x + h, p, cfg, moe_impl=moe_impl), (kh, vh)
 
     x, (k_all, v_all) = lax.scan(layer, x, params["layers"])
-    # Only the last position's logits are needed: slice BEFORE the
-    # (B, S0, V) head projection.
-    logits = _lm_head(x[:, -1:], params["ln_f"], params["head"], cfg)
+    # Only one position's logits are needed: slice BEFORE the (B, S0, V)
+    # head projection.
+    if true_len is None:
+        last = x[:, -1:]
+        new_pos = pos + S0
+    else:
+        true_len = jnp.asarray(true_len, jnp.int32)
+        last = lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)
+        new_pos = pos + true_len
+    logits = _lm_head(last, params["ln_f"], params["head"], cfg)
     cache = {
         "k": lax.dynamic_update_slice_in_dim(
             cache["k"], k_all.astype(cache["k"].dtype), 0, axis=3),
         "v": lax.dynamic_update_slice_in_dim(
             cache["v"], v_all.astype(cache["v"].dtype), 0, axis=3),
-        "pos": pos + S0,
+        "pos": new_pos,
     }
     return logits[:, 0], cache
 

@@ -1,0 +1,48 @@
+"""Continuous-batching inference serving (docs/serving.md).
+
+The paper's fusion-of-pending-work architecture applied to decoding:
+one compiled ``decode_step_slots`` executable hot over a fixed pool of
+cache slots, a bounded FCFS scheduler admitting requests into freed
+slots with zero recompilation, and a threaded stdlib-HTTP front.
+
+    from horovod_tpu import serving
+    engine = serving.InferenceEngine(params, cfg,
+                                     serving.EngineConfig(n_slots=8))
+    with serving.ServingServer(engine, port=8000):
+        ...
+"""
+
+from horovod_tpu.serving.cache import (
+    SlotCache,
+    init_slot_cache,
+    insert_prefill,
+)
+from horovod_tpu.serving.engine import (
+    EngineConfig,
+    GenerationFuture,
+    InferenceEngine,
+)
+from horovod_tpu.serving.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    ServingMetrics,
+)
+from horovod_tpu.serving.scheduler import (
+    DeadlineExceededError,
+    QueueFullError,
+    Request,
+    RequestTooLongError,
+    Scheduler,
+    ServingError,
+)
+from horovod_tpu.serving.server import ServingServer
+
+__all__ = [
+    "SlotCache", "init_slot_cache", "insert_prefill",
+    "EngineConfig", "GenerationFuture", "InferenceEngine",
+    "Counter", "Gauge", "Histogram", "ServingMetrics",
+    "DeadlineExceededError", "QueueFullError", "Request",
+    "RequestTooLongError", "Scheduler", "ServingError",
+    "ServingServer",
+]

@@ -1,0 +1,133 @@
+"""Slot-based KV-cache manager for continuous batching.
+
+The device side is the transformer's existing STATIC cache layout
+(:func:`horovod_tpu.models.transformer.init_cache` with ``batch = S``)
+with one change: ``pos`` is a PER-SLOT ``(S,)`` vector instead of a
+shared scalar, because every slot holds a different request at a
+different depth.  The host side (:class:`SlotCache`) is plain free-list
+bookkeeping: slots are allocated FCFS-lowest-index, freed on
+retirement, and the active set is exported as a ``(S,)`` bool mask that
+the engine feeds to :func:`~horovod_tpu.models.transformer.
+decode_step_slots` every tick — the live set is DATA, not structure, so
+the decode executable never recompiles as requests come and go.
+
+A freed slot is NOT scrubbed: decode writes position ``p`` in the same
+step that first attends it, so whatever the previous tenant left behind
+is overwritten before the next one can attend it (the argument is
+spelled out on ``decode_step_slots``; the no-contamination test in
+``tests/test_serving.py`` exercises it).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from horovod_tpu.models import transformer as T
+
+
+def init_slot_cache(cfg: "T.TransformerConfig", n_slots: int,
+                    max_len: int = 0) -> Dict:
+    """A per-layer KV cache with ``n_slots`` independent request slots:
+    ``k``/``v`` are ``(L, S, H_kv, T, Dh)`` exactly as
+    :func:`~horovod_tpu.models.transformer.init_cache` lays them out for
+    ``batch = S``, and ``pos`` is ``(S,)`` int32 — one write position per
+    slot."""
+    base = T.init_cache(cfg, n_slots, max_len)
+    return {"k": base["k"], "v": base["v"],
+            "pos": jnp.zeros((n_slots,), jnp.int32)}
+
+
+def insert_prefill(cache: Dict, slot, prefilled: Dict) -> Dict:
+    """Land a batch-1 prefilled cache in slot ``slot`` of a slot cache.
+
+    ``prefilled`` is the cache returned by a single-request
+    :func:`~horovod_tpu.models.transformer.prefill` — ``k``/``v`` shaped
+    ``(L, 1, H_kv, T_pre, Dh)`` with ``T_pre <= T`` and scalar ``pos``.
+    One ``lax.dynamic_update_slice`` per tensor writes the block at
+    ``(layer 0, slot, head 0, position 0, dim 0)``; ``slot`` may be
+    traced, so a jitted wrapper compiles once per prefill bucket shape
+    and serves every slot index."""
+    slot = jnp.asarray(slot, jnp.int32)
+    zero = jnp.int32(0)
+    k = lax.dynamic_update_slice(
+        cache["k"], prefilled["k"].astype(cache["k"].dtype),
+        (zero, slot, zero, zero, zero))
+    v = lax.dynamic_update_slice(
+        cache["v"], prefilled["v"].astype(cache["v"].dtype),
+        (zero, slot, zero, zero, zero))
+    pos = cache["pos"].at[slot].set(prefilled["pos"].astype(jnp.int32))
+    return {"k": k, "v": v, "pos": pos}
+
+
+class SlotCache:
+    """Host-side slot allocator wrapped around one device slot cache.
+
+    The device cache dict lives at :attr:`cache` and is REPLACED (never
+    mutated) by :meth:`insert` and by the engine's decode tick — JAX
+    functional style with host bookkeeping alongside.
+    """
+
+    def __init__(self, cfg: "T.TransformerConfig", n_slots: int,
+                 max_len: int = 0):
+        if n_slots < 1:
+            raise ValueError(f"need at least one slot, got {n_slots}")
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len or cfg.max_seq
+        self.cache = init_slot_cache(cfg, n_slots, self.max_len)
+        self._active = np.zeros(n_slots, bool)
+        self._free: List[int] = list(range(n_slots))
+        # One compiled insert per prefill bucket shape (slot is traced);
+        # the slot cache is donated — insert replaces it in place instead
+        # of holding two full copies live.
+        self._insert = jax.jit(insert_prefill, donate_argnums=(0,))
+
+    # -- allocation ---------------------------------------------------------
+
+    def alloc(self) -> Optional[int]:
+        """Lowest free slot index, or ``None`` when the pool is full."""
+        if not self._free:
+            return None
+        slot = self._free.pop(0)
+        self._active[slot] = True
+        return slot
+
+    def free(self, slot: int) -> None:
+        if not self._active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        self._active[slot] = False
+        self._free.append(slot)
+        self._free.sort()  # keep FCFS assignment at the lowest index
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        return int(self._active.sum())
+
+    @property
+    def occupancy(self) -> float:
+        return self.active_count / self.n_slots
+
+    def active_mask(self) -> np.ndarray:
+        """(S,) bool — a COPY, safe to hand to jit."""
+        return self._active.copy()
+
+    def positions(self) -> np.ndarray:
+        return np.asarray(self.cache["pos"])
+
+    # -- device ops ---------------------------------------------------------
+
+    def insert(self, slot: int, prefilled: Dict) -> None:
+        """Write a batch-1 prefilled cache into ``slot`` (which must be
+        allocated) and adopt its position."""
+        if not self._active[slot]:
+            raise ValueError(f"slot {slot} is not allocated")
+        self.cache = self._insert(self.cache, slot, prefilled)

@@ -1,0 +1,410 @@
+"""Continuous-batching inference engine.
+
+The paper's core move — a background controller that fuses pending work
+from many independent callers into one efficient device operation —
+applied to decoding: ONE compiled ``decode_step_slots`` executable stays
+hot over a fixed pool of S cache slots, and new requests land in freed
+slots between ticks via a bucketed single-request prefill +
+``insert_prefill``, with zero recompilation of the decode step (the
+live set is data — an ``(S,)`` active mask — not structure).
+
+Tick loop (:meth:`InferenceEngine.step`):
+
+1. **Admit**: drain up to K requests from the scheduler into free slots
+   (K = ``max_prefills_per_tick`` bounds the decode stall, so TTFT and
+   tok/s are both bounded).  Each admission is a batch-1 prefill padded
+   to a power-of-two bucket (one compile per bucket, reused across
+   lengths), whose last-real-position logits yield the request's FIRST
+   token immediately.
+2. **Decode**: one masked ``decode_step_slots`` over all S slots;
+   inactive slots compute on zeros (Join-style).  Each active slot's
+   next greedy token streams to its future; EOS / max-token / capacity
+   retirement frees the slot for the next admission.
+
+Greedy decoding is deliberate: it makes the engine's output
+TOKEN-IDENTICAL to per-request ``greedy_decode`` (the correctness oracle
+in ``tests/test_serving.py``) regardless of which requests share the
+batch or when they were admitted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.models import transformer as T
+from horovod_tpu.serving.cache import SlotCache, init_slot_cache  # noqa: F401
+from horovod_tpu.serving.metrics import ServingMetrics
+from horovod_tpu.serving.scheduler import (
+    QueueFullError,
+    Request,
+    RequestTooLongError,
+    Scheduler,
+    ServingError,
+)
+
+__all__ = [
+    "EngineConfig", "GenerationFuture", "InferenceEngine",
+]
+
+
+class GenerationFuture:
+    """Per-request result sink: tokens stream in as the engine emits
+    them; :meth:`result` blocks until retirement (or a typed rejection).
+
+    ``on_token(token_id, text_piece)`` fires from the ENGINE thread for
+    every emitted token (``text_piece`` is None without a detokenizer) —
+    keep it cheap."""
+
+    def __init__(self, on_token: Optional[Callable] = None,
+                 detokenize: Optional[Callable[[int], str]] = None):
+        self._tokens: List[int] = []
+        self._text: List[str] = []
+        self._done = threading.Event()
+        self._exc: Optional[BaseException] = None
+        self._on_token = on_token
+        self._detokenize = detokenize
+        self.finish_reason: Optional[str] = None
+        self.ttft: Optional[float] = None
+
+    # engine-side ----------------------------------------------------------
+
+    def _add_token(self, tok: int) -> None:
+        self._tokens.append(tok)
+        piece = None
+        if self._detokenize is not None:
+            piece = self._detokenize(tok)
+            self._text.append(piece)
+        if self._on_token is not None:
+            self._on_token(tok, piece)
+
+    def _finish(self, reason: str) -> None:
+        self.finish_reason = reason
+        self._done.set()
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._done.set()
+
+    # caller-side ----------------------------------------------------------
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def tokens_so_far(self) -> List[int]:
+        return list(self._tokens)
+
+    @property
+    def text(self) -> str:
+        return "".join(self._text)
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Generated token ids; raises the typed rejection if the request
+        never ran, TimeoutError if it is still running at ``timeout``."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("generation still in progress")
+        if self._exc is not None:
+            raise self._exc
+        return list(self._tokens)
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Continuous-batching knobs (tuning notes: docs/serving.md).
+
+    ``n_slots`` (S) is the decode batch the executable is compiled for;
+    ``max_len`` caps prompt + generation per slot (0 = cfg.max_seq);
+    ``max_prefills_per_tick`` (K) bounds admissions between decode
+    ticks; ``max_queue_depth`` bounds the burst the scheduler absorbs;
+    ``min_prefill_bucket`` floors the power-of-two prompt buckets so
+    tiny prompts share one compile."""
+
+    n_slots: int = 4
+    max_len: int = 0
+    max_prefills_per_tick: int = 2
+    max_queue_depth: int = 64
+    default_max_new_tokens: int = 64
+    min_prefill_bucket: int = 8
+
+
+@dataclasses.dataclass
+class _SlotState:
+    request: Request
+    last_token: int
+    n_generated: int
+
+
+class InferenceEngine:
+    """Continuous-batching engine over one model's params + config.
+
+    Drive it synchronously with :meth:`step` (tests, benchmarks) or as a
+    background thread with :meth:`start`/:meth:`stop` (the HTTP server).
+    ``detokenize`` optionally maps a token id to its text piece for
+    streamed detokenization."""
+
+    def __init__(self, params: Dict, cfg: "T.TransformerConfig",
+                 engine_cfg: EngineConfig = EngineConfig(), *,
+                 detokenize: Optional[Callable[[int], str]] = None):
+        self.params = params
+        self.cfg = cfg
+        self.engine_cfg = engine_cfg
+        self.detokenize = detokenize
+        self.slots = SlotCache(cfg, engine_cfg.n_slots, engine_cfg.max_len)
+        self.scheduler = Scheduler(
+            max_queue_depth=engine_cfg.max_queue_depth,
+            max_prefills_per_tick=engine_cfg.max_prefills_per_tick)
+        self.metrics = ServingMetrics()
+        self._states: List[Optional[_SlotState]] = \
+            [None] * engine_cfg.n_slots
+        self._lock = threading.Lock()  # engine-loop state (step is serial)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+        # Compile-count hook: the traced-function body runs ONLY when jax
+        # (re)traces, so this counter IS the number of decode
+        # compilations — the acceptance criterion asserts it stays at 1
+        # after warmup.
+        self._decode_traces = 0
+
+        def _tick(params, tokens, active, cache):
+            self._decode_traces += 1
+            logits, cache = T.decode_step_slots(
+                params, tokens, cache, self.cfg, active)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return jnp.where(active, nxt, 0), cache
+
+        # Donate the cache: without it XLA keeps input AND output caches
+        # alive across the tick (2x the KV HBM — half the servable
+        # slots) and copies the whole cache every token.
+        self._tick_fn = jax.jit(_tick, donate_argnums=(3,))
+        self._prefill_fns: Dict[int, Callable] = {}
+        self._prefill_traces = 0
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], *,
+               max_new_tokens: Optional[int] = None,
+               eos_id: Optional[int] = None,
+               deadline: Optional[float] = None,
+               on_token: Optional[Callable] = None) -> GenerationFuture:
+        """Queue a generation request; returns its future.
+
+        Typed rejections: :class:`RequestTooLongError` (prompt +
+        max_new_tokens cannot fit a cache slot — raised immediately),
+        :class:`QueueFullError` (bounded queue at capacity), and
+        :class:`DeadlineExceededError` (set on the FUTURE if
+        ``deadline`` — an absolute ``time.monotonic()`` instant — passes
+        while queued).  A deadline that lapses AFTER admission retires
+        the slot early instead: the future completes with the partial
+        result and ``finish_reason == "deadline"``, so abandoned
+        requests don't pin slots."""
+        prompt = [int(t) for t in prompt]
+        n_new = (max_new_tokens if max_new_tokens is not None
+                 else self.engine_cfg.default_max_new_tokens)
+        if not prompt:
+            raise ServingError("empty prompt")
+        if n_new < 1:
+            raise ServingError(f"max_new_tokens must be >= 1, got {n_new}")
+        cap = self.slots.max_len
+        # First token comes from prefill logits, so a slot needs room for
+        # the prompt plus the n_new - 1 decode-step writes.
+        if len(prompt) + n_new - 1 > cap:
+            self.metrics.rejected.inc()
+            raise RequestTooLongError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({n_new}) "
+                f"exceeds slot capacity ({cap})")
+        fut = GenerationFuture(on_token=on_token,
+                               detokenize=self.detokenize)
+        req = Request(prompt=prompt, max_new_tokens=n_new, future=fut,
+                      eos_id=eos_id, deadline=deadline)
+        try:
+            self.scheduler.submit(req)
+        except QueueFullError:
+            self.metrics.rejected.inc()
+            raise
+        self.metrics.queue_depth.set(self.scheduler.depth)
+        return fut
+
+    # -- the tick ----------------------------------------------------------
+
+    def step(self) -> bool:
+        """One engine tick: admit up to K requests into free slots, then
+        one masked decode over all S slots.  Returns True if any work
+        was done (False = idle; callers may sleep)."""
+        with self._lock:
+            worked = self._admit_pending()
+            worked = self._decode_tick() or worked
+            self.metrics.queue_depth.set(self.scheduler.depth)
+            self.metrics.slot_occupancy.set(self.slots.occupancy)
+            return worked
+
+    def _admit_pending(self) -> bool:
+        def on_reject(req, err):
+            self.metrics.rejected.inc()
+
+        reqs = self.scheduler.take(self.slots.free_count,
+                                   on_reject=on_reject)
+        for req in reqs:
+            slot = self.slots.alloc()
+            assert slot is not None  # take() is bounded by free_count
+            self._admit(slot, req)
+        return bool(reqs)
+
+    def _prefill_fn(self, bucket: int) -> Callable:
+        fn = self._prefill_fns.get(bucket)
+        if fn is None:
+            def _prefill(params, padded, true_len):
+                self._prefill_traces += 1
+                cache = T.init_cache(self.cfg, 1, bucket)
+                return T.prefill(params, padded, cache, self.cfg,
+                                 true_len=true_len)
+
+            fn = jax.jit(_prefill)
+            self._prefill_fns[bucket] = fn
+        return fn
+
+    def _bucket(self, n: int) -> int:
+        b = max(self.engine_cfg.min_prefill_bucket, 1)
+        while b < n:
+            b *= 2
+        return min(b, self.slots.max_len)
+
+    def _admit(self, slot: int, req: Request) -> None:
+        """Batch-1 bucketed prefill -> insert into the slot -> emit the
+        request's first token (prefill logits ARE the first greedy
+        step)."""
+        s0 = len(req.prompt)
+        bucket = self._bucket(s0)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :s0] = req.prompt
+        logits, pre_cache = self._prefill_fn(bucket)(
+            self.params, jnp.asarray(padded), s0)
+        self.slots.insert(slot, pre_cache)
+        first = int(np.asarray(jnp.argmax(logits[0])))
+        now = time.monotonic()
+        ttft = now - req.submitted_at
+        req.future.ttft = ttft
+        self.metrics.ttft.observe(ttft)
+        self.metrics.admitted.inc()
+        self._states[slot] = _SlotState(request=req, last_token=first,
+                                        n_generated=0)
+        self._emit(slot, first)
+
+    def _emit(self, slot: int, tok: int) -> None:
+        """Stream one token to the slot's future; retire on EOS,
+        max-token, or cache-capacity exhaustion."""
+        st = self._states[slot]
+        st.request.future._add_token(tok)
+        st.last_token = tok
+        st.n_generated += 1
+        self.metrics.tokens_generated.inc()
+        reason = None
+        if st.request.eos_id is not None and tok == st.request.eos_id:
+            reason = "eos"
+        elif st.n_generated >= st.request.max_new_tokens:
+            reason = "length"
+        # Next decode tick would write at prompt + n_generated - 1 (the
+        # first token came from prefill, no write) — retire at capacity.
+        elif (len(st.request.prompt) + st.n_generated - 1
+              >= self.slots.max_len):
+            reason = "capacity"  # submit() sizing makes this unreachable
+        # Deadline AFTER admission: the caller is gone (504/timeout) —
+        # retire with the partial result instead of pinning the slot
+        # until max_new_tokens on output nobody reads.  (A deadline that
+        # lapses while QUEUED is a typed rejection — Scheduler.take.)
+        elif (st.request.deadline is not None
+              and time.monotonic() > st.request.deadline):
+            reason = "deadline"
+        if reason is not None:
+            st.request.future._finish(reason)
+            self.metrics.completed.inc()
+            self._states[slot] = None
+            self.slots.free(slot)
+
+    def _decode_tick(self) -> bool:
+        active = self.slots.active_mask()
+        if not active.any():
+            return False
+        tokens = np.zeros(self.engine_cfg.n_slots, np.int32)
+        for s, st in enumerate(self._states):
+            if st is not None:
+                tokens[s] = st.last_token
+        t0 = time.monotonic()
+        nxt, self.slots.cache = self._tick_fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(active),
+            self.slots.cache)
+        nxt = np.asarray(nxt)  # fetch = sync: the tick really finished
+        dt = time.monotonic() - t0
+        for s in np.nonzero(active)[0]:
+            self.metrics.token_latency.observe(dt)
+            self._emit(int(s), int(nxt[s]))
+        return True
+
+    # -- background loop ---------------------------------------------------
+
+    def start(self, idle_sleep: float = 0.001) -> None:
+        """Run the tick loop in a daemon thread until :meth:`stop`."""
+        if self._thread is not None:
+            return
+
+        def loop():
+            while not self._stop.is_set():
+                if not self.step():
+                    time.sleep(idle_sleep)
+
+        self._stop.clear()
+        self._thread = threading.Thread(target=loop,
+                                        name="serving-engine", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout)
+        self._thread = None
+
+    def drain(self, timeout: float = 60.0, poll: float = 0.002) -> bool:
+        """Block until queue and slots are empty (True) or timeout.
+        Synchronous callers (no background thread) should loop
+        :meth:`step` instead."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            # Sample under the step lock: between scheduler.take() and
+            # slots.alloc() a request is in neither counter, and an
+            # unlocked read could report "drained" mid-admission.
+            with self._lock:
+                idle = (self.scheduler.depth == 0
+                        and self.slots.active_count == 0)
+            if idle:
+                return True
+            if self._thread is None:
+                self.step()
+            else:
+                time.sleep(poll)
+        return False
+
+    # -- observability -----------------------------------------------------
+
+    @property
+    def decode_compilations(self) -> int:
+        """How many times the decode tick was traced/compiled — the
+        zero-recompilation acceptance hook (stays 1 after warmup)."""
+        return self._decode_traces
+
+    def stats(self) -> Dict:
+        return {
+            **self.metrics.snapshot(),
+            "n_slots": self.engine_cfg.n_slots,
+            "slots_active": self.slots.active_count,
+            "max_len": self.slots.max_len,
+            "decode_compilations": self._decode_traces,
+            "prefill_compilations": self._prefill_traces,
+            "prefill_buckets": sorted(self._prefill_fns),
+        }

@@ -1,0 +1,154 @@
+"""Serving observability: counters, gauges, and fixed-bucket histograms.
+
+Deliberately dependency-free (stdlib only) and thread-safe — instruments
+are updated from the engine thread and read from HTTP handler threads.
+Snapshots are plain dicts so ``/stats`` can ``json.dumps`` them
+directly.  Percentiles come from the cumulative bucket counts (the
+Prometheus-style estimate: the reported pN is the upper edge of the
+bucket containing the N-th percentile observation), which keeps memory
+constant no matter how long the server runs.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+
+class Counter:
+    def __init__(self) -> None:
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+
+class Gauge:
+    def __init__(self) -> None:
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+# Latency buckets in seconds: 1ms .. 60s, roughly x2.5 per step — wide
+# enough for CPU-smoke ticks and TPU production alike.
+DEFAULT_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+class Histogram:
+    """Fixed-bucket histogram with an implicit +Inf overflow bucket."""
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        self.buckets: List[float] = sorted(float(b) for b in buckets)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v: float) -> None:
+        i = 0
+        while i < len(self.buckets) and v > self.buckets[i]:
+            i += 1
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def mean(self) -> Optional[float]:
+        return self._sum / self._count if self._count else None
+
+    def _percentile(self, counts: List[int], total: int,
+                    q: float) -> Optional[float]:
+        if not total:
+            return None
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                return self.buckets[i] if i < len(self.buckets) \
+                    else self.buckets[-1]
+        return self.buckets[-1]
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Upper edge of the bucket holding the q-quantile observation
+        (q in [0, 1]); None when empty, +Inf bucket reports the largest
+        finite edge."""
+        with self._lock:
+            counts, total = list(self._counts), self._count
+        return self._percentile(counts, total, q)
+
+    def snapshot(self) -> Dict:
+        # One locked copy; count/sum/buckets AND percentiles all
+        # describe the same population (an observe() racing /stats must
+        # not split them).
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+        return {
+            "count": total,
+            "sum": round(s, 6),
+            "mean": round(s / total, 6) if total else None,
+            "p50": self._percentile(counts, total, 0.50),
+            "p99": self._percentile(counts, total, 0.99),
+            "buckets": {
+                ("%g" % b): c for b, c in zip(self.buckets, counts)
+            } | {"+Inf": counts[-1]},
+        }
+
+
+class ServingMetrics:
+    """The engine's instrument panel, surfaced verbatim through /stats.
+
+    * ``ttft`` — submit-to-first-token latency (prefill + queueing).
+    * ``token_latency`` — per-token decode-tick latency.
+    * ``queue_depth`` / ``slot_occupancy`` — gauges sampled every tick.
+    * ``admitted`` / ``rejected`` / ``completed`` — request counters
+      (rejected covers queue-full, deadline, and too-long).
+    """
+
+    def __init__(self) -> None:
+        self.ttft = Histogram()
+        self.token_latency = Histogram()
+        self.queue_depth = Gauge()
+        self.slot_occupancy = Gauge()
+        self.admitted = Counter()
+        self.rejected = Counter()
+        self.completed = Counter()
+        self.tokens_generated = Counter()
+
+    def snapshot(self) -> Dict:
+        return {
+            "ttft_seconds": self.ttft.snapshot(),
+            "token_latency_seconds": self.token_latency.snapshot(),
+            "queue_depth": self.queue_depth.value,
+            "slot_occupancy": self.slot_occupancy.value,
+            "requests_admitted": self.admitted.value,
+            "requests_rejected": self.rejected.value,
+            "requests_completed": self.completed.value,
+            "tokens_generated": self.tokens_generated.value,
+        }

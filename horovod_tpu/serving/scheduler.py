@@ -1,0 +1,128 @@
+"""Request admission and scheduling for the continuous-batching engine.
+
+The paper's background-controller pattern applied to inference: callers
+submit independent requests; a bounded FCFS queue absorbs bursts; the
+engine drains it into free cache slots between decode ticks.  Admission
+control is explicit and typed — a full queue raises
+:class:`QueueFullError` at submit time, a request whose deadline lapsed
+while queued is rejected with :class:`DeadlineExceededError` when it
+reaches the head, and a request that cannot fit the cache raises
+:class:`RequestTooLongError` before it ever queues — so backpressure is
+a protocol, not an OOM.
+
+The prefill/decode interleave policy lives here too:
+:meth:`Scheduler.take` hands the engine at most ``max_prefills_per_tick``
+admissions per decode tick, bounding how long the active batch stalls on
+prompt ingestion (time-to-first-token vs decode tok/s — both stay
+bounded; see docs/serving.md for tuning).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+
+class ServingError(Exception):
+    """Base class for typed serving rejections."""
+
+
+class QueueFullError(ServingError):
+    """The bounded request queue is at capacity — retry with backoff."""
+
+
+class DeadlineExceededError(ServingError):
+    """The request's deadline passed before it could be admitted."""
+
+
+class RequestTooLongError(ServingError):
+    """prompt + max_new_tokens exceeds the cache slot capacity."""
+
+
+_req_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request as the scheduler sees it.
+
+    ``prompt`` is a token-id sequence; ``deadline`` is an ABSOLUTE
+    ``time.monotonic()`` instant (None = no deadline); ``future`` is the
+    engine's per-request result sink (tokens stream into it, typed
+    rejections land on it as exceptions)."""
+
+    prompt: Sequence[int]
+    max_new_tokens: int
+    future: Any
+    eos_id: Optional[int] = None
+    deadline: Optional[float] = None
+    submitted_at: float = 0.0
+    id: int = dataclasses.field(default_factory=lambda: next(_req_ids))
+
+
+class Scheduler:
+    """Bounded FCFS queue + prefill/decode interleave policy.
+
+    Thread-safe: callers submit from any thread; the engine thread
+    drains with :meth:`take`.
+    """
+
+    def __init__(self, *, max_queue_depth: int = 64,
+                 max_prefills_per_tick: int = 2,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_queue_depth < 1:
+            raise ValueError(f"max_queue_depth must be >= 1, got "
+                             f"{max_queue_depth}")
+        if max_prefills_per_tick < 1:
+            raise ValueError(f"max_prefills_per_tick must be >= 1, got "
+                             f"{max_prefills_per_tick}")
+        self.max_queue_depth = max_queue_depth
+        self.max_prefills_per_tick = max_prefills_per_tick
+        self._clock = clock
+        self._q: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+
+    @property
+    def depth(self) -> int:
+        return len(self._q)
+
+    def submit(self, req: Request) -> None:
+        """Enqueue FCFS; raises :class:`QueueFullError` at capacity (the
+        caller's future is untouched — the submit call itself fails)."""
+        req.submitted_at = self._clock()
+        with self._lock:
+            if len(self._q) >= self.max_queue_depth:
+                raise QueueFullError(
+                    f"request queue at capacity ({self.max_queue_depth})")
+            self._q.append(req)
+
+    def take(self, free_slots: int,
+             on_reject: Optional[Callable[[Request, ServingError], None]]
+             = None) -> List[Request]:
+        """Up to ``min(max_prefills_per_tick, free_slots)`` admissible
+        requests, FCFS.  Requests whose deadline lapsed while queued are
+        rejected in place: their future gets a
+        :class:`DeadlineExceededError` and ``on_reject`` is notified —
+        they do not consume a slot or a prefill budget entry."""
+        budget = min(self.max_prefills_per_tick, free_slots)
+        out: List[Request] = []
+        while budget > 0:
+            with self._lock:
+                if not self._q:
+                    break
+                req = self._q.popleft()
+            if req.deadline is not None and self._clock() > req.deadline:
+                err = DeadlineExceededError(
+                    f"request {req.id} deadline passed while queued "
+                    f"({self._clock() - req.submitted_at:.3f}s in queue)")
+                req.future.set_exception(err)
+                if on_reject is not None:
+                    on_reject(req, err)
+                continue
+            out.append(req)
+            budget -= 1
+        return out

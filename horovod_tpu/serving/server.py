@@ -1,0 +1,197 @@
+"""Threaded stdlib-HTTP front for the continuous-batching engine.
+
+No web framework — ``http.server.ThreadingHTTPServer`` with one handler
+thread per connection, all of them funneling into the single engine
+thread through the scheduler's bounded queue (the paper's
+many-callers-one-controller shape, over HTTP).
+
+Endpoints:
+
+* ``POST /generate`` — body ``{"tokens": [...], "max_new_tokens": N,
+  "eos_id": E?, "timeout_ms": T?}`` (or ``{"text": ...}`` when the
+  server was built with an ``encode`` callable).  Replies ``{"tokens":
+  [...], "finish_reason": ..., "ttft_ms": ...}`` (+ ``"text"`` with a
+  detokenizer).  Typed rejections map to HTTP: queue full -> 429,
+  too long -> 413, deadline -> 504, bad request -> 400.
+* ``GET /healthz`` — liveness + slot headroom.
+* ``GET /stats`` — the full metrics snapshot (serving/metrics.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional, Sequence
+
+from horovod_tpu.serving.engine import InferenceEngine
+from horovod_tpu.serving.scheduler import (
+    DeadlineExceededError,
+    QueueFullError,
+    RequestTooLongError,
+    ServingError,
+)
+
+__all__ = ["ServingServer"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # The ThreadingHTTPServer instance carries the engine (see
+    # ServingServer.start); BaseHTTPRequestHandler exposes it as
+    # self.server.
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet: metrics are the log
+        pass
+
+    def _json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        engine: InferenceEngine = self.server.engine
+        if self.path == "/healthz":
+            self._json(200, {
+                "status": "ok",
+                "slots_free": engine.slots.free_count,
+                "queue_depth": engine.scheduler.depth,
+            })
+        elif self.path == "/stats":
+            self._json(200, engine.stats())
+        else:
+            self._json(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):
+        # Read the body FIRST, even on error paths: HTTP/1.1 keep-alive
+        # reuses the connection, and unread body bytes would be parsed
+        # as the next request line.
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+        except ValueError:
+            self._json(400, {"error": "bad Content-Length"})
+            return
+        if self.path != "/generate":
+            self._json(404, {"error": f"unknown path {self.path}"})
+            return
+        engine: InferenceEngine = self.server.engine
+        try:
+            req = json.loads(body or b"{}")
+        except json.JSONDecodeError as e:
+            self._json(400, {"error": f"bad JSON body: {e}"})
+            return
+
+        tokens = req.get("tokens")
+        if tokens is None and "text" in req:
+            encode = self.server.encode
+            if encode is None:
+                self._json(400, {"error": "server has no text encoder; "
+                                          "send token ids"})
+                return
+            tokens = encode(req["text"])
+        if not tokens:
+            self._json(400, {"error": "need non-empty 'tokens' (or "
+                                      "'text' with an encoder)"})
+            return
+
+        timeout_ms = req.get("timeout_ms")
+        try:
+            deadline = (time.monotonic() + float(timeout_ms) / 1e3
+                        if timeout_ms else None)
+            fut = engine.submit(
+                [int(t) for t in tokens],
+                max_new_tokens=req.get("max_new_tokens"),
+                eos_id=req.get("eos_id"),
+                deadline=deadline)
+            out = fut.result(timeout=self.server.request_timeout)
+        except QueueFullError as e:
+            self._json(429, {"error": str(e), "type": "queue_full"})
+            return
+        except RequestTooLongError as e:
+            self._json(413, {"error": str(e), "type": "too_long"})
+            return
+        except DeadlineExceededError as e:
+            self._json(504, {"error": str(e), "type": "deadline_exceeded"})
+            return
+        except (ServingError, ValueError, TypeError) as e:
+            # TypeError covers non-numeric JSON fields (timeout_ms,
+            # max_new_tokens, nested token lists): a 400, not a dropped
+            # connection.
+            self._json(400, {"error": str(e)})
+            return
+        except TimeoutError as e:
+            self._json(504, {"error": str(e), "type": "timeout"})
+            return
+        payload = {
+            "tokens": out,
+            "finish_reason": fut.finish_reason,
+            "ttft_ms": round(fut.ttft * 1e3, 3) if fut.ttft else None,
+        }
+        if engine.detokenize is not None:
+            payload["text"] = fut.text
+        self._json(200, payload)
+
+
+class ServingServer:
+    """Own the engine thread + HTTP listener lifecycle.
+
+    >>> srv = ServingServer(engine, port=0)      # 0 = ephemeral port
+    >>> srv.start()                              # engine + HTTP threads
+    >>> srv.address                              # ("127.0.0.1", 43117)
+    >>> srv.stop()                               # both torn down
+    """
+
+    def __init__(self, engine: InferenceEngine, *,
+                 host: str = "127.0.0.1", port: int = 8000,
+                 encode: Optional[Callable[[str], Sequence[int]]] = None,
+                 request_timeout: float = 120.0):
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self.encode = encode
+        self.request_timeout = request_timeout
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self):
+        """(host, port) actually bound (resolves port=0)."""
+        if self._httpd is None:
+            return (self.host, self.port)
+        return self._httpd.server_address[:2]
+
+    def start(self) -> "ServingServer":
+        if self._httpd is not None:
+            return self
+        self.engine.start()
+        self._httpd = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.engine = self.engine
+        self._httpd.encode = self.encode
+        self._httpd.request_timeout = self.request_timeout
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="serving-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        self.engine.stop()
+
+    def __enter__(self) -> "ServingServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
