@@ -11,12 +11,18 @@ Run:  python examples/serve.py [--steps 30] [--port 8000] [--keep]
 With ``--keep`` the server stays up (curl it yourself):
     curl -s localhost:8000/generate -d '{"tokens": [3,4,5], "max_new_tokens": 8}'
     curl -s localhost:8000/stats
+
+Shutdown is GRACEFUL: SIGTERM (what Kubernetes / systemd send) and
+Ctrl-C both trigger a drain — /healthz flips to 503 ``draining``, new
+/generate calls are rejected with 503, in-flight requests run to
+completion, then the server tears down (docs/serving.md "Operations").
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import signal
 import threading
 import urllib.request
 
@@ -76,6 +82,13 @@ def main() -> None:
         params, cfg,
         serving.EngineConfig(n_slots=args.slots, max_len=cfg.max_seq),
         detokenize=lambda t: f" {t}")
+    # SIGTERM (k8s/systemd stop) -> graceful drain, same as Ctrl-C —
+    # installed for the WHOLE serving lifetime, demo burst included:
+    # the load balancer sees 503 on /healthz, admitted requests
+    # finish, then the listener closes.
+    stop_requested = threading.Event()
+    signal.signal(signal.SIGTERM,
+                  lambda signum, frame: stop_requested.set())
     srv = serving.ServingServer(engine, port=args.port).start()
     host, port = srv.address
     base = f"http://{host}:{port}"
@@ -114,13 +127,15 @@ def main() -> None:
           f"decode compiles {stats['decode_compilations']}, "
           f"TTFT p50 {stats['ttft_seconds']['p50']}s")
 
-    if args.keep:
-        print("serving until Ctrl-C ...")
+    if args.keep and not stop_requested.is_set():
+        print("serving until SIGTERM / Ctrl-C ...")
         try:
-            threading.Event().wait()
+            stop_requested.wait()
         except KeyboardInterrupt:
             pass
-    srv.stop()
+    print("draining (in-flight requests run to completion) ...")
+    srv.stop(drain_timeout=30.0)
+    print(f"stopped; final engine state: {engine.health}")
     hvd.shutdown()
 
 

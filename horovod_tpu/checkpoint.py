@@ -22,6 +22,8 @@ packages the conventions over it:
 from __future__ import annotations
 
 import os
+import shutil
+import warnings
 from typing import Any, Optional
 
 import jax
@@ -168,13 +170,47 @@ def save_async(path: str, tree: Any):
     return _Handle(ckptr)
 
 
+def _promote_tmp(tmp: str, final: str) -> None:
+    """Atomically promote a completed ``.tmp`` write to its final step
+    directory (same filesystem, so ``os.rename`` is the commit point —
+    a crash leaves either the old state or the new one, never a
+    half-written step under the final name)."""
+    if os.path.isdir(final):
+        shutil.rmtree(final, ignore_errors=True)
+    os.rename(tmp, final)
+
+
+class _FinalizingHandle:
+    """Wrap an async-save handle so ``wait()`` also commits the
+    ``.tmp`` -> final rename once the background write is durable."""
+
+    def __init__(self, inner, tmp: str, final: str, promote: bool):
+        self._inner = inner
+        self._tmp = tmp
+        self._final = final
+        self._promote = promote
+
+    def wait(self) -> None:
+        self._inner.wait()
+        if self._promote and os.path.isdir(self._tmp):
+            _promote_tmp(self._tmp, self._final)
+
+
 class CheckpointManager:
     """Step-numbered checkpoints with retention + latest-resume.
 
     ``save(step, tree)`` on a cadence; ``latest_step()`` / ``restore_latest
     (template)`` on startup — the estimator/elastic resume contract.
     ``async_saves=True`` makes ``save`` non-blocking (each save first
-    waits out the previous one, so at most one write is in flight)."""
+    waits out the previous one, so at most one write is in flight).
+
+    Saves are ATOMIC: orbax writes land in ``step_N.tmp`` and are
+    committed by a rename — a crash mid-save leaves a stale ``.tmp``
+    that :meth:`all_steps` never lists, so a finalized step directory
+    is intact by construction.  :meth:`restore_latest` adds a second
+    line of defense for corruption after the fact (truncated files,
+    torn disks): an unreadable newest step is skipped with a warning
+    and the previous intact one restores instead of raising."""
 
     def __init__(self, directory: str, *, max_to_keep: int = 3,
                  async_saves: bool = False) -> None:
@@ -188,6 +224,8 @@ class CheckpointManager:
         return os.path.join(self.directory, f"step_{step:010d}")
 
     def all_steps(self):
+        # f"step_N.tmp" names fail the int() parse, so uncommitted and
+        # crash-abandoned writes are invisible here by construction.
         steps = []
         if os.path.isdir(self.directory):
             for name in os.listdir(self.directory):
@@ -202,14 +240,35 @@ class CheckpointManager:
         steps = self.all_steps()
         return steps[-1] if steps else None
 
+    def _is_finalizer(self) -> bool:
+        """Exactly one process commits the rename: rank 0 (the orbax
+        primary in the collaborative regime; the only writer in the
+        replicated one)."""
+        return basics.num_processes() == 1 or basics.process_rank() == 0
+
     def save(self, step: int, tree: Any) -> None:
+        final = self._step_dir(step)
+        tmp = final + ".tmp"
         if self.async_saves:
             self.wait()  # at most one write in flight
-            self._inflight = save_async(self._step_dir(step), tree)
+            self._inflight = _FinalizingHandle(
+                save_async(tmp, tree), tmp, final,
+                promote=self._is_finalizer())
         else:
-            save(self._step_dir(step), tree)
+            save(tmp, tree)
+            if self._is_finalizer() and os.path.isdir(tmp):
+                _promote_tmp(tmp, final)
         if basics.num_processes() > 1 and basics.process_rank() != 0:
             return
+        # sweep crash-abandoned .tmp writes from PREVIOUS runs — never
+        # the one currently in flight — so each crash doesn't leak a
+        # full checkpoint's worth of disk forever
+        inflight_tmp = tmp if self._inflight is not None else None
+        for name in os.listdir(self.directory):
+            if name.endswith(".tmp"):
+                stale = os.path.join(self.directory, name)
+                if stale != inflight_tmp:
+                    shutil.rmtree(stale, ignore_errors=True)
         # retention (oldest beyond max_to_keep removed; an in-flight
         # async save is never the victim — it is the newest step, and it
         # counts toward the retention budget even though its directory
@@ -219,12 +278,11 @@ class CheckpointManager:
             steps.append(step)
         while len(steps) > self.max_to_keep:
             victim = steps.pop(0)
-            import shutil
-
             shutil.rmtree(self._step_dir(victim), ignore_errors=True)
 
     def wait(self) -> None:
-        """Block until the in-flight async save (if any) is durable."""
+        """Block until the in-flight async save (if any) is durable
+        (and, for async saves, committed to its final name)."""
         if self._inflight is not None:
             self._inflight.wait()
             self._inflight = None
@@ -233,10 +291,58 @@ class CheckpointManager:
         self.wait()  # never read past an in-flight write
         return restore(self._step_dir(step), template)
 
+    def _warn_unreadable(self, step: int, e: Exception) -> None:
+        warnings.warn(
+            f"checkpoint step {step} at {self._step_dir(step)} is "
+            f"unreadable ({type(e).__name__}: {e}); falling back to the "
+            f"previous checkpoint")
+
     def restore_latest(self, template: Any) -> tuple[Optional[int], Any]:
-        """(step, tree) from the newest checkpoint, or (None, template)."""
+        """(step, tree) from the newest INTACT checkpoint, or (None,
+        template).  A corrupt or partial newest step (truncated files,
+        interrupted finalize) is skipped with a warning and the next
+        older one is tried — resume never dies on the checkpoint that
+        was being written when the previous run crashed."""
         self.wait()
-        step = self.latest_step()
-        if step is None:
-            return None, template
-        return step, self.restore(step, template)
+        steps = self.all_steps()
+        if _spans_processes(template) and basics.num_processes() > 1:
+            # Pod-mode GSPMD: restore is COLLECTIVE (every rank reads
+            # its own shards), so a per-rank try/except fallback would
+            # let ranks that see local corruption issue different
+            # collectives than ranks that don't — a distributed hang.
+            # Attempt only the newest step and fail loudly; skipping a
+            # torn pod checkpoint needs an out-of-band decision.
+            if not steps:
+                return None, template
+            return steps[-1], self.restore(steps[-1], template)
+        if basics.num_processes() > 1:
+            # Replicated regime: only rank 0 reads disk, so only it can
+            # SEE corruption — if every rank walked the fallback loop
+            # independently, non-root ranks would accept the newest step
+            # number while rank 0 silently restored an older tree.
+            # Rank 0 picks the winning step locally (no broadcast), then
+            # step + tree ship together in ONE broadcast so every rank
+            # resumes from the same (step, weights) pair.
+            chosen, tree = -1, template
+            if basics.process_rank() == 0:
+                for step in reversed(steps):
+                    try:
+                        tree = restore(self._step_dir(step), template,
+                                       broadcast=False)
+                        chosen = step
+                        break
+                    except Exception as e:
+                        self._warn_unreadable(step, e)
+            agreed = S.broadcast_parameters(
+                {"step": np.asarray(chosen, np.int64), "tree": tree}, 0)
+            step = int(np.asarray(agreed["step"]))
+            if step < 0:
+                return None, template
+            return step, agreed["tree"]
+        for step in reversed(steps):
+            try:
+                return step, self.restore(step, template)
+            except Exception as e:  # orbax raises various per-format errors
+                self._warn_unreadable(step, e)
+                continue
+        return None, template

@@ -3,7 +3,11 @@
 The paper's fusion-of-pending-work architecture applied to decoding:
 one compiled ``decode_step_slots`` executable hot over a fixed pool of
 cache slots, a bounded FCFS scheduler admitting requests into freed
-slots with zero recompilation, and a threaded stdlib-HTTP front.
+slots with zero recompilation, and a threaded stdlib-HTTP front —
+wrapped in a fault-tolerance layer (supervised tick restarts, a
+watchdog against hung ticks, typed failure propagation, cancellation,
+graceful drain) whose invariant is that every submitted request
+resolves in bounded time with tokens or a typed error.
 
     from horovod_tpu import serving
     engine = serving.InferenceEngine(params, cfg,
@@ -18,9 +22,18 @@ from horovod_tpu.serving.cache import (
     insert_prefill,
 )
 from horovod_tpu.serving.engine import (
+    DEGRADED,
+    DRAINING,
+    FAILED,
+    HEALTHY,
     EngineConfig,
     GenerationFuture,
     InferenceEngine,
+)
+from horovod_tpu.serving.faults import (
+    FaultInjector,
+    FaultSpec,
+    InjectedFaultError,
 )
 from horovod_tpu.serving.metrics import (
     Counter,
@@ -30,6 +43,9 @@ from horovod_tpu.serving.metrics import (
 )
 from horovod_tpu.serving.scheduler import (
     DeadlineExceededError,
+    DrainingError,
+    EngineFailedError,
+    EngineStalledError,
     QueueFullError,
     Request,
     RequestTooLongError,
@@ -41,8 +57,11 @@ from horovod_tpu.serving.server import ServingServer
 __all__ = [
     "SlotCache", "init_slot_cache", "insert_prefill",
     "EngineConfig", "GenerationFuture", "InferenceEngine",
+    "HEALTHY", "DEGRADED", "DRAINING", "FAILED",
+    "FaultInjector", "FaultSpec", "InjectedFaultError",
     "Counter", "Gauge", "Histogram", "ServingMetrics",
-    "DeadlineExceededError", "QueueFullError", "Request",
+    "DeadlineExceededError", "DrainingError", "EngineFailedError",
+    "EngineStalledError", "QueueFullError", "Request",
     "RequestTooLongError", "Scheduler", "ServingError",
     "ServingServer",
 ]

@@ -104,6 +104,14 @@ class SlotCache:
         self._free.append(slot)
         self._free.sort()  # keep FCFS assignment at the lowest index
 
+    def release_all(self) -> None:
+        """Host-side reset: every slot freed (device K/V left in place —
+        write-before-attend makes scrubbing unnecessary).  The engine's
+        failure paths use this so a dead engine never reports phantom
+        in-flight work."""
+        self._active[:] = False
+        self._free = list(range(self.n_slots))
+
     @property
     def free_count(self) -> int:
         return len(self._free)

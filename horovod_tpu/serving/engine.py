@@ -25,6 +25,39 @@ Greedy decoding is deliberate: it makes the engine's output
 TOKEN-IDENTICAL to per-request ``greedy_decode`` (the correctness oracle
 in ``tests/test_serving.py``) regardless of which requests share the
 batch or when they were admitted.
+
+Fault tolerance (docs/serving.md "Operations"; the runtime analogue of
+the training side's typed rank-failure surfacing + ``Join`` + elastic
+supervision):
+
+* **Supervised tick loop** — any exception out of :meth:`step` fails
+  every in-flight future with a typed
+  :class:`~horovod_tpu.serving.scheduler.EngineFailedError`, then the
+  engine restarts itself: fresh :class:`SlotCache` (the device cache
+  is suspect after a failure), bounded consecutive attempts with
+  exponential backoff, ``engine_restarts`` counter.  Queued requests
+  survive a restart; only when the restart budget is exhausted does
+  the engine go terminally ``failed`` and resolve the queue too.
+* **Watchdog** — :meth:`start` also runs a watchdog thread against a
+  per-tick heartbeat; a tick exceeding ``tick_timeout`` is declared
+  *stalled* (hung device call): in-flight AND queued futures resolve
+  with :class:`~horovod_tpu.serving.scheduler.EngineStalledError`
+  immediately (a hung tick may never return), and if it does return,
+  the loop restarts through the same supervised path.
+* **Lifecycle states** — ``healthy`` / ``degraded`` (just restarted) /
+  ``draining`` (shutdown in progress, new submits rejected) /
+  ``failed`` (restart budget exhausted or stalled), surfaced through
+  :attr:`health`, :meth:`stats`, and the server's ``/healthz``.
+* **Cancellation** — :meth:`GenerationFuture.cancel` marks a request;
+  the engine reclaims its slot (or purges it from the queue) on the
+  next tick and resolves the future with ``finish_reason
+  "cancelled"`` and the tokens so far.
+
+The one invariant all of this serves: **every submitted request
+resolves, in bounded time, with tokens or a typed error** — proven
+under deterministic fault injection
+(:class:`~horovod_tpu.serving.faults.FaultInjector`, threaded through
+:attr:`EngineConfig.faults`) by ``tests/test_chaos.py``.
 """
 
 from __future__ import annotations
@@ -40,8 +73,12 @@ import numpy as np
 
 from horovod_tpu.models import transformer as T
 from horovod_tpu.serving.cache import SlotCache, init_slot_cache  # noqa: F401
+from horovod_tpu.serving.faults import FaultInjector
 from horovod_tpu.serving.metrics import ServingMetrics
 from horovod_tpu.serving.scheduler import (
+    DrainingError,
+    EngineFailedError,
+    EngineStalledError,
     QueueFullError,
     Request,
     RequestTooLongError,
@@ -51,7 +88,17 @@ from horovod_tpu.serving.scheduler import (
 
 __all__ = [
     "EngineConfig", "GenerationFuture", "InferenceEngine",
+    "HEALTHY", "DEGRADED", "DRAINING", "FAILED",
 ]
+
+# Engine lifecycle states (the /healthz vocabulary).  healthy/degraded
+# serve traffic (degraded = freshly restarted, not yet proven by a
+# clean tick); draining/failed reject new work — load balancers should
+# stop routing (non-200 /healthz).
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+DRAINING = "draining"
+FAILED = "failed"
 
 
 class GenerationFuture:
@@ -70,32 +117,68 @@ class GenerationFuture:
         self._exc: Optional[BaseException] = None
         self._on_token = on_token
         self._detokenize = detokenize
+        self._cancel = False
+        self._resolve_lock = threading.Lock()
         self.finish_reason: Optional[str] = None
         self.ttft: Optional[float] = None
 
     # engine-side ----------------------------------------------------------
+    # Resolution is serialized by _resolve_lock: the watchdog may fail
+    # a future from its own thread at the same instant the engine
+    # thread finishes it normally — whoever wins the lock resolves the
+    # future, the loser is a no-op (a bare done-check would let both
+    # pass the guard and leave finish_reason AND an exception set).
 
     def _add_token(self, tok: int) -> None:
-        self._tokens.append(tok)
-        piece = None
-        if self._detokenize is not None:
-            piece = self._detokenize(tok)
-            self._text.append(piece)
+        with self._resolve_lock:
+            if self._done.is_set():
+                return
+            self._tokens.append(tok)
+            piece = None
+            if self._detokenize is not None:
+                piece = self._detokenize(tok)
+                self._text.append(piece)
         if self._on_token is not None:
             self._on_token(tok, piece)
 
     def _finish(self, reason: str) -> None:
-        self.finish_reason = reason
-        self._done.set()
+        with self._resolve_lock:
+            if self._done.is_set():
+                return
+            self.finish_reason = reason
+            self._done.set()
 
     def set_exception(self, exc: BaseException) -> None:
-        self._exc = exc
-        self._done.set()
+        with self._resolve_lock:
+            if self._done.is_set():
+                return
+            self._exc = exc
+            self._done.set()
 
     # caller-side ----------------------------------------------------------
 
     def done(self) -> bool:
         return self._done.is_set()
+
+    def cancel(self) -> bool:
+        """Request cancellation.  Returns False if the future is
+        already resolved, True if cancellation was requested.  The
+        engine reclaims the request's slot (or removes it from the
+        queue) on its next tick and resolves the future with
+        ``finish_reason == "cancelled"`` and the tokens generated so
+        far — cancellation resolves, it does not raise."""
+        if self._done.is_set():
+            return False
+        self._cancel = True
+        return True
+
+    @property
+    def cancel_requested(self) -> bool:
+        return self._cancel
+
+    @property
+    def cancelled(self) -> bool:
+        return self.finish_reason == "cancelled"
 
     def tokens_so_far(self) -> List[int]:
         return list(self._tokens)
@@ -123,7 +206,19 @@ class EngineConfig:
     ``max_prefills_per_tick`` (K) bounds admissions between decode
     ticks; ``max_queue_depth`` bounds the burst the scheduler absorbs;
     ``min_prefill_bucket`` floors the power-of-two prompt buckets so
-    tiny prompts share one compile."""
+    tiny prompts share one compile.
+
+    Fault tolerance: ``max_restarts`` bounds CONSECUTIVE supervised
+    restarts before the engine goes terminally ``failed`` (a clean tick
+    resets the count); ``restart_backoff`` / ``restart_backoff_max``
+    shape the exponential backoff between attempts; ``tick_timeout`` is
+    the watchdog's per-tick wall-clock budget (0 disables the watchdog;
+    the budget must cover the first tick's prefill+decode COMPILATION,
+    not just steady-state latency); ``watchdog_interval`` is its poll
+    period; ``faults`` threads a deterministic
+    :class:`~horovod_tpu.serving.faults.FaultInjector` through the
+    engine's failure-prone sites (tests only — leave None in
+    production)."""
 
     n_slots: int = 4
     max_len: int = 0
@@ -131,6 +226,12 @@ class EngineConfig:
     max_queue_depth: int = 64
     default_max_new_tokens: int = 64
     min_prefill_bucket: int = 8
+    max_restarts: int = 3
+    restart_backoff: float = 0.05
+    restart_backoff_max: float = 2.0
+    tick_timeout: float = 60.0
+    watchdog_interval: float = 0.05
+    faults: Optional[FaultInjector] = None
 
 
 @dataclasses.dataclass
@@ -144,9 +245,9 @@ class InferenceEngine:
     """Continuous-batching engine over one model's params + config.
 
     Drive it synchronously with :meth:`step` (tests, benchmarks) or as a
-    background thread with :meth:`start`/:meth:`stop` (the HTTP server).
-    ``detokenize`` optionally maps a token id to its text piece for
-    streamed detokenization."""
+    background thread with :meth:`start`/:meth:`stop` (the HTTP server;
+    this also arms the watchdog).  ``detokenize`` optionally maps a
+    token id to its text piece for streamed detokenization."""
 
     def __init__(self, params: Dict, cfg: "T.TransformerConfig",
                  engine_cfg: EngineConfig = EngineConfig(), *,
@@ -156,15 +257,41 @@ class InferenceEngine:
         self.engine_cfg = engine_cfg
         self.detokenize = detokenize
         self.slots = SlotCache(cfg, engine_cfg.n_slots, engine_cfg.max_len)
+        self.metrics = ServingMetrics()
         self.scheduler = Scheduler(
             max_queue_depth=engine_cfg.max_queue_depth,
-            max_prefills_per_tick=engine_cfg.max_prefills_per_tick)
-        self.metrics = ServingMetrics()
+            max_prefills_per_tick=engine_cfg.max_prefills_per_tick,
+            on_reject=lambda req, err: self.metrics.rejected.inc(),
+            on_cancel=lambda req: self.metrics.cancelled.inc())
         self._states: List[Optional[_SlotState]] = \
             [None] * engine_cfg.n_slots
+        # Requests popped from the queue but not yet landed in a slot —
+        # a tick failing mid-admission must fail these futures too.
+        self._taken: List[Request] = []
         self._lock = threading.Lock()  # engine-loop state (step is serial)
         self._thread: Optional[threading.Thread] = None
+        self._watchdog: Optional[threading.Thread] = None
         self._stop = threading.Event()
+
+        # Fault-tolerance state.  _hb_lock guards the tick heartbeat,
+        # epoch, and stall flag — the ONLY state the watchdog touches
+        # while the engine thread may be hung inside _lock (taking
+        # _lock from the watchdog would deadlock recovery).
+        self._hb_lock = threading.Lock()
+        self._tick_started: Optional[float] = None
+        self._epoch = 0          # bumped on every restart
+        self._stalled = False    # set by the watchdog, cleared on recovery
+        self._health = HEALTHY
+        self._health_lock = threading.Lock()
+        self._transitions: List[str] = [HEALTHY]
+        self._consec_failures = 0
+        # Sticky lifecycle facts that the health STATE alone cannot
+        # carry: a watchdog stall overwrites DRAINING with FAILED, and
+        # a later stall-recovery must restore DRAINING (never reopen a
+        # draining engine as DEGRADED); _terminal marks a failure no
+        # restart may undo (budget exhausted / terminate()).
+        self._draining = False
+        self._terminal = False
 
         # Compile-count hook: the traced-function body runs ONLY when jax
         # (re)traces, so this counter IS the number of decode
@@ -177,7 +304,12 @@ class InferenceEngine:
             logits, cache = T.decode_step_slots(
                 params, tokens, cache, self.cfg, active)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return jnp.where(active, nxt, 0), cache
+            # Per-slot max logit rides along for the host-side
+            # finiteness check: NaN/Inf logits (bad params, flaky
+            # hardware) must become a typed engine failure, not
+            # silently-greedy garbage tokens.
+            mx = jnp.max(logits, axis=-1)
+            return jnp.where(active, nxt, 0), mx, cache
 
         # Donate the cache: without it XLA keeps input AND output caches
         # alive across the tick (2x the KV HBM — half the servable
@@ -185,6 +317,43 @@ class InferenceEngine:
         self._tick_fn = jax.jit(_tick, donate_argnums=(3,))
         self._prefill_fns: Dict[int, Callable] = {}
         self._prefill_traces = 0
+
+    # -- lifecycle / health ------------------------------------------------
+
+    @property
+    def health(self) -> str:
+        """Current lifecycle state: healthy | degraded | draining |
+        failed."""
+        return self._health
+
+    @property
+    def state_transitions(self) -> List[str]:
+        """The state-machine trail (capped), oldest first."""
+        return list(self._transitions)
+
+    def _set_health(self, state: str) -> None:
+        with self._health_lock:
+            if self._health == state:
+                return
+            self._health = state
+            self._transitions.append(state)
+            del self._transitions[:-50]  # bounded trail
+
+    def begin_drain(self) -> None:
+        """Enter ``draining``: new :meth:`submit` calls raise
+        :class:`DrainingError`; admitted and queued requests keep
+        running.  Draining is sticky — even a stall-recovery restart
+        stays draining.  A terminally failed engine stays ``failed``
+        (check-and-set under ONE lock hold: a concurrent watchdog
+        FAILED must never be overwritten, or drain() would burn its
+        whole budget on a dead engine)."""
+        self._draining = True
+        with self._health_lock:
+            if self._health in (FAILED, DRAINING):
+                return
+            self._health = DRAINING
+            self._transitions.append(DRAINING)
+            del self._transitions[:-50]
 
     # -- submission --------------------------------------------------------
 
@@ -197,13 +366,24 @@ class InferenceEngine:
 
         Typed rejections: :class:`RequestTooLongError` (prompt +
         max_new_tokens cannot fit a cache slot — raised immediately),
-        :class:`QueueFullError` (bounded queue at capacity), and
-        :class:`DeadlineExceededError` (set on the FUTURE if
-        ``deadline`` — an absolute ``time.monotonic()`` instant — passes
-        while queued).  A deadline that lapses AFTER admission retires
-        the slot early instead: the future completes with the partial
-        result and ``finish_reason == "deadline"``, so abandoned
-        requests don't pin slots."""
+        :class:`QueueFullError` (bounded queue at capacity),
+        :class:`DrainingError` / :class:`EngineFailedError` (engine
+        draining or terminally failed — nothing is ever enqueued on a
+        dead engine), and :class:`DeadlineExceededError` (set on the
+        FUTURE if ``deadline`` — an absolute ``time.monotonic()``
+        instant — passes while queued).  A deadline that lapses AFTER
+        admission retires the slot early instead: the future completes
+        with the partial result and ``finish_reason == "deadline"``, so
+        abandoned requests don't pin slots."""
+        if self._draining:
+            raise DrainingError("engine is draining; not accepting work")
+        if self._health == FAILED:
+            if self._terminal:
+                raise EngineFailedError(
+                    "engine has failed permanently "
+                    "(restart budget exhausted or terminated)")
+            raise EngineFailedError(
+                "engine is recovering from a stalled tick; retry shortly")
         prompt = [int(t) for t in prompt]
         n_new = (max_new_tokens if max_new_tokens is not None
                  else self.engine_cfg.default_max_new_tokens)
@@ -223,37 +403,122 @@ class InferenceEngine:
                                detokenize=self.detokenize)
         req = Request(prompt=prompt, max_new_tokens=n_new, future=fut,
                       eos_id=eos_id, deadline=deadline)
-        try:
-            self.scheduler.submit(req)
-        except QueueFullError:
-            self.metrics.rejected.inc()
-            raise
+        self.scheduler.submit(req)  # QueueFullError counts via on_reject
+        # Post-enqueue re-checks close the submit-vs-shutdown races:
+        # the pre-checks above can pass just before a terminal failure
+        # drains the queue, or just before begin_drain() + drain()
+        # sample an (at that instant) empty queue and stop the engine —
+        # either way THIS request must not be left enqueued unresolved.
+        if self._health == FAILED:
+            # Resolve ONLY this request: the terminal path already
+            # drained the queue, and failing it wholesale here could
+            # collateral-kill requests legitimately enqueued by other
+            # threads after a stall-recovery restart.  take() drops
+            # already-done requests if the engine ever ticks again.
+            exc = EngineFailedError("engine failed during submit")
+            fut.set_exception(exc)
+            raise exc
+        if self._draining:
+            exc = DrainingError("engine began draining during submit")
+            fut.set_exception(exc)  # take() drops already-done requests
+            raise exc
         self.metrics.queue_depth.set(self.scheduler.depth)
         return fut
 
     # -- the tick ----------------------------------------------------------
 
     def step(self) -> bool:
-        """One engine tick: admit up to K requests into free slots, then
-        one masked decode over all S slots.  Returns True if any work
-        was done (False = idle; callers may sleep)."""
-        with self._lock:
-            worked = self._admit_pending()
-            worked = self._decode_tick() or worked
-            self.metrics.queue_depth.set(self.scheduler.depth)
-            self.metrics.slot_occupancy.set(self.slots.occupancy)
-            return worked
+        """One SUPERVISED engine tick: admit up to K requests into free
+        slots, then one masked decode over all S slots.  Returns True
+        if any work was done (False = idle; callers may sleep).
+
+        An exception anywhere in the tick does not propagate: every
+        in-flight future is resolved with a typed
+        :class:`EngineFailedError` and the engine restarts (fresh slot
+        cache, bounded attempts, exponential backoff) — or goes
+        terminally ``failed`` when the budget is exhausted."""
+        if self._health == FAILED:
+            return False
+        with self._hb_lock:
+            self._tick_started = time.monotonic()
+        try:
+            faults = self.engine_cfg.faults
+            if faults is not None:
+                faults.probe("watchdog")  # a "hang" here stalls the tick
+            with self._lock:
+                worked = self._reclaim_cancelled()
+                worked = self._admit_pending() or worked
+                worked = self._decode_tick() or worked
+                self.metrics.queue_depth.set(self.scheduler.depth)
+                self.metrics.slot_occupancy.set(self.slots.occupancy)
+        except Exception as exc:  # supervised: ANY tick failure recovers
+            with self._hb_lock:
+                self._tick_started = None
+                stalled = self._stalled
+            # A stalled tick that ends by RAISING is still one incident:
+            # the watchdog already counted it when it declared the stall.
+            self._recover(exc, counted=stalled)
+            return True
+        with self._hb_lock:
+            self._tick_started = None
+            stalled = self._stalled
+        if stalled:
+            # The watchdog declared us dead mid-tick but the tick DID
+            # return: futures are already resolved; restart the engine
+            # through the same supervised path (no double-counting —
+            # the watchdog already counted the failure).
+            self._recover(EngineStalledError(
+                f"tick exceeded the {self.engine_cfg.tick_timeout}s "
+                f"watchdog budget"), counted=True)
+            return True
+        # Clean tick: recover health, reset the consecutive-failure
+        # budget the supervised restarts draw from.
+        if self._consec_failures or self._health == DEGRADED:
+            self._consec_failures = 0
+            if self._health == DEGRADED:
+                self._set_health(HEALTHY)
+        return worked
+
+    def _reclaim_cancelled(self) -> bool:
+        """Free slots whose requests were cancelled caller-side — their
+        futures resolve with the tokens so far (reason "cancelled") —
+        or whose futures were already resolved externally (a submit
+        that raced a drain); either way the slot must not leak."""
+        worked = False
+        for s, st in enumerate(self._states):
+            if st is None:
+                continue
+            fut = st.request.future
+            if fut.done():
+                self._states[s] = None
+                self.slots.free(s)
+                worked = True
+                continue
+            if fut.cancel_requested:
+                fut._finish("cancelled")
+                self.metrics.cancelled.inc()
+                self._states[s] = None
+                self.slots.free(s)
+                worked = True
+        return worked
 
     def _admit_pending(self) -> bool:
-        def on_reject(req, err):
-            self.metrics.rejected.inc()
-
-        reqs = self.scheduler.take(self.slots.free_count,
-                                   on_reject=on_reject)
+        reqs = self.scheduler.take(self.slots.free_count)
+        self._taken = list(reqs)
         for req in reqs:
+            if req.future.done():  # resolved while taken (raced drain)
+                self._taken.remove(req)
+                continue
+            if req.future.cancel_requested:
+                req.future._finish("cancelled")
+                self.metrics.cancelled.inc()
+                self._taken.remove(req)
+                continue
             slot = self.slots.alloc()
             assert slot is not None  # take() is bounded by free_count
             self._admit(slot, req)
+            self._taken.remove(req)  # landed: _states[slot] owns it now
+        self._taken = []
         return bool(reqs)
 
     def _prefill_fn(self, bucket: int) -> Callable:
@@ -279,6 +544,9 @@ class InferenceEngine:
         """Batch-1 bucketed prefill -> insert into the slot -> emit the
         request's first token (prefill logits ARE the first greedy
         step)."""
+        faults = self.engine_cfg.faults
+        if faults is not None:
+            faults.probe("prefill")
         s0 = len(req.prompt)
         bucket = self._bucket(s0)
         padded = np.zeros((1, bucket), np.int32)
@@ -300,6 +568,16 @@ class InferenceEngine:
         """Stream one token to the slot's future; retire on EOS,
         max-token, or cache-capacity exhaustion."""
         st = self._states[slot]
+        if st is None:
+            return
+        if st.request.future.done():
+            # Resolved externally: by the watchdog (stall declared while
+            # the tick was in flight — recovery rebuilds slot state
+            # anyway) or by a submit that raced a drain.  Reclaim the
+            # slot here so it cannot leak and pin drain() forever.
+            self._states[slot] = None
+            self.slots.free(slot)
+            return
         st.request.future._add_token(tok)
         st.last_token = tok
         st.n_generated += 1
@@ -331,25 +609,156 @@ class InferenceEngine:
         active = self.slots.active_mask()
         if not active.any():
             return False
+        faults = self.engine_cfg.faults
+        kind = faults.probe("decode_tick") if faults is not None else None
         tokens = np.zeros(self.engine_cfg.n_slots, np.int32)
         for s, st in enumerate(self._states):
             if st is not None:
                 tokens[s] = st.last_token
         t0 = time.monotonic()
-        nxt, self.slots.cache = self._tick_fn(
+        nxt, mx, self.slots.cache = self._tick_fn(
             self.params, jnp.asarray(tokens), jnp.asarray(active),
             self.slots.cache)
         nxt = np.asarray(nxt)  # fetch = sync: the tick really finished
+        mx = np.asarray(mx)
+        if kind == "nonfinite":  # injected: NaN logits from the device
+            mx = np.where(active, np.nan, mx)
+        if not np.isfinite(mx[active]).all():
+            raise EngineFailedError(
+                "non-finite logits from decode tick (bad params or "
+                "device fault)")
         dt = time.monotonic() - t0
         for s in np.nonzero(active)[0]:
             self.metrics.token_latency.observe(dt)
             self._emit(int(s), int(nxt[s]))
         return True
 
+    # -- failure recovery --------------------------------------------------
+
+    def _fail_inflight(self, exc: BaseException) -> None:
+        """Resolve every in-flight future (slots + taken-but-unlanded)
+        with ``exc`` and reset slot bookkeeping — including the slot
+        allocator, so terminal states (no _restart to rebuild it) don't
+        report phantom occupancy forever.  Idempotent per future
+        (set_exception no-ops once done)."""
+        for st in self._states:
+            if st is not None:
+                st.request.future.set_exception(exc)
+        for req in self._taken:
+            req.future.set_exception(exc)
+        self._taken = []
+        self._states = [None] * self.engine_cfg.n_slots
+        self.slots.release_all()
+
+    def _fail_queue(self, exc: BaseException) -> None:
+        for req in self.scheduler.drain_pending():
+            req.future.set_exception(exc)
+
+    def _recover(self, exc: BaseException, *, counted: bool = False) -> None:
+        """The supervised-restart path: fail in-flight futures with a
+        typed error, then either restart (fresh SlotCache, exponential
+        backoff) or go terminally ``failed`` when ``max_restarts``
+        consecutive attempts are spent."""
+        if not isinstance(exc, EngineFailedError):
+            wrapped = EngineFailedError(f"engine tick failed: {exc!r}")
+            wrapped.__cause__ = exc
+            exc = wrapped
+        with self._hb_lock:
+            self._stalled = False
+        if not counted:
+            self.metrics.engine_failures.inc()
+        with self._lock:
+            self._fail_inflight(exc)
+            self._consec_failures += 1
+            attempt = self._consec_failures
+            if (self._terminal
+                    or attempt > self.engine_cfg.max_restarts):
+                self._terminal = True
+                self._set_health(FAILED)
+                self._fail_queue(exc)
+                self.metrics.queue_depth.set(0)
+                self.metrics.slot_occupancy.set(0.0)
+                return
+        backoff = min(
+            self.engine_cfg.restart_backoff * (2.0 ** (attempt - 1)),
+            self.engine_cfg.restart_backoff_max)
+        time.sleep(backoff)
+        with self._lock:
+            # terminate() may have landed during the backoff sleep — a
+            # terminal declaration is never undone by a restart.
+            if self._terminal:
+                self._set_health(FAILED)
+                self._fail_queue(exc)
+                return
+            self._restart()
+
+    def _restart(self) -> None:
+        """Fresh SlotCache + slot bookkeeping (the old device cache is
+        suspect after a failure); queued requests survive and are
+        admitted by the next tick.  Caller holds ``_lock``.
+
+        A stall overwrites the health state with FAILED, so the
+        restart target comes from the sticky ``_draining`` flag, not
+        from the state it is replacing — a draining engine restarts
+        DRAINING (still rejecting new work), everything else restarts
+        DEGRADED."""
+        self.slots = SlotCache(self.cfg, self.engine_cfg.n_slots,
+                               self.engine_cfg.max_len)
+        self._states = [None] * self.engine_cfg.n_slots
+        with self._hb_lock:
+            self._epoch += 1
+            self._stalled = False
+        self.metrics.engine_restarts.inc()
+        self._set_health(DRAINING if self._draining else DEGRADED)
+
+    # -- watchdog ----------------------------------------------------------
+
+    def _watchdog_loop(self) -> None:
+        budget = self.engine_cfg.tick_timeout
+        while not self._stop.is_set():
+            time.sleep(self.engine_cfg.watchdog_interval)
+            with self._hb_lock:
+                started = self._tick_started
+                epoch = self._epoch
+                stalled = self._stalled
+            if started is None or stalled:
+                continue
+            if time.monotonic() - started > budget:
+                self._declare_stalled(epoch, started)
+
+    def _declare_stalled(self, epoch: int, started: float) -> None:
+        """The tick has been running past its budget — a hung device
+        call.  Runs on the WATCHDOG thread, which must never take
+        ``_lock`` (the hung engine thread holds it): it only resolves
+        futures (thread-safe, idempotent) and flips flags.  Slot
+        bookkeeping is rebuilt by the engine thread if/when the hung
+        tick returns; if it never returns, the engine stays ``failed``
+        and nothing is left waiting on it."""
+        with self._hb_lock:
+            if (self._stalled or self._epoch != epoch
+                    or self._tick_started != started):
+                return  # the tick finished or recovery already ran
+            self._stalled = True
+        exc = EngineStalledError(
+            f"engine stalled: tick exceeded the "
+            f"{self.engine_cfg.tick_timeout}s watchdog budget")
+        self.metrics.engine_failures.inc()
+        self._set_health(FAILED)
+        # The engine thread is hung inside _lock, so _states is frozen —
+        # snapshot-read it without the lock and resolve every future a
+        # hung tick would otherwise strand (in-flight AND queued).
+        for st in list(self._states):
+            if st is not None:
+                st.request.future.set_exception(exc)
+        for req in list(self._taken):
+            req.future.set_exception(exc)
+        self._fail_queue(exc)
+
     # -- background loop ---------------------------------------------------
 
     def start(self, idle_sleep: float = 0.001) -> None:
-        """Run the tick loop in a daemon thread until :meth:`stop`."""
+        """Run the tick loop in a daemon thread until :meth:`stop`; arm
+        the watchdog when ``tick_timeout > 0``."""
         if self._thread is not None:
             return
 
@@ -362,6 +771,11 @@ class InferenceEngine:
         self._thread = threading.Thread(target=loop,
                                         name="serving-engine", daemon=True)
         self._thread.start()
+        if self.engine_cfg.tick_timeout > 0:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="serving-watchdog",
+                daemon=True)
+            self._watchdog.start()
 
     def stop(self, timeout: float = 10.0) -> None:
         if self._thread is None:
@@ -369,6 +783,9 @@ class InferenceEngine:
         self._stop.set()
         self._thread.join(timeout)
         self._thread = None
+        if self._watchdog is not None:
+            self._watchdog.join(timeout)
+            self._watchdog = None
 
     def drain(self, timeout: float = 60.0, poll: float = 0.002) -> bool:
         """Block until queue and slots are empty (True) or timeout.
@@ -376,19 +793,50 @@ class InferenceEngine:
         :meth:`step` instead."""
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
+            if self._health == FAILED:
+                return True  # recovery already resolved everything
             # Sample under the step lock: between scheduler.take() and
             # slots.alloc() a request is in neither counter, and an
-            # unlocked read could report "drained" mid-admission.
-            with self._lock:
-                idle = (self.scheduler.depth == 0
-                        and self.slots.active_count == 0)
-            if idle:
-                return True
+            # unlocked read could report "drained" mid-admission.  A
+            # TIMED acquire, not a blocking one — a hung tick holds
+            # _lock indefinitely, and drain must keep re-checking its
+            # own deadline (and the FAILED the watchdog sets) instead
+            # of inheriting the hang.
+            if self._lock.acquire(timeout=poll):
+                try:
+                    idle = (self.scheduler.depth == 0
+                            and self.slots.active_count == 0
+                            and not self._taken)
+                finally:
+                    self._lock.release()
+                if idle:
+                    return True
             if self._thread is None:
                 self.step()
             else:
                 time.sleep(poll)
         return False
+
+    def terminate(self, reason: str = "engine terminated") -> None:
+        """Force-resolve EVERYTHING (slots, taken, queue) with a typed
+        :class:`EngineFailedError` and go terminally ``failed`` — the
+        drain-timeout escape hatch: teardown must finish in bounded
+        time even if requests cannot.  If the step lock cannot be
+        acquired (a hung tick holds it — possibly with the watchdog
+        disabled), futures are resolved WITHOUT it: the hung engine
+        thread is not mutating slot state, and ``_terminal`` guarantees
+        a late-returning tick can only land in the terminal branch of
+        ``_recover``, never a restart."""
+        self._terminal = True
+        exc = EngineFailedError(reason)
+        locked = self._lock.acquire(timeout=1.0)
+        try:
+            self._fail_inflight(exc)
+            self._fail_queue(exc)
+        finally:
+            if locked:
+                self._lock.release()
+        self._set_health(FAILED)
 
     # -- observability -----------------------------------------------------
 
@@ -401,6 +849,8 @@ class InferenceEngine:
     def stats(self) -> Dict:
         return {
             **self.metrics.snapshot(),
+            "state": self._health,
+            "state_transitions": self.state_transitions,
             "n_slots": self.engine_cfg.n_slots,
             "slots_active": self.slots.active_count,
             "max_len": self.slots.max_len,

@@ -127,8 +127,14 @@ class ServingMetrics:
     * ``ttft`` — submit-to-first-token latency (prefill + queueing).
     * ``token_latency`` — per-token decode-tick latency.
     * ``queue_depth`` / ``slot_occupancy`` — gauges sampled every tick.
-    * ``admitted`` / ``rejected`` / ``completed`` — request counters
-      (rejected covers queue-full, deadline, and too-long).
+    * ``admitted`` / ``rejected`` / ``completed`` / ``cancelled`` —
+      request counters (rejected covers queue-full, deadline, and
+      too-long — BOTH the submit-time and the take-time paths;
+      cancelled covers caller-side :meth:`GenerationFuture.cancel`,
+      including the server's 504 slot reclamation).
+    * ``engine_failures`` / ``engine_restarts`` — fault-tolerance
+      counters: every tick failure or watchdog stall, and every
+      successful supervised restart (fresh slot cache).
     """
 
     def __init__(self) -> None:
@@ -139,7 +145,10 @@ class ServingMetrics:
         self.admitted = Counter()
         self.rejected = Counter()
         self.completed = Counter()
+        self.cancelled = Counter()
         self.tokens_generated = Counter()
+        self.engine_failures = Counter()
+        self.engine_restarts = Counter()
 
     def snapshot(self) -> Dict:
         return {
@@ -150,5 +159,8 @@ class ServingMetrics:
             "requests_admitted": self.admitted.value,
             "requests_rejected": self.rejected.value,
             "requests_completed": self.completed.value,
+            "requests_cancelled": self.cancelled.value,
             "tokens_generated": self.tokens_generated.value,
+            "engine_failures": self.engine_failures.value,
+            "engine_restarts": self.engine_restarts.value,
         }

@@ -43,6 +43,26 @@ class RequestTooLongError(ServingError):
     """prompt + max_new_tokens exceeds the cache slot capacity."""
 
 
+class EngineFailedError(ServingError):
+    """The engine tick failed (device exception, non-finite logits) and
+    every in-flight request was resolved with this error.  The engine
+    restarts itself (bounded attempts); callers may retry — unless the
+    restart budget is exhausted, in which case new submits raise this
+    too and ``/healthz`` reports ``failed``."""
+
+
+class EngineStalledError(EngineFailedError):
+    """The watchdog declared the engine stalled: a tick exceeded its
+    wall-clock budget (hung device call).  In-flight AND queued
+    requests are resolved with this error — a hung tick may never
+    return, so nothing is left waiting on it."""
+
+
+class DrainingError(ServingError):
+    """The server is draining for shutdown — new requests are rejected
+    (HTTP 503 ``draining``); admitted requests run to completion."""
+
+
 _req_ids = itertools.count()
 
 
@@ -69,11 +89,21 @@ class Scheduler:
 
     Thread-safe: callers submit from any thread; the engine thread
     drains with :meth:`take`.
+
+    ``on_reject`` (constructor) is the ONE metrics hook for shed load:
+    it fires for submit-time :class:`QueueFullError` AND for
+    :class:`DeadlineExceededError` rejections inside :meth:`take`, so a
+    counter wired here sees every rejection path (the engine wires
+    ``metrics.rejected``).  ``on_cancel`` fires when a queued request
+    is resolved because its future was cancelled before admission.
     """
 
     def __init__(self, *, max_queue_depth: int = 64,
                  max_prefills_per_tick: int = 2,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 on_reject: Optional[
+                     Callable[[Request, ServingError], None]] = None,
+                 on_cancel: Optional[Callable[[Request], None]] = None):
         if max_queue_depth < 1:
             raise ValueError(f"max_queue_depth must be >= 1, got "
                              f"{max_queue_depth}")
@@ -83,6 +113,8 @@ class Scheduler:
         self.max_queue_depth = max_queue_depth
         self.max_prefills_per_tick = max_prefills_per_tick
         self._clock = clock
+        self._on_reject = on_reject
+        self._on_cancel = on_cancel
         self._q: collections.deque = collections.deque()
         self._lock = threading.Lock()
 
@@ -92,37 +124,74 @@ class Scheduler:
 
     def submit(self, req: Request) -> None:
         """Enqueue FCFS; raises :class:`QueueFullError` at capacity (the
-        caller's future is untouched — the submit call itself fails)."""
+        caller's future is untouched — the submit call itself fails —
+        but the constructor's ``on_reject`` IS notified, so shed load
+        at submit time counts the same as shed load in :meth:`take`)."""
         req.submitted_at = self._clock()
+        err: Optional[QueueFullError] = None
         with self._lock:
             if len(self._q) >= self.max_queue_depth:
-                raise QueueFullError(
+                err = QueueFullError(
                     f"request queue at capacity ({self.max_queue_depth})")
-            self._q.append(req)
+            else:
+                self._q.append(req)
+        if err is not None:
+            if self._on_reject is not None:
+                self._on_reject(req, err)
+            raise err
 
     def take(self, free_slots: int,
              on_reject: Optional[Callable[[Request, ServingError], None]]
              = None) -> List[Request]:
         """Up to ``min(max_prefills_per_tick, free_slots)`` admissible
-        requests, FCFS.  Requests whose deadline lapsed while queued are
-        rejected in place: their future gets a
-        :class:`DeadlineExceededError` and ``on_reject`` is notified —
-        they do not consume a slot or a prefill budget entry."""
+        requests, FCFS.  Requests whose deadline lapsed — or whose
+        future was cancelled — while queued are resolved in place
+        (:class:`DeadlineExceededError` on the future / finished with
+        reason ``"cancelled"``) without consuming a slot or a prefill
+        budget entry, EVEN when the budget is zero: dead heads never
+        block the queue.  Both the constructor's ``on_reject`` and the
+        per-call one (if given) are notified of rejections."""
         budget = min(self.max_prefills_per_tick, free_slots)
         out: List[Request] = []
-        while budget > 0:
+        while True:
             with self._lock:
                 if not self._q:
                     break
                 req = self._q.popleft()
+            fut = req.future
+            if getattr(fut, "done", lambda: False)():
+                # Already resolved elsewhere (e.g. a submit that raced
+                # a drain/terminal failure set its exception after
+                # enqueuing) — drop it, nothing to admit or notify.
+                continue
+            if getattr(fut, "cancel_requested", False):
+                fut._finish("cancelled")
+                if self._on_cancel is not None:
+                    self._on_cancel(req)
+                continue
             if req.deadline is not None and self._clock() > req.deadline:
                 err = DeadlineExceededError(
                     f"request {req.id} deadline passed while queued "
                     f"({self._clock() - req.submitted_at:.3f}s in queue)")
-                req.future.set_exception(err)
+                fut.set_exception(err)
+                if self._on_reject is not None:
+                    self._on_reject(req, err)
                 if on_reject is not None:
                     on_reject(req, err)
                 continue
+            if budget <= 0:
+                with self._lock:
+                    self._q.appendleft(req)  # still the FCFS head
+                break
             out.append(req)
             budget -= 1
+        return out
+
+    def drain_pending(self) -> List[Request]:
+        """Atomically remove and return every queued request — the
+        terminal-failure / forced-shutdown path, where the caller must
+        resolve each future itself so nothing is left hanging."""
+        with self._lock:
+            out = list(self._q)
+            self._q.clear()
         return out

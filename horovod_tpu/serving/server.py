@@ -12,9 +12,18 @@ Endpoints:
   server was built with an ``encode`` callable).  Replies ``{"tokens":
   [...], "finish_reason": ..., "ttft_ms": ...}`` (+ ``"text"`` with a
   detokenizer).  Typed rejections map to HTTP: queue full -> 429,
-  too long -> 413, deadline -> 504, bad request -> 400.
-* ``GET /healthz`` — liveness + slot headroom.
-* ``GET /stats`` — the full metrics snapshot (serving/metrics.py).
+  too long -> 413, deadline -> 504, draining / engine failed -> 503,
+  bad request -> 400.  When no ``timeout_ms`` is sent, the request's
+  engine deadline defaults to the server's ``request_timeout`` — every
+  admitted request carries a deadline, so a vanished client can never
+  pin a slot to ``max_new_tokens``.
+* ``GET /healthz`` — readiness keyed to the engine state machine:
+  200 for ``healthy``/``degraded``, **503 for ``draining`` and
+  ``failed``** so load balancers stop routing before teardown or after
+  an unrecovered failure.
+* ``GET /stats`` — the full metrics snapshot (serving/metrics.py),
+  including ``state``, ``state_transitions``, ``engine_failures`` and
+  ``engine_restarts``.
 """
 
 from __future__ import annotations
@@ -25,9 +34,11 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Sequence
 
-from horovod_tpu.serving.engine import InferenceEngine
+from horovod_tpu.serving.engine import DEGRADED, HEALTHY, InferenceEngine
 from horovod_tpu.serving.scheduler import (
     DeadlineExceededError,
+    DrainingError,
+    EngineFailedError,
     QueueFullError,
     RequestTooLongError,
     ServingError,
@@ -56,10 +67,13 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         engine: InferenceEngine = self.server.engine
         if self.path == "/healthz":
-            self._json(200, {
-                "status": "ok",
+            state = engine.health
+            code = 200 if state in (HEALTHY, DEGRADED) else 503
+            self._json(code, {
+                "status": state,
                 "slots_free": engine.slots.free_count,
                 "queue_depth": engine.scheduler.depth,
+                "engine_restarts": engine.metrics.engine_restarts.value,
             })
         elif self.path == "/stats":
             self._json(200, engine.stats())
@@ -100,15 +114,26 @@ class _Handler(BaseHTTPRequestHandler):
             return
 
         timeout_ms = req.get("timeout_ms")
+        fut = None
         try:
-            deadline = (time.monotonic() + float(timeout_ms) / 1e3
-                        if timeout_ms else None)
+            # Every request gets an engine deadline: the client's
+            # timeout_ms, or the server's request_timeout when none is
+            # sent — an abandoned request retires itself even if this
+            # handler dies before it can cancel.
+            deadline = time.monotonic() + (
+                float(timeout_ms) / 1e3 if timeout_ms
+                else self.server.request_timeout)
             fut = engine.submit(
                 [int(t) for t in tokens],
                 max_new_tokens=req.get("max_new_tokens"),
                 eos_id=req.get("eos_id"),
                 deadline=deadline)
-            out = fut.result(timeout=self.server.request_timeout)
+            # The engine's deadline retirement (partial result, reason
+            # "deadline") should win over this hard HTTP timeout, which
+            # only fires when the engine cannot retire (e.g. hung) —
+            # hence the grace on top of request_timeout.
+            out = fut.result(timeout=self.server.request_timeout
+                             + self.server.timeout_grace)
         except QueueFullError as e:
             self._json(429, {"error": str(e), "type": "queue_full"})
             return
@@ -118,6 +143,14 @@ class _Handler(BaseHTTPRequestHandler):
         except DeadlineExceededError as e:
             self._json(504, {"error": str(e), "type": "deadline_exceeded"})
             return
+        except DrainingError as e:
+            self._json(503, {"error": str(e), "type": "draining"})
+            return
+        except EngineFailedError as e:
+            # Submit-time (terminally failed) or result-time (this
+            # request was in flight when the engine failed/stalled).
+            self._json(503, {"error": str(e), "type": "engine_failed"})
+            return
         except (ServingError, ValueError, TypeError) as e:
             # TypeError covers non-numeric JSON fields (timeout_ms,
             # max_new_tokens, nested token lists): a 400, not a dropped
@@ -125,6 +158,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(400, {"error": str(e)})
             return
         except TimeoutError as e:
+            # 504 without cancellation would leak the slot: the engine
+            # would keep decoding to max_new_tokens for a caller that
+            # already got its error page.  cancel() reclaims the slot
+            # (or purges the queue entry) on the next tick.
+            if fut is not None:
+                fut.cancel()
             self._json(504, {"error": str(e), "type": "timeout"})
             return
         payload = {
@@ -143,18 +182,20 @@ class ServingServer:
     >>> srv = ServingServer(engine, port=0)      # 0 = ephemeral port
     >>> srv.start()                              # engine + HTTP threads
     >>> srv.address                              # ("127.0.0.1", 43117)
-    >>> srv.stop()                               # both torn down
+    >>> srv.stop(drain_timeout=30)               # graceful drain, then down
     """
 
     def __init__(self, engine: InferenceEngine, *,
                  host: str = "127.0.0.1", port: int = 8000,
                  encode: Optional[Callable[[str], Sequence[int]]] = None,
-                 request_timeout: float = 120.0):
+                 request_timeout: float = 120.0,
+                 timeout_grace: float = 5.0):
         self.engine = engine
         self.host = host
         self.port = port
         self.encode = encode
         self.request_timeout = request_timeout
+        self.timeout_grace = timeout_grace
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -174,13 +215,32 @@ class ServingServer:
         self._httpd.engine = self.engine
         self._httpd.encode = self.encode
         self._httpd.request_timeout = self.request_timeout
+        self._httpd.timeout_grace = self.timeout_grace
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, name="serving-http",
             daemon=True)
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def stop(self, drain_timeout: float = 30.0) -> None:
+        """Graceful drain, then teardown — bounded by ``drain_timeout``.
+
+        1. The engine enters ``draining``: new ``/generate`` calls get
+           503 ``"draining"``, ``/healthz`` goes non-200 (load
+           balancers stop routing).
+        2. Admitted and queued requests run to completion (the engine
+           keeps ticking); if the budget lapses first, whatever remains
+           is force-resolved with a typed :class:`EngineFailedError` —
+           teardown never strands a future.
+        3. The HTTP listener and the engine thread shut down.
+        """
+        if self._httpd is None and self._thread is None:
+            return
+        self.engine.begin_drain()
+        if not self.engine.drain(timeout=drain_timeout):
+            self.engine.terminate(
+                f"server shutdown: drain budget ({drain_timeout}s) "
+                f"exhausted")
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()

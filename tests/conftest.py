@@ -43,3 +43,22 @@ def _hvd():
 @pytest.fixture()
 def hvd(_hvd):
     return _hvd
+
+
+def http_post_json(url, payload, timeout=60.0):
+    """POST JSON to the serving server; returns (status, parsed body),
+    unwrapping HTTPError so typed rejections (429/413/503/504) read
+    like normal responses.  Shared by the serving and chaos suites so
+    the response-protocol handling cannot silently diverge."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())

@@ -61,6 +61,42 @@ class TestCheckpoint:
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         h.wait()  # idempotent
 
+    def test_restore_latest_skips_corrupt_newest(self, hvd, tmp_path):
+        """A truncated newest checkpoint falls back to the previous
+        intact one instead of raising (the crash-mid-write resume
+        story)."""
+        mgr = checkpoint.CheckpointManager(str(tmp_path / "cruns"),
+                                           max_to_keep=3)
+        mgr.save(1, {"x": np.full(3, 1.0)})
+        mgr.save(2, {"x": np.full(3, 2.0)})
+        # truncate every file in the newest step dir (torn write)
+        newest = mgr._step_dir(2)
+        for root, _, files in os.walk(newest):
+            for f in files:
+                open(os.path.join(root, f), "wb").close()
+        with pytest.warns(UserWarning, match="step 2.*unreadable"):
+            step, tree = mgr.restore_latest({"x": np.zeros(3)})
+        assert step == 1
+        np.testing.assert_array_equal(tree["x"], np.full(3, 1.0))
+
+    def test_saves_are_atomic_tmp_invisible(self, hvd, tmp_path):
+        """A crash-abandoned step_N.tmp directory is never listed nor
+        restored; a clean save commits via rename (no .tmp left)."""
+        mgr = checkpoint.CheckpointManager(str(tmp_path / "atomic"))
+        mgr.save(5, {"x": np.full(2, 5.0)})
+        assert not any(n.endswith(".tmp")
+                       for n in os.listdir(mgr.directory))
+        # simulate a crash mid-save: a half-written tmp for step 6
+        os.makedirs(mgr._step_dir(6) + ".tmp")
+        assert mgr.all_steps() == [5]
+        step, tree = mgr.restore_latest({"x": np.zeros(2)})
+        assert step == 5
+        np.testing.assert_array_equal(tree["x"], np.full(2, 5.0))
+        # the next save sweeps the crash-abandoned tmp (no disk leak)
+        mgr.save(7, {"x": np.full(2, 7.0)})
+        assert not os.path.isdir(mgr._step_dir(6) + ".tmp")
+        assert mgr.all_steps() == [5, 7]
+
     def test_manager_async_saves(self, hvd, tmp_path):
         """async_saves=True: saves overlap the 'training' between them
         (at most one in flight); restore paths wait before reading;

@@ -1,0 +1,645 @@
+"""Chaos suite for the serving fault-tolerance layer.
+
+THE invariant (docs/serving.md "Operations"): **no submitted request
+ever hangs** — under injected device exceptions, non-finite logits,
+hung ticks, and mid-stream cancellations, every
+:class:`GenerationFuture` resolves with tokens or a typed error within
+a bounded wall-clock, the engine recovers through supervised restarts,
+and post-recovery greedy output is still token-identical to
+per-request ``greedy_decode`` (the same oracle as
+``tests/test_serving.py``).
+
+Faults come from :class:`horovod_tpu.serving.FaultInjector` — seeded,
+site-addressed, visit-counted — so every test here is deterministic:
+same spec, same call sequence, same faults.  Engines are WARMED before
+the watchdog is armed (first-tick XLA compilation would otherwise
+read as a stall on CPU).
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import serving
+from horovod_tpu.models import transformer as T
+
+pytestmark = [pytest.mark.serving, pytest.mark.chaos]
+
+
+def _cfg():
+    return T.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=48, dtype=jnp.float32, attention_impl="reference",
+        n_kv_heads=2)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return T.init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _ref_greedy(params, cfg, prompt, steps):
+    return np.asarray(T.greedy_decode(
+        params, jnp.asarray([prompt], jnp.int32), steps, cfg))[0].tolist()
+
+
+def _engine(model, *, faults=None, **kw):
+    params, cfg = model
+    defaults = dict(n_slots=2, max_len=40, min_prefill_bucket=4,
+                    restart_backoff=0.01, restart_backoff_max=0.05,
+                    faults=faults)
+    defaults.update(kw)
+    return serving.InferenceEngine(
+        params, cfg, serving.EngineConfig(**defaults))
+
+
+def _run_until_done(engine, futs, max_ticks=300):
+    for _ in range(max_ticks):
+        if all(f.done() for f in futs):
+            return
+        engine.step()
+    raise AssertionError("engine did not finish within the tick budget")
+
+
+def _warm(engine, prompt_lens=(3,)):
+    """Compile every prefill bucket + the decode tick BEFORE arming the
+    watchdog: first-tick XLA compilation takes seconds on CPU and must
+    not read as a stall."""
+    futs = [engine.submit(list(range(1, n + 1)), max_new_tokens=2)
+            for n in prompt_lens]
+    _run_until_done(engine, futs)
+
+
+def _wait_for(pred, timeout=15.0, poll=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(poll)
+    return False
+
+
+from conftest import http_post_json as _post  # noqa: E402
+
+
+class TestFaultInjector:
+    def test_deterministic_and_site_addressed(self):
+        def run():
+            inj = serving.FaultInjector([
+                serving.FaultSpec(site="decode_tick", kind="raise",
+                                  skip=1, max_fires=2, p=0.5),
+            ], seed=42)
+            fired = []
+            for _ in range(20):
+                try:
+                    inj.probe("decode_tick")
+                except serving.InjectedFaultError:
+                    fired.append(inj.fired[-1])
+                inj.probe("prefill")  # other sites never fire this spec
+            return fired, inj
+
+        fired_a, inj_a = run()
+        fired_b, _ = run()
+        assert fired_a == fired_b            # same seed, same faults
+        assert len(fired_a) == 2             # max_fires honored
+        assert all(site == "decode_tick" for site, _, _ in fired_a)
+        assert all(visit >= 1 for _, _, visit in fired_a)  # skip honored
+        assert inj_a.exhausted
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="site"):
+            serving.FaultInjector([serving.FaultSpec(site="nope")])
+        with pytest.raises(ValueError, match="kind"):
+            serving.FaultInjector(
+                [serving.FaultSpec(site="prefill", kind="nope")])
+
+    def test_hang_sleeps(self):
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="watchdog", kind="hang", delay=0.05)])
+        t0 = time.monotonic()
+        assert inj.probe("watchdog") == "hang"
+        assert time.monotonic() - t0 >= 0.05
+        assert inj.probe("watchdog") is None  # max_fires=1 default
+
+
+class TestSupervisedRestart:
+    def test_decode_raise_fails_inflight_and_restarts(self, model):
+        """A device exception mid-decode resolves every in-flight
+        future with a typed EngineFailedError, restarts the engine
+        (fresh SlotCache), and post-restart output is oracle-exact."""
+        params, cfg = model
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_tick", kind="raise", skip=1)])
+        engine = _engine(model, faults=inj)
+        futs = [engine.submit([3, 4, 5], max_new_tokens=8),
+                engine.submit([7, 8], max_new_tokens=8)]
+        _run_until_done(engine, futs)
+        for f in futs:
+            with pytest.raises(serving.EngineFailedError):
+                f.result(timeout=0)
+        s = engine.stats()
+        assert s["engine_failures"] == 1
+        assert s["engine_restarts"] == 1
+        assert "degraded" in s["state_transitions"]
+        # recovery: the engine serves oracle-identical output
+        fut = engine.submit([3, 4, 5], max_new_tokens=8)
+        _run_until_done(engine, [fut])
+        assert fut.result(timeout=0) == _ref_greedy(params, cfg,
+                                                    [3, 4, 5], 8)
+        assert engine.health == "healthy"
+
+    def test_prefill_fault_fails_admitting_request(self, model):
+        """A fault during admission (mid-prefill) must fail the request
+        being admitted — it is in neither the queue nor a slot at that
+        instant."""
+        params, cfg = model
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="prefill", kind="raise")])
+        engine = _engine(model, faults=inj)
+        fut = engine.submit([5, 6, 7], max_new_tokens=6)
+        _run_until_done(engine, [fut])
+        with pytest.raises(serving.EngineFailedError):
+            fut.result(timeout=0)
+        fut = engine.submit([5, 6, 7], max_new_tokens=6)
+        _run_until_done(engine, [fut])
+        assert fut.result(timeout=0) == _ref_greedy(params, cfg,
+                                                    [5, 6, 7], 6)
+        assert engine.stats()["engine_restarts"] == 1
+
+    def test_nonfinite_logits_typed_failure(self, model):
+        """NaN logits out of the decode tick become a typed engine
+        failure (never silently-greedy garbage tokens), then recovery."""
+        params, cfg = model
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_tick", kind="nonfinite")])
+        engine = _engine(model, faults=inj)
+        fut = engine.submit([9, 10], max_new_tokens=5)
+        _run_until_done(engine, [fut])
+        with pytest.raises(serving.EngineFailedError, match="non-finite"):
+            fut.result(timeout=0)
+        fut = engine.submit([9, 10], max_new_tokens=5)
+        _run_until_done(engine, [fut])
+        assert fut.result(timeout=0) == _ref_greedy(params, cfg,
+                                                    [9, 10], 5)
+
+    def test_restart_budget_exhausted_goes_terminal(self, model):
+        """Consecutive failures past max_restarts: the engine goes
+        terminally failed, resolves the queue, and rejects new submits
+        with a typed error — nothing ever hangs on a dead engine."""
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_tick", kind="raise",
+                              max_fires=None)])
+        engine = _engine(model, faults=inj, max_restarts=1)
+        f1 = engine.submit([1, 2], max_new_tokens=4)
+        engine.step()  # admit + decode -> failure #1 -> restart
+        assert engine.health == "degraded"
+        with pytest.raises(serving.EngineFailedError):
+            f1.result(timeout=0)
+        f2 = engine.submit([3, 4], max_new_tokens=4)
+        f3 = engine.submit([5, 6], max_new_tokens=4)
+        engine.step()  # failure #2 > budget -> terminal
+        assert engine.health == "failed"
+        for f in (f2, f3):  # in-flight AND still-queued both resolved
+            with pytest.raises(serving.EngineFailedError):
+                f.result(timeout=0)
+        with pytest.raises(serving.EngineFailedError):
+            engine.submit([7], max_new_tokens=2)
+        assert engine.step() is False  # dead engines don't tick
+        s = engine.stats()
+        assert s["state"] == "failed"
+        assert s["engine_restarts"] == 1
+        assert s["state_transitions"][-1] == "failed"
+        # no phantom in-flight work on a dead engine
+        assert s["slots_active"] == 0
+        assert engine.slots.free_count == engine.engine_cfg.n_slots
+
+
+class TestWatchdog:
+    def test_stall_resolves_futures_then_recovers(self, model):
+        """A hung tick: the watchdog fails in-flight + queued futures
+        with EngineStalledError within the budget (the tick may never
+        return); when it does return, the supervised restart brings the
+        engine back to oracle-exact output."""
+        params, cfg = model
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_tick", kind="hang",
+                              delay=1.2, skip=3)])
+        engine = _engine(model, faults=inj, n_slots=2,
+                         tick_timeout=0.3, watchdog_interval=0.02)
+        _warm(engine)
+        engine.start()
+        try:
+            t0 = time.monotonic()
+            f_run = engine.submit([11, 12, 13], max_new_tokens=30)
+            f_queued = engine.submit([14, 15], max_new_tokens=30)
+            f_queued2 = engine.submit([16], max_new_tokens=30)
+            # n_slots=2: f_run/f_queued admitted, f_queued2 waits.  The
+            # 4th decode tick hangs 1.2s; the watchdog declares a stall
+            # at ~0.3s and resolves ALL of them typed.
+            for f in (f_run, f_queued, f_queued2):
+                with pytest.raises(serving.EngineStalledError):
+                    f.result(timeout=10.0)
+            resolved_in = time.monotonic() - t0
+            assert resolved_in < 1.2  # resolved BEFORE the hung tick ends
+            assert "failed" in engine.state_transitions
+            # the hung tick returns -> supervised restart -> healthy
+            assert _wait_for(lambda: engine.health == "healthy")
+            fut = engine.submit([11, 12, 13], max_new_tokens=6)
+            assert fut.result(timeout=10.0) == _ref_greedy(
+                params, cfg, [11, 12, 13], 6)
+            s = engine.stats()
+            assert s["engine_restarts"] >= 1
+            assert "degraded" in s["state_transitions"]
+        finally:
+            engine.stop()
+
+    def test_terminate_bounded_with_hung_tick_no_watchdog(self, model):
+        """Watchdog disabled + hung tick: drain() must not inherit the
+        hang (its lock acquire is timed), and terminate() still
+        force-resolves every future in bounded time — teardown is
+        bounded even when nothing else is."""
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_tick", kind="hang",
+                              delay=1.5, skip=1)])
+        engine = _engine(model, faults=inj, tick_timeout=0)
+        _warm(engine)
+        engine.start()
+        try:
+            fut = engine.submit([1, 2], max_new_tokens=10)
+            assert _wait_for(lambda: engine.slots.active_count == 1,
+                             timeout=5.0)
+            time.sleep(0.1)  # now inside the 1.5s hang, _lock held
+            t0 = time.monotonic()
+            assert engine.drain(timeout=0.3) is False
+            assert time.monotonic() - t0 < 1.0  # bounded, not hung
+            engine.terminate("operator shutdown")
+            assert time.monotonic() - t0 < 2.0
+            with pytest.raises(serving.EngineFailedError):
+                fut.result(timeout=1.0)
+            assert engine.health == "failed"
+            # the late-returning tick may only land terminal, never a
+            # restart that reopens the engine
+            time.sleep(1.6)
+            assert engine.health == "failed"
+            with pytest.raises(serving.EngineFailedError):
+                engine.submit([3], max_new_tokens=2)
+        finally:
+            engine.stop()
+
+    def test_draining_sticky_across_stall_recovery(self, model):
+        """A stall overwrites DRAINING with FAILED; the recovery
+        restart must restore DRAINING — never reopen a draining engine
+        as DEGRADED behind a still-open listener."""
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_tick", kind="hang",
+                              delay=0.8, skip=1)])
+        engine = _engine(model, faults=inj, tick_timeout=0.2,
+                         watchdog_interval=0.02)
+        _warm(engine)
+        engine.start()
+        try:
+            fut = engine.submit([1, 2], max_new_tokens=20)
+            engine.begin_drain()
+            with pytest.raises(serving.EngineStalledError):
+                fut.result(timeout=10.0)
+            assert _wait_for(
+                lambda: engine.metrics.engine_restarts.value >= 1)
+            assert engine.health == "draining"
+            with pytest.raises(serving.DrainingError):
+                engine.submit([3], max_new_tokens=2)
+        finally:
+            engine.stop()
+
+    def test_hang_before_admission_fails_queued(self, model):
+        """A stall while requests are still QUEUED (hang at the
+        watchdog probe site, before admission) resolves them too — the
+        queue is never left behind a hung engine."""
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="watchdog", kind="hang", delay=0.9,
+                              skip=0)])
+        engine = _engine(model, faults=inj, tick_timeout=0.2,
+                         watchdog_interval=0.02)
+        # Submit BEFORE start: the very first step hangs ahead of
+        # admission, so both requests are queued when the stall lands.
+        f1 = engine.submit([1, 2], max_new_tokens=4)
+        f2 = engine.submit([3, 4], max_new_tokens=4)
+        engine.start()
+        try:
+            for f in (f1, f2):
+                with pytest.raises(serving.EngineStalledError):
+                    f.result(timeout=10.0)
+            assert _wait_for(lambda: engine.health == "healthy")
+        finally:
+            engine.stop()
+
+
+class TestCancellation:
+    def test_cancel_midstream_reclaims_slot(self, model):
+        params, cfg = model
+        engine = _engine(model)
+        fut = engine.submit([21, 22], max_new_tokens=30)
+        engine.step()
+        engine.step()
+        n_before = len(fut.tokens_so_far())
+        assert 0 < n_before < 30
+        assert fut.cancel() is True
+        engine.step()  # reclamation tick
+        assert fut.done() and fut.finish_reason == "cancelled"
+        assert fut.cancelled
+        toks = fut.result(timeout=0)  # resolves with partial tokens
+        assert len(toks) == n_before < 30
+        assert engine.slots.active_count == 0  # slot reclaimed
+        assert engine.stats()["requests_cancelled"] == 1
+        # the freed slot serves the next request, oracle-exact
+        fut = engine.submit([21, 22], max_new_tokens=5)
+        _run_until_done(engine, [fut])
+        assert fut.result(timeout=0) == _ref_greedy(params, cfg,
+                                                    [21, 22], 5)
+
+    def test_cancel_queued_never_admitted(self, model):
+        engine = _engine(model, n_slots=1)
+        f_run = engine.submit([1, 2], max_new_tokens=20)
+        f_queued = engine.submit([3, 4], max_new_tokens=20)
+        engine.step()  # f_run takes the only slot
+        assert f_queued.cancel() is True
+        engine.step()  # queue purge: cancelled head never takes a slot
+        assert f_queued.done() and f_queued.finish_reason == "cancelled"
+        assert f_queued.result(timeout=0) == []
+        assert engine.stats()["requests_admitted"] == 1
+        f_run.cancel()
+        engine.step()
+        assert f_run.done()
+
+    def test_cancel_after_done_is_noop(self, model):
+        engine = _engine(model)
+        fut = engine.submit([5, 6], max_new_tokens=2)
+        _run_until_done(engine, [fut])
+        assert fut.cancel() is False
+        assert fut.finish_reason == "length"
+
+
+class TestChaosInvariant:
+    def test_no_submitted_request_ever_hangs(self, model):
+        """ACCEPTANCE: faults at every site — raise, non-finite, and a
+        watchdog-tripping hang — against a loaded background engine.
+        100% of submitted requests resolve with tokens or a typed
+        error within a bounded wall-clock (zero hung futures), the
+        engine recovers, serves oracle-identical greedy output, and
+        the restarts + health transitions are visible in stats."""
+        params, cfg = model
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="prefill", kind="raise", skip=3),
+            serving.FaultSpec(site="decode_tick", kind="raise", skip=6),
+            serving.FaultSpec(site="decode_tick", kind="nonfinite",
+                              skip=11),
+            serving.FaultSpec(site="decode_tick", kind="hang",
+                              delay=0.8, skip=16),
+        ], seed=0)
+        engine = _engine(model, faults=inj, n_slots=4, max_restarts=10,
+                         tick_timeout=0.3, watchdog_interval=0.02,
+                         max_queue_depth=64)
+        _warm(engine, prompt_lens=(3, 7))  # both prefill buckets
+        engine.start()
+        rng = np.random.default_rng(5)
+        t0 = time.monotonic()
+        try:
+            futs = []
+            for i in range(16):
+                prompt = rng.integers(0, cfg.vocab_size,
+                                      2 + i % 7).tolist()
+                try:
+                    futs.append(engine.submit(prompt, max_new_tokens=16))
+                except serving.ServingError:
+                    pass  # typed submit-time rejection = resolved too
+            # THE invariant: every future resolves inside the bound —
+            # tokens or a typed ServingError, never a hang.
+            outcomes = {"ok": 0, "typed_error": 0}
+            for f in futs:
+                try:
+                    f.result(timeout=30.0)
+                    outcomes["ok"] += 1
+                except serving.ServingError:
+                    outcomes["typed_error"] += 1
+            # (TimeoutError would propagate and fail the test: a hang.)
+            assert outcomes["ok"] + outcomes["typed_error"] == len(futs)
+            assert time.monotonic() - t0 < 60.0
+
+            # Burn off any fault that hasn't fired yet (e.g. the hang,
+            # if earlier failures emptied the pool first) so recovery
+            # is tested on a genuinely fault-free engine.
+            burn_deadline = time.monotonic() + 30.0
+            while not inj.exhausted:
+                assert time.monotonic() < burn_deadline, \
+                    "faults never exhausted"
+                if engine.health in ("healthy", "degraded"):
+                    try:
+                        f = engine.submit([1, 2, 3], max_new_tokens=8)
+                        try:
+                            f.result(timeout=10.0)
+                        except serving.ServingError:
+                            pass
+                    except serving.ServingError:
+                        pass
+                else:
+                    time.sleep(0.05)
+
+            assert _wait_for(lambda: engine.health == "healthy")
+            # Recovery correctness: oracle-identical greedy output.
+            prompt = [30, 31, 32]
+            fut = engine.submit(prompt, max_new_tokens=10)
+            assert fut.result(timeout=15.0) == _ref_greedy(
+                params, cfg, prompt, 10)
+            s = engine.stats()
+            assert s["engine_failures"] >= 4   # all four specs fired
+            assert s["engine_restarts"] >= 3
+            assert s["state"] == "healthy"
+            assert "degraded" in s["state_transitions"]
+            assert "failed" in s["state_transitions"]  # the stall
+            # the decode executable NEVER recompiled — restarts swap
+            # the cache, not the program
+            assert s["decode_compilations"] == 1
+        finally:
+            engine.stop()
+
+
+class TestServerFaultTolerance:
+    def _serve(self, engine, **kw):
+        return serving.ServingServer(engine, port=0, **kw)
+
+    def test_healthz_tracks_state_machine(self, model):
+        """healthy -> 200; failed -> 503 (load balancers stop
+        routing); stats carry the transition trail."""
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_tick", kind="raise",
+                              max_fires=None)])
+        engine = _engine(model, faults=inj, max_restarts=0)
+        with self._serve(engine) as srv:
+            host, port = srv.address
+            base = f"http://{host}:{port}"
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=10) as r:
+                assert json.loads(r.read())["status"] == "healthy"
+            code, out = _post(base + "/generate",
+                              {"tokens": [1, 2], "max_new_tokens": 4})
+            assert code == 503
+            assert out["type"] == "engine_failed"
+            assert _wait_for(lambda: engine.health == "failed")
+            try:
+                urllib.request.urlopen(base + "/healthz", timeout=10)
+                raise AssertionError("expected 503")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert json.loads(e.read())["status"] == "failed"
+            with urllib.request.urlopen(base + "/stats", timeout=10) as r:
+                s = json.loads(r.read())
+            assert s["state"] == "failed"
+            assert s["engine_failures"] >= 1
+
+    def test_504_cancels_and_frees_slot(self, model):
+        """The 504 slot-leak fix: an HTTP timeout cancels the request,
+        so the slot frees on the next tick instead of decoding to
+        max_new_tokens for a caller that already got its error page."""
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_tick", kind="hang",
+                              delay=0.05, max_fires=None)])
+        engine = _engine(model, faults=inj, n_slots=2)
+        _warm(engine)
+        # explicit timeout_ms >> request_timeout: the engine deadline
+        # never fires, so only the HTTP timeout (and its cancel) can
+        # free the slot.
+        with self._serve(engine, request_timeout=0.4,
+                         timeout_grace=0.1) as srv:
+            host, port = srv.address
+            t0 = time.monotonic()
+            code, out = _post(
+                f"http://{host}:{port}/generate",
+                {"tokens": [1, 2], "max_new_tokens": 38,
+                 "timeout_ms": 60000})
+            assert (code, out["type"]) == (504, "timeout")
+            # 38 tokens x >=50ms/tick ~= 2s of decoding left; the
+            # cancel must free the slot in ~one tick instead.
+            assert _wait_for(lambda: engine.slots.active_count == 0,
+                             timeout=1.0)
+            assert time.monotonic() - t0 < 1.8
+            assert engine.stats()["requests_cancelled"] == 1
+
+    def test_default_deadline_from_request_timeout(self, model):
+        """No client timeout_ms: the engine deadline defaults to the
+        server's request_timeout, so the request deadline-retires with
+        a partial result instead of running to max_new_tokens."""
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_tick", kind="hang",
+                              delay=0.05, max_fires=None)])
+        engine = _engine(model, faults=inj, n_slots=2)
+        _warm(engine)
+        with self._serve(engine, request_timeout=0.4) as srv:
+            host, port = srv.address
+            code, out = _post(f"http://{host}:{port}/generate",
+                              {"tokens": [1, 2], "max_new_tokens": 38})
+            assert code == 200
+            assert out["finish_reason"] == "deadline"
+            assert 1 <= len(out["tokens"]) < 38
+
+    def test_drain_under_load(self, model):
+        """stop(drain_timeout): a burst in flight completes, new
+        requests get 503 draining, /healthz goes non-200, and teardown
+        lands inside the budget."""
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_tick", kind="hang",
+                              delay=0.03, max_fires=None)])
+        engine = _engine(model, faults=inj, n_slots=4)
+        _warm(engine)
+        srv = self._serve(engine, request_timeout=60.0).start()
+        host, port = srv.address
+        base = f"http://{host}:{port}"
+
+        results = [None] * 6
+        def client(i):
+            results[i] = _post(base + "/generate",
+                               {"tokens": [1 + i, 2 + i],
+                                "max_new_tokens": 12})
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        # every client is IN the system (admitted or queued) before the
+        # drain starts — none may be shed as 503 by a racing stop()
+        assert _wait_for(lambda: engine.metrics.admitted.value
+                         + engine.scheduler.depth >= 6)
+
+        t0 = time.monotonic()
+        stopper = threading.Thread(target=lambda: srv.stop(
+            drain_timeout=20.0))
+        stopper.start()
+        assert _wait_for(lambda: engine.health == "draining")
+        # burst still decoding (>=8 ticks x 30ms left): probe the
+        # draining server while it is provably mid-drain
+        code, out = _post(base + "/generate", {"tokens": [9],
+                                               "max_new_tokens": 2})
+        assert (code, out["type"]) == (503, "draining")
+        try:
+            urllib.request.urlopen(base + "/healthz", timeout=10)
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as e:
+            assert e.code == 503
+            assert json.loads(e.read())["status"] == "draining"
+        stopper.join(25.0)
+        assert not stopper.is_alive()
+        assert time.monotonic() - t0 < 22.0  # teardown inside budget
+        for t in threads:
+            t.join(10.0)
+        # every admitted request completed normally through the drain
+        assert all(r is not None and r[0] == 200
+                   and r[1]["finish_reason"] == "length"
+                   for r in results)
+        assert engine.slots.active_count == 0
+        assert engine.scheduler.depth == 0
+
+    @pytest.mark.slow
+    def test_chaos_soak_http(self, model):
+        """Long soak: rolling faults under concurrent HTTP traffic;
+        every response is 200 or a typed error payload, and the engine
+        ends healthy and oracle-exact."""
+        params, cfg = model
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_tick", kind="raise",
+                              skip=9, max_fires=3, p=0.5),
+            serving.FaultSpec(site="prefill", kind="raise",
+                              skip=12, max_fires=2, p=0.5),
+        ], seed=11)
+        engine = _engine(model, faults=inj, n_slots=4, max_restarts=50)
+        _warm(engine, prompt_lens=(3, 7))
+        rng = np.random.default_rng(13)
+        with self._serve(engine, request_timeout=30.0) as srv:
+            host, port = srv.address
+            base = f"http://{host}:{port}"
+            results = [None] * 32
+
+            def client(i):
+                p = rng.integers(0, cfg.vocab_size, 2 + i % 6).tolist()
+                results[i] = _post(base + "/generate",
+                                   {"tokens": p, "max_new_tokens":
+                                    2 + i % 8}, timeout=60.0)
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(32)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(90.0)
+            assert all(r is not None for r in results)  # nothing hung
+            assert all(r[0] in (200, 429, 503, 504) for r in results)
+            assert _wait_for(lambda: engine.health == "healthy")
+            prompt = [40, 41]
+            code, out = _post(base + "/generate",
+                              {"tokens": prompt, "max_new_tokens": 6})
+            assert code == 200
+            assert out["tokens"] == _ref_greedy(params, cfg, prompt, 6)

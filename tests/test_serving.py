@@ -276,6 +276,28 @@ class TestAdmissionControl:
         assert 1 <= len(fut.result(timeout=0)) < 16
         assert engine.slots.active_count == 0
 
+    def test_rejected_counts_both_paths(self, model):
+        """metrics.rejected sees BOTH rejection paths: submit-time
+        QueueFullError (via the scheduler's constructor on_reject) and
+        take-time DeadlineExceededError — /stats never under-reports
+        shed load."""
+        params, cfg = model
+        engine = serving.InferenceEngine(
+            params, cfg, serving.EngineConfig(n_slots=2, max_len=40,
+                                              max_queue_depth=1,
+                                              min_prefill_bucket=4))
+        # take-time path: queued past its deadline
+        fut = engine.submit([1, 2], max_new_tokens=2,
+                            deadline=time.monotonic() - 0.01)
+        # submit-time path: queue (depth 1) already full
+        with pytest.raises(serving.QueueFullError):
+            engine.submit([3, 4], max_new_tokens=2)
+        assert engine.stats()["requests_rejected"] == 1  # submit-time
+        engine.step()
+        with pytest.raises(serving.DeadlineExceededError):
+            fut.result(timeout=1.0)
+        assert engine.stats()["requests_rejected"] == 2  # + take-time
+
     def test_request_too_long_typed_rejection(self, model):
         params, cfg = model
         engine = serving.InferenceEngine(
@@ -320,15 +342,7 @@ class TestHistogram:
         assert serving.Histogram().percentile(0.5) is None
 
 
-def _post(url, payload, timeout=60.0):
-    req = urllib.request.Request(
-        url, data=json.dumps(payload).encode(),
-        headers={"Content-Type": "application/json"}, method="POST")
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            return r.status, json.loads(r.read())
-    except urllib.error.HTTPError as e:
-        return e.code, json.loads(e.read())
+from conftest import http_post_json as _post  # noqa: E402
 
 
 class TestServer:
@@ -346,7 +360,7 @@ class TestServer:
         params, cfg = model
         engine, base = served
         with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
-            assert json.loads(r.read())["status"] == "ok"
+            assert json.loads(r.read())["status"] == "healthy"
         code, out = _post(base + "/generate",
                           {"tokens": [3, 4, 5], "max_new_tokens": 5})
         assert code == 200
