@@ -714,14 +714,19 @@ def prefill(params: Dict, prompt, cache: Dict, cfg: TransformerConfig,
 
     ``true_len`` supports BUCKETED prefill (the serving engine's
     compile-stability lever): the prompt is RIGHT-padded to a bucket
-    length S0 and ``true_len`` (int or traced scalar) is its real token
-    count — logits come from position ``true_len - 1`` and the returned
-    ``pos`` is ``true_len``, so one compiled prefill per bucket serves
-    every length in the bucket.  Causality makes the padding inert for
-    the logits (position ``true_len - 1`` never attends past itself),
-    and the junk K/V it leaves at positions ``>= true_len`` is never
-    read: decode writes position ``p`` in the same step that first
-    attends it."""
+    length S0 and ``true_len`` is its real token count — logits come
+    from position ``true_len - 1`` and the returned ``pos`` is
+    ``true_len``, so one compiled prefill per bucket serves every
+    length in the bucket.  A SCALAR ``true_len`` (int or traced) keeps
+    the scalar-``pos`` cache contract for :func:`decode_step`; a
+    ``(B,)`` VECTOR gives every row its own length — the batch-K
+    multi-request prefill the continuous-batching engine admits with —
+    and the returned ``pos`` is the ``(B,)`` per-row count (consumed by
+    ``serving.cache.insert_prefill_batch``, one slot per row).
+    Causality makes the padding inert for the logits (position
+    ``true_len - 1`` never attends past itself), and the junk K/V it
+    leaves at positions ``>= true_len`` is never read: decode writes
+    position ``p`` in the same step that first attends it."""
     pos = cache["pos"]
     if not isinstance(pos, jax.core.Tracer) and int(pos) != 0:
         raise ValueError("prefill requires a fresh cache (pos == 0)")
@@ -747,9 +752,17 @@ def prefill(params: Dict, prompt, cache: Dict, cfg: TransformerConfig,
     if true_len is None:
         last = x[:, -1:]
         new_pos = pos + S0
-    else:
+    elif jnp.ndim(true_len) == 0:
         true_len = jnp.asarray(true_len, jnp.int32)
         last = lax.dynamic_slice_in_dim(x, true_len - 1, 1, axis=1)
+        new_pos = pos + true_len
+    else:
+        # Per-row lengths (batch-K multi-request prefill): row b's
+        # logits come from ITS position true_len[b] - 1, and pos
+        # becomes the (B,) vector of per-row counts.
+        true_len = jnp.asarray(true_len, jnp.int32)
+        last = jnp.take_along_axis(x, (true_len - 1)[:, None, None],
+                                   axis=1)
         new_pos = pos + true_len
     logits = _lm_head(last, params["ln_f"], params["head"], cfg)
     cache = {

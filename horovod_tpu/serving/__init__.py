@@ -2,12 +2,17 @@
 
 The paper's fusion-of-pending-work architecture applied to decoding:
 one compiled ``decode_step_slots`` executable hot over a fixed pool of
-cache slots, a bounded FCFS scheduler admitting requests into freed
-slots with zero recompilation, and a threaded stdlib-HTTP front —
-wrapped in a fault-tolerance layer (supervised tick restarts, a
-watchdog against hung ticks, typed failure propagation, cancellation,
-graceful drain) whose invariant is that every submitted request
-resolves in bounded time with tokens or a typed error.
+cache slots, a bounded FCFS scheduler admitting requests (one batched
+batch-K prefill per tick) into freed slots with zero recompilation,
+and a threaded stdlib-HTTP front — wrapped in a fault-tolerance layer
+(supervised tick restarts, a watchdog against hung ticks, typed
+failure propagation, cancellation, graceful drain) whose invariant is
+that every submitted request resolves in bounded time with tokens or
+a typed error.  The decode hot loop is a device/host pipeline
+(``EngineConfig.overlap``, default on): device-resident tokens feed
+tick N's output straight into tick N+1's dispatch while host
+bookkeeping runs one tick behind — token-identical to the synchronous
+path (docs/serving.md "Performance").
 
     from horovod_tpu import serving
     engine = serving.InferenceEngine(params, cfg,
@@ -20,6 +25,7 @@ from horovod_tpu.serving.cache import (
     SlotCache,
     init_slot_cache,
     insert_prefill,
+    insert_prefill_batch,
 )
 from horovod_tpu.serving.engine import (
     DEGRADED,
@@ -56,6 +62,7 @@ from horovod_tpu.serving.server import ServingServer
 
 __all__ = [
     "SlotCache", "init_slot_cache", "insert_prefill",
+    "insert_prefill_batch",
     "EngineConfig", "GenerationFuture", "InferenceEngine",
     "HEALTHY", "DEGRADED", "DRAINING", "FAILED",
     "FaultInjector", "FaultSpec", "InjectedFaultError",

@@ -64,6 +64,26 @@ def insert_prefill(cache: Dict, slot, prefilled: Dict) -> Dict:
     return {"k": k, "v": v, "pos": pos}
 
 
+def insert_prefill_batch(cache: Dict, slots, prefilled: Dict) -> Dict:
+    """Land a batch-K prefilled cache in K slots of a slot cache.
+
+    ``prefilled`` is the cache returned by a batch-K
+    :func:`~horovod_tpu.models.transformer.prefill` with a PER-ROW
+    ``true_len`` — ``k``/``v`` shaped ``(L, K, H_kv, T_pre, Dh)`` with
+    ``T_pre <= T`` and ``pos`` a ``(K,)`` vector of per-row counts.
+    Row ``i`` lands in slot ``slots[i]`` via one scatter per tensor;
+    ``slots`` may be traced, so a jitted wrapper compiles once per
+    ``(K, T_pre)`` shape and serves every slot assignment."""
+    slots = jnp.asarray(slots, jnp.int32)
+    t_pre = prefilled["k"].shape[3]
+    k = cache["k"].at[:, slots, :, :t_pre, :].set(
+        prefilled["k"].astype(cache["k"].dtype))
+    v = cache["v"].at[:, slots, :, :t_pre, :].set(
+        prefilled["v"].astype(cache["v"].dtype))
+    pos = cache["pos"].at[slots].set(prefilled["pos"].astype(jnp.int32))
+    return {"k": k, "v": v, "pos": pos}
+
+
 class SlotCache:
     """Host-side slot allocator wrapped around one device slot cache.
 
@@ -84,8 +104,11 @@ class SlotCache:
         self._free: List[int] = list(range(n_slots))
         # One compiled insert per prefill bucket shape (slot is traced);
         # the slot cache is donated — insert replaces it in place instead
-        # of holding two full copies live.
+        # of holding two full copies live.  The batch variant compiles
+        # per (K, bucket) shape — the engine's batched admission path.
         self._insert = jax.jit(insert_prefill, donate_argnums=(0,))
+        self._insert_batch = jax.jit(insert_prefill_batch,
+                                     donate_argnums=(0,))
 
     # -- allocation ---------------------------------------------------------
 
@@ -139,3 +162,15 @@ class SlotCache:
         if not self._active[slot]:
             raise ValueError(f"slot {slot} is not allocated")
         self.cache = self._insert(self.cache, slot, prefilled)
+
+    def insert_batch(self, slots, prefilled: Dict) -> None:
+        """Write a batch-K prefilled cache (per-row ``true_len``
+        prefill) into K allocated slots — row ``i`` lands in
+        ``slots[i]`` — and adopt the per-row positions.  ONE device
+        scatter for the whole admission group instead of K serial
+        inserts."""
+        for s in slots:
+            if not self._active[s]:
+                raise ValueError(f"slot {s} is not allocated")
+        self.cache = self._insert_batch(
+            self.cache, np.asarray(slots, np.int32), prefilled)

@@ -12,14 +12,30 @@ Tick loop (:meth:`InferenceEngine.step`):
 
 1. **Admit**: drain up to K requests from the scheduler into free slots
    (K = ``max_prefills_per_tick`` bounds the decode stall, so TTFT and
-   tok/s are both bounded).  Each admission is a batch-1 prefill padded
-   to a power-of-two bucket (one compile per bucket, reused across
-   lengths), whose last-real-position logits yield the request's FIRST
-   token immediately.
+   tok/s are both bounded).  The whole group is admitted by ONE
+   bucketed batch-K prefill (prompts right-padded to a shared
+   power-of-two bucket, per-row ``true_len``; compile set bounded by
+   buckets x K), whose last-real-position logits yield each request's
+   FIRST token immediately.
 2. **Decode**: one masked ``decode_step_slots`` over all S slots;
    inactive slots compute on zeros (Join-style).  Each active slot's
    next greedy token streams to its future; EOS / max-token / capacity
    retirement frees the slot for the next admission.
+
+With ``EngineConfig.overlap`` (the default) the decode half runs as a
+TWO-STAGE PIPELINE — the paper's latency-hiding move (overlap the
+expensive device work with the host work that feeds it) applied to the
+token loop.  ``tokens``/``active`` live on the device: tick N's output
+token vector feeds tick N+1's dispatch directly (JAX async dispatch —
+no host round-trip, no re-upload), and the host-side fetch + emission +
+retirement bookkeeping for tick N runs while the device is already
+computing tick N+1.  Retirement therefore lands with ONE TICK of lag;
+a per-dispatch identity snapshot keeps the lag invisible (a slot's
+token is emitted only if the slot still holds the request it was
+computing for — no token after EOS, no stale row leaking into a
+reused slot; see :meth:`_retire_pending`), so greedy output stays
+token-identical to the synchronous path (``overlap=False``, the A/B
+baseline one flag away) and to per-request ``greedy_decode``.
 
 Greedy decoding is deliberate: it makes the engine's output
 TOKEN-IDENTICAL to per-request ``greedy_decode`` (the correctness oracle
@@ -204,9 +220,17 @@ class EngineConfig:
     ``n_slots`` (S) is the decode batch the executable is compiled for;
     ``max_len`` caps prompt + generation per slot (0 = cfg.max_seq);
     ``max_prefills_per_tick`` (K) bounds admissions between decode
-    ticks; ``max_queue_depth`` bounds the burst the scheduler absorbs;
-    ``min_prefill_bucket`` floors the power-of-two prompt buckets so
-    tiny prompts share one compile.
+    ticks AND sizes the batched prefill that admits them (one batch-K
+    prefill per tick, compile set buckets x K); ``max_queue_depth``
+    bounds the burst the scheduler absorbs; ``min_prefill_bucket``
+    floors the power-of-two prompt buckets so tiny prompts share one
+    compile.
+
+    ``overlap`` (default on) runs the decode loop as the two-stage
+    device/host pipeline (device-resident tokens, one-tick-lag
+    retirement — module docstring); ``overlap=False`` is the
+    synchronous A/B baseline: fetch-and-apply in the same step, same
+    tokens, ~the device wait slower per tick.
 
     Fault tolerance: ``max_restarts`` bounds CONSECUTIVE supervised
     restarts before the engine goes terminally ``failed`` (a clean tick
@@ -223,6 +247,7 @@ class EngineConfig:
     n_slots: int = 4
     max_len: int = 0
     max_prefills_per_tick: int = 2
+    overlap: bool = True
     max_queue_depth: int = 64
     default_max_new_tokens: int = 64
     min_prefill_bucket: int = 8
@@ -315,8 +340,25 @@ class InferenceEngine:
         # alive across the tick (2x the KV HBM — half the servable
         # slots) and copies the whole cache every token.
         self._tick_fn = jax.jit(_tick, donate_argnums=(3,))
-        self._prefill_fns: Dict[int, Callable] = {}
+        self._prefill_fns: Dict[tuple, Callable] = {}
         self._prefill_traces = 0
+
+        # Overlapped-pipeline state (engine_cfg.overlap).  _pending is
+        # the ONE in-flight decode tick: its un-fetched device outputs
+        # plus a host snapshot of which request each slot was computing
+        # for at dispatch (the identity check that makes one-tick-lag
+        # retirement safe).  _dev_tokens is the device-resident token
+        # vector — tick N's output feeds tick N+1's dispatch without a
+        # host round-trip — and _dev_active caches the device copy of
+        # the active mask, re-uploaded only when the host mask changes.
+        self._pending: Optional[Dict] = None
+        self._dev_tokens = None
+        self._dev_active = None
+        self._dev_active_host: Optional[np.ndarray] = None
+        # where(mask, vals, toks): lands freshly admitted slots' first
+        # tokens in the device token vector (one tiny async op).
+        self._merge_tokens = jax.jit(
+            lambda toks, vals, mask: jnp.where(mask, vals, toks))
 
     # -- lifecycle / health ------------------------------------------------
 
@@ -448,7 +490,10 @@ class InferenceEngine:
             with self._lock:
                 worked = self._reclaim_cancelled()
                 worked = self._admit_pending() or worked
-                worked = self._decode_tick() or worked
+                if self.engine_cfg.overlap:
+                    worked = self._decode_tick_overlapped() or worked
+                else:
+                    worked = self._decode_tick() or worked
                 self.metrics.queue_depth.set(self.scheduler.depth)
                 self.metrics.slot_occupancy.set(self.slots.occupancy)
         except Exception as exc:  # supervised: ANY tick failure recovers
@@ -503,8 +548,11 @@ class InferenceEngine:
         return worked
 
     def _admit_pending(self) -> bool:
-        reqs = self.scheduler.take(self.slots.free_count)
+        reqs = self.scheduler.take(
+            self.slots.free_count,
+            bucket_fn=lambda r: self._bucket(len(r.prompt)))
         self._taken = list(reqs)
+        live: List[Request] = []
         for req in reqs:
             if req.future.done():  # resolved while taken (raced drain)
                 self._taken.remove(req)
@@ -514,24 +562,23 @@ class InferenceEngine:
                 self.metrics.cancelled.inc()
                 self._taken.remove(req)
                 continue
-            slot = self.slots.alloc()
-            assert slot is not None  # take() is bounded by free_count
-            self._admit(slot, req)
-            self._taken.remove(req)  # landed: _states[slot] owns it now
+            live.append(req)
+        if live:
+            self._admit_batch(live)
         self._taken = []
         return bool(reqs)
 
-    def _prefill_fn(self, bucket: int) -> Callable:
-        fn = self._prefill_fns.get(bucket)
+    def _prefill_fn(self, bucket: int, k: int) -> Callable:
+        fn = self._prefill_fns.get((bucket, k))
         if fn is None:
-            def _prefill(params, padded, true_len):
+            def _prefill(params, padded, true_lens):
                 self._prefill_traces += 1
-                cache = T.init_cache(self.cfg, 1, bucket)
+                cache = T.init_cache(self.cfg, k, bucket)
                 return T.prefill(params, padded, cache, self.cfg,
-                                 true_len=true_len)
+                                 true_len=true_lens)
 
             fn = jax.jit(_prefill)
-            self._prefill_fns[bucket] = fn
+            self._prefill_fns[(bucket, k)] = fn
         return fn
 
     def _bucket(self, n: int) -> int:
@@ -540,29 +587,55 @@ class InferenceEngine:
             b *= 2
         return min(b, self.slots.max_len)
 
-    def _admit(self, slot: int, req: Request) -> None:
-        """Batch-1 bucketed prefill -> insert into the slot -> emit the
-        request's first token (prefill logits ARE the first greedy
-        step)."""
+    def _admit_batch(self, reqs: List[Request]) -> None:
+        """ONE bucketed batch-K prefill admits the whole group (the
+        burst-TTFT lever: K prompts cost one forward pass, not K) ->
+        one insert scatter lands all K in their slots -> one host
+        fetch yields the K first tokens (prefill logits ARE the first
+        greedy step).  The scheduler's bucket-uniform take keeps the
+        group on one bucket, so the compile set is buckets x K."""
         faults = self.engine_cfg.faults
         if faults is not None:
             faults.probe("prefill")
-        s0 = len(req.prompt)
-        bucket = self._bucket(s0)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :s0] = req.prompt
-        logits, pre_cache = self._prefill_fn(bucket)(
-            self.params, jnp.asarray(padded), s0)
-        self.slots.insert(slot, pre_cache)
-        first = int(np.asarray(jnp.argmax(logits[0])))
+        k = len(reqs)
+        bucket = max(self._bucket(len(r.prompt)) for r in reqs)
+        padded = np.zeros((k, bucket), np.int32)
+        lens = np.zeros((k,), np.int32)
+        for i, req in enumerate(reqs):
+            padded[i, :len(req.prompt)] = req.prompt
+            lens[i] = len(req.prompt)
+        logits, pre_cache = self._prefill_fn(bucket, k)(
+            self.params, jnp.asarray(padded), jnp.asarray(lens))
+        slots: List[int] = []
+        for _ in reqs:
+            slot = self.slots.alloc()
+            assert slot is not None  # take() is bounded by free_count
+            slots.append(slot)
+        self.slots.insert_batch(slots, pre_cache)
+        firsts = np.asarray(jnp.argmax(logits, axis=-1))  # one sync for K
+        self.metrics.host_syncs.inc()
         now = time.monotonic()
-        ttft = now - req.submitted_at
-        req.future.ttft = ttft
-        self.metrics.ttft.observe(ttft)
-        self.metrics.admitted.inc()
-        self._states[slot] = _SlotState(request=req, last_token=first,
-                                        n_generated=0)
-        self._emit(slot, first)
+        for slot, req, first in zip(slots, reqs, firsts):
+            ttft = now - req.submitted_at
+            req.future.ttft = ttft
+            self.metrics.ttft.observe(ttft)
+            self.metrics.admitted.inc()
+            self._states[slot] = _SlotState(request=req,
+                                            last_token=int(first),
+                                            n_generated=0)
+            self._emit(slot, int(first))
+            self._taken.remove(req)  # landed: _states[slot] owns it now
+        if self._dev_tokens is not None:
+            # Land the first tokens in the device-resident token vector
+            # (a slot retired by its own first token — EOS at admission
+            # — is inactive in the mask; its value is a don't-care).
+            vals = np.zeros(self.engine_cfg.n_slots, np.int32)
+            mask = np.zeros(self.engine_cfg.n_slots, bool)
+            for slot, first in zip(slots, firsts):
+                vals[slot] = int(first)
+                mask[slot] = True
+            self._dev_tokens = self._merge_tokens(
+                self._dev_tokens, jnp.asarray(vals), jnp.asarray(mask))
 
     def _emit(self, slot: int, tok: int) -> None:
         """Stream one token to the slot's future; retire on EOS,
@@ -606,6 +679,10 @@ class InferenceEngine:
             self.slots.free(slot)
 
     def _decode_tick(self) -> bool:
+        """The SYNCHRONOUS decode tick (``overlap=False``, the A/B
+        baseline): upload tokens + mask, dispatch, fetch, and apply the
+        bookkeeping all in the same step — the device idles through the
+        host half, which is exactly what the pipeline hides."""
         active = self.slots.active_mask()
         if not active.any():
             return False
@@ -619,19 +696,110 @@ class InferenceEngine:
         nxt, mx, self.slots.cache = self._tick_fn(
             self.params, jnp.asarray(tokens), jnp.asarray(active),
             self.slots.cache)
-        nxt = np.asarray(nxt)  # fetch = sync: the tick really finished
-        mx = np.asarray(mx)
-        if kind == "nonfinite":  # injected: NaN logits from the device
+        self.metrics.decode_ticks.inc()
+        self.metrics.tick_dispatch.observe(time.monotonic() - t0)
+        # Same fetch-and-apply tail as the pipeline, just not deferred.
+        self._retire_pending({
+            "nxt": nxt, "mx": mx, "active": active,
+            "reqs": [st.request if st is not None else None
+                     for st in self._states],
+            "kind": kind, "dispatched_at": t0,
+        })
+        return True
+
+    def _decode_tick_overlapped(self) -> bool:
+        """One PIPELINED decode step (``overlap=True``): dispatch tick
+        N+1 FIRST — its token input is tick N's device-resident output,
+        so no host value gates the dispatch — then fetch and apply tick
+        N's results while the device is already computing N+1.  Host
+        bookkeeping runs one tick behind the device; the identity
+        snapshot in ``_pending`` keeps the lag safe
+        (:meth:`_retire_pending`)."""
+        worked = False
+        faults = self.engine_cfg.faults
+        active = self.slots.active_mask()
+        new_pending: Optional[Dict] = None
+        if active.any():
+            kind = (faults.probe("decode_tick")
+                    if faults is not None else None)
+            t0 = time.monotonic()
+            if self._dev_tokens is None:
+                # Pipeline (re)start: seed the device token vector from
+                # host slot state.  After this the ONLY recurring
+                # upload is the active mask, and only when it changes.
+                tokens = np.zeros(self.engine_cfg.n_slots, np.int32)
+                for s, st in enumerate(self._states):
+                    if st is not None:
+                        tokens[s] = st.last_token
+                self._dev_tokens = jnp.asarray(tokens)
+            if (self._dev_active_host is None
+                    or not np.array_equal(active, self._dev_active_host)):
+                self._dev_active = jnp.asarray(active)
+                self._dev_active_host = active
+            nxt, mx, self.slots.cache = self._tick_fn(
+                self.params, self._dev_tokens, self._dev_active,
+                self.slots.cache)
+            self._dev_tokens = nxt  # tick N+2's input — never fetched
+            self.metrics.decode_ticks.inc()
+            self.metrics.tick_dispatch.observe(time.monotonic() - t0)
+            new_pending = {
+                "nxt": nxt, "mx": mx, "active": active,
+                "reqs": [st.request if st is not None else None
+                         for st in self._states],
+                "kind": kind, "dispatched_at": t0,
+            }
+            worked = True
+        prev, self._pending = self._pending, new_pending
+        if prev is not None:
+            self._retire_pending(prev)
+            worked = True
+        return worked
+
+    def _retire_pending(self, p: Dict) -> None:
+        """Fetch a dispatched tick's results — THE one host sync of a
+        steady-state step — and apply its bookkeeping.  The ONE copy of
+        the nonfinite check and the emission rules, shared by the
+        synchronous tick (applied immediately) and the overlapped
+        pipeline (applied one tick late), so the two paths cannot
+        diverge.
+
+        Why the pipeline's lag preserves the greedy oracle: a slot's
+        token is emitted only if the slot still holds the request it
+        was computing for at dispatch time (the ``reqs`` identity
+        snapshot).  A slot retired by EOS/length/deadline, cancelled,
+        or re-admitted between dispatch and fetch fails that check and
+        its stale row is DROPPED — so no token is ever emitted after
+        EOS, and a freed slot can never leak a token into its next
+        tenant.  The stale row's device write is harmless by the same
+        write-before-attend argument as bucketed prefill padding
+        (``decode_step_slots``).  (In the synchronous path the snapshot
+        always matches — nothing can retire a slot between dispatch and
+        this call within one locked step.)"""
+        faults = self.engine_cfg.faults
+        if faults is not None:
+            faults.probe("decode_fetch")
+        t0 = time.monotonic()
+        nxt = np.asarray(p["nxt"])
+        mx = np.asarray(p["mx"])
+        self.metrics.host_syncs.inc()
+        t1 = time.monotonic()
+        self.metrics.tick_device_wait.observe(t1 - t0)
+        active = p["active"]
+        if p["kind"] == "nonfinite":  # injected: NaN logits
             mx = np.where(active, np.nan, mx)
         if not np.isfinite(mx[active]).all():
             raise EngineFailedError(
                 "non-finite logits from decode tick (bad params or "
                 "device fault)")
-        dt = time.monotonic() - t0
+        lat = t1 - p["dispatched_at"]
         for s in np.nonzero(active)[0]:
-            self.metrics.token_latency.observe(dt)
-            self._emit(int(s), int(nxt[s]))
-        return True
+            s = int(s)
+            st = self._states[s]
+            if st is None or st.request is not p["reqs"][s]:
+                continue  # retired / re-admitted since dispatch: stale
+            self.metrics.token_latency.observe(lat)
+            self._emit(s, int(nxt[s]))
+        self.metrics.tick_host.observe(time.monotonic() - t1)
 
     # -- failure recovery --------------------------------------------------
 
@@ -649,6 +817,17 @@ class InferenceEngine:
         self._taken = []
         self._states = [None] * self.engine_cfg.n_slots
         self.slots.release_all()
+        self._reset_pipeline()
+
+    def _reset_pipeline(self) -> None:
+        """Drop the in-flight tick and the device-resident token state
+        (restart/terminal paths — the old device arrays belong to a
+        suspect cache lineage); the next dispatch reseeds from host
+        slot state."""
+        self._pending = None
+        self._dev_tokens = None
+        self._dev_active = None
+        self._dev_active_host = None
 
     def _fail_queue(self, exc: BaseException) -> None:
         for req in self.scheduler.drain_pending():
@@ -705,6 +884,7 @@ class InferenceEngine:
         self.slots = SlotCache(self.cfg, self.engine_cfg.n_slots,
                                self.engine_cfg.max_len)
         self._states = [None] * self.engine_cfg.n_slots
+        self._reset_pipeline()
         with self._hb_lock:
             self._epoch += 1
             self._stalled = False
@@ -787,6 +967,29 @@ class InferenceEngine:
             self._watchdog.join(timeout)
             self._watchdog = None
 
+    def warmup(self, prompt_lens: Sequence[int] = (1,)) -> None:
+        """Drive the engine SYNCHRONOUSLY until every compile the given
+        prompt lengths can demand exists: one prefill + cache-insert
+        executable per (bucket, admission-batch-k) shape for k up to
+        ``max_prefills_per_tick``, plus the decode tick (and, with
+        ``overlap``, the token-merge op).  Call before :meth:`start` so
+        first-request latency — and a tight watchdog ``tick_timeout`` —
+        never pays XLA compilation (docs/serving.md "Watchdog tuning").
+        The ONE definition of the warm sweep, shared by the chaos
+        suite and ``benchmarks/serving.py``, so warm coverage tracks
+        the engine's compile-set shape."""
+        kmax = min(self.engine_cfg.max_prefills_per_tick,
+                   self.engine_cfg.n_slots)
+        for n in prompt_lens:
+            prompt = [0] * max(int(n), 1)
+            for k in range(1, kmax + 1):
+                # max_new_tokens=2: the second token exercises the
+                # decode tick (the first comes from prefill logits).
+                futs = [self.submit(prompt, max_new_tokens=2)
+                        for _ in range(k)]
+                while not all(f.done() for f in futs):
+                    self.step()
+
     def drain(self, timeout: float = 60.0, poll: float = 0.002) -> bool:
         """Block until queue and slots are empty (True) or timeout.
         Synchronous callers (no background thread) should loop
@@ -854,7 +1057,10 @@ class InferenceEngine:
             "n_slots": self.engine_cfg.n_slots,
             "slots_active": self.slots.active_count,
             "max_len": self.slots.max_len,
+            "overlap": self.engine_cfg.overlap,
             "decode_compilations": self._decode_traces,
             "prefill_compilations": self._prefill_traces,
+            # (bucket, batch) shape pairs the prefill has compiled for
+            # — bounded by buckets x max_prefills_per_tick.
             "prefill_buckets": sorted(self._prefill_fns),
         }

@@ -12,13 +12,21 @@ probes that the engine calls at its failure-prone boundaries.
 
 Sites (``FaultInjector.SITES``):
 
-* ``"prefill"`` — probed in ``InferenceEngine._admit`` immediately
-  before the batch-1 prefill (a device fault during admission).
-* ``"decode_tick"`` — probed in ``InferenceEngine._decode_tick``
-  immediately before the compiled tick (a device fault mid-decode);
+* ``"prefill"`` — probed in ``InferenceEngine._admit_batch``
+  immediately before the batched prefill (a device fault during
+  admission).
+* ``"decode_tick"`` — probed in the engine's decode path immediately
+  before the compiled tick is DISPATCHED (a device fault mid-decode);
   the ``"nonfinite"`` kind corrupts the tick's per-slot max-logit
-  vector AFTER the tick instead, modeling NaN/Inf logits from bad
+  vector at its fetch instead, modeling NaN/Inf logits from bad
   params or flaky hardware.
+* ``"decode_fetch"`` — probed immediately before the engine fetches a
+  dispatched tick's results (``np.asarray`` of the device tokens).
+  With the overlapped pipeline this is the DEFERRED-fetch boundary —
+  the one host sync per steady-state tick, where an async device
+  failure from the PREVIOUS tick actually surfaces — so the chaos
+  suite can model a device that accepted the dispatch and then died
+  (raise) or wedged (hang) before delivering the value.
 * ``"watchdog"`` — probed at the top of ``InferenceEngine.step``; a
   ``"hang"`` here stalls the whole tick outside any device call,
   which is exactly what the watchdog thread exists to catch.
@@ -91,12 +99,23 @@ class FaultInjector:
     raises, the tenth hangs 0.5 s, everything else runs clean.
     """
 
-    SITES = ("prefill", "decode_tick", "watchdog")
+    SITES = ("prefill", "decode_tick", "decode_fetch", "watchdog")
     KINDS = ("raise", "hang", "nonfinite")
 
     def __init__(self, specs: Sequence[FaultSpec] = (), seed: int = 0):
-        self.specs: List[FaultSpec] = list(specs)
-        for spec in self.specs:
+        self.specs: List[FaultSpec] = []
+        self._rng = random.Random(seed)
+        self._visits: Dict[str, int] = {s: 0 for s in self.SITES}
+        #: every firing, in order: (site, kind, site-visit index)
+        self.fired: List[Tuple[str, str, int]] = []
+        self.add(*specs)
+
+    def add(self, *specs: FaultSpec) -> "FaultInjector":
+        """Validate and append specs — also usable MID-RUN, so a test
+        can warm an engine fault-free and then schedule a fault
+        relative to :meth:`visits` (``skip=inj.visits(site) + n``:
+        fire on the n-th visit from now)."""
+        for spec in specs:
             if spec.site not in self.SITES:
                 raise ValueError(
                     f"unknown fault site {spec.site!r}; expected one of "
@@ -105,10 +124,8 @@ class FaultInjector:
                 raise ValueError(
                     f"unknown fault kind {spec.kind!r}; expected one of "
                     f"{self.KINDS}")
-        self._rng = random.Random(seed)
-        self._visits: Dict[str, int] = {s: 0 for s in self.SITES}
-        #: every firing, in order: (site, kind, site-visit index)
-        self.fired: List[Tuple[str, str, int]] = []
+            self.specs.append(spec)
+        return self
 
     def visits(self, site: str) -> int:
         """How many times ``site`` has been probed so far."""

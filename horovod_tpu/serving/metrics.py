@@ -50,6 +50,13 @@ DEFAULT_LATENCY_BUCKETS = (
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
 
+# Tick-phase buckets extend down to 10us: an async dispatch (and a
+# fully-hidden device wait) is sub-millisecond, which the request-level
+# buckets above cannot resolve.
+TICK_PHASE_BUCKETS = (
+    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+) + DEFAULT_LATENCY_BUCKETS
+
 
 class Histogram:
     """Fixed-bucket histogram with an implicit +Inf overflow bucket."""
@@ -135,6 +142,23 @@ class ServingMetrics:
     * ``engine_failures`` / ``engine_restarts`` — fault-tolerance
       counters: every tick failure or watchdog stall, and every
       successful supervised restart (fresh slot cache).
+    * ``tick_dispatch`` / ``tick_device_wait`` / ``tick_host`` — the
+      pipeline phase timers: time to BUILD AND DISPATCH a decode tick
+      (async — returns before the device finishes), time BLOCKED
+      fetching a tick's results (the host-visible device wait; with the
+      overlapped loop this is the residual the pipeline could not
+      hide), and time in host bookkeeping (emit / retire / admission
+      accounting).  ``device_wait / (dispatch + device_wait + host)``
+      is the overlap-efficiency number ``benchmarks/serving.py``
+      reports — 1.0 means every host cycle was hidden behind device
+      compute.
+    * ``decode_ticks`` / ``host_syncs`` — dispatched decode ticks and
+      host sync points (value fetches that block on device work) on
+      the decode hot path.  Steady-state overlapped decode performs
+      exactly ONE sync per tick (the deferred fetch of the previous
+      tick); ``host_syncs_per_tick`` in the snapshot is the regression
+      guard against an accidental ``np.asarray`` /
+      ``block_until_ready`` creeping back onto the hot path.
     """
 
     def __init__(self) -> None:
@@ -149,8 +173,14 @@ class ServingMetrics:
         self.tokens_generated = Counter()
         self.engine_failures = Counter()
         self.engine_restarts = Counter()
+        self.tick_dispatch = Histogram(buckets=TICK_PHASE_BUCKETS)
+        self.tick_device_wait = Histogram(buckets=TICK_PHASE_BUCKETS)
+        self.tick_host = Histogram(buckets=TICK_PHASE_BUCKETS)
+        self.decode_ticks = Counter()
+        self.host_syncs = Counter()
 
     def snapshot(self) -> Dict:
+        ticks = self.decode_ticks.value
         return {
             "ttft_seconds": self.ttft.snapshot(),
             "token_latency_seconds": self.token_latency.snapshot(),
@@ -163,4 +193,11 @@ class ServingMetrics:
             "tokens_generated": self.tokens_generated.value,
             "engine_failures": self.engine_failures.value,
             "engine_restarts": self.engine_restarts.value,
+            "tick_dispatch_seconds": self.tick_dispatch.snapshot(),
+            "tick_device_wait_seconds": self.tick_device_wait.snapshot(),
+            "tick_host_seconds": self.tick_host.snapshot(),
+            "decode_ticks": ticks,
+            "host_syncs": self.host_syncs.value,
+            "host_syncs_per_tick":
+                round(self.host_syncs.value / ticks, 4) if ticks else None,
         }

@@ -142,7 +142,9 @@ class Scheduler:
 
     def take(self, free_slots: int,
              on_reject: Optional[Callable[[Request, ServingError], None]]
-             = None) -> List[Request]:
+             = None,
+             bucket_fn: Optional[Callable[[Request], int]] = None
+             ) -> List[Request]:
         """Up to ``min(max_prefills_per_tick, free_slots)`` admissible
         requests, FCFS.  Requests whose deadline lapsed — or whose
         future was cancelled — while queued are resolved in place
@@ -150,9 +152,18 @@ class Scheduler:
         reason ``"cancelled"``) without consuming a slot or a prefill
         budget entry, EVEN when the budget is zero: dead heads never
         block the queue.  Both the constructor's ``on_reject`` and the
-        per-call one (if given) are notified of rejections."""
+        per-call one (if given) are notified of rejections.
+
+        ``bucket_fn`` makes the batch UNIFORM: after the FCFS head is
+        taken, the take stops at the first queued request whose bucket
+        differs from the head's (it stays queued, still the head for
+        the next tick — FCFS order is never reordered).  The engine
+        uses this so one batched prefill serves the whole admission
+        group without padding short prompts to a long prompt's bucket,
+        and the compile set stays bounded by buckets x K."""
         budget = min(self.max_prefills_per_tick, free_slots)
         out: List[Request] = []
+        bucket: Optional[int] = None
         while True:
             with self._lock:
                 if not self._q:
@@ -183,6 +194,14 @@ class Scheduler:
                 with self._lock:
                     self._q.appendleft(req)  # still the FCFS head
                 break
+            if bucket_fn is not None:
+                b = bucket_fn(req)
+                if bucket is None:
+                    bucket = b
+                elif b != bucket:
+                    with self._lock:
+                        self._q.appendleft(req)  # next tick's FCFS head
+                    break
             out.append(req)
             budget -= 1
         return out

@@ -70,12 +70,12 @@ def _run_until_done(engine, futs, max_ticks=300):
 
 
 def _warm(engine, prompt_lens=(3,)):
-    """Compile every prefill bucket + the decode tick BEFORE arming the
-    watchdog: first-tick XLA compilation takes seconds on CPU and must
-    not read as a stall."""
-    futs = [engine.submit(list(range(1, n + 1)), max_new_tokens=2)
-            for n in prompt_lens]
-    _run_until_done(engine, futs)
+    """Compile every (prefill bucket, admission batch size) shape +
+    the decode tick BEFORE arming the watchdog: XLA compilation takes
+    seconds on CPU and must not read as a stall.  The sweep itself is
+    the engine's own :meth:`warmup` — one definition, so warm coverage
+    tracks the engine's compile-set shape."""
+    engine.warmup(prompt_lens)
 
 
 def _wait_for(pred, timeout=15.0, poll=0.01):
@@ -229,12 +229,16 @@ class TestWatchdog:
         return); when it does return, the supervised restart brings the
         engine back to oracle-exact output."""
         params, cfg = model
-        inj = serving.FaultInjector([
-            serving.FaultSpec(site="decode_tick", kind="hang",
-                              delay=1.2, skip=3)])
+        inj = serving.FaultInjector()
         engine = _engine(model, faults=inj, n_slots=2,
                          tick_timeout=0.3, watchdog_interval=0.02)
         _warm(engine)
+        # Scheduled RELATIVE to the post-warm visit count: the warm
+        # phase must stay fault-free, and the overlapped pipeline's
+        # tick count through warmup differs from the sync loop's.
+        inj.add(serving.FaultSpec(
+            site="decode_tick", kind="hang", delay=1.2,
+            skip=inj.visits("decode_tick") + 2))
         engine.start()
         try:
             t0 = time.monotonic()
@@ -266,11 +270,12 @@ class TestWatchdog:
         hang (its lock acquire is timed), and terminate() still
         force-resolves every future in bounded time — teardown is
         bounded even when nothing else is."""
-        inj = serving.FaultInjector([
-            serving.FaultSpec(site="decode_tick", kind="hang",
-                              delay=1.5, skip=1)])
+        inj = serving.FaultInjector()
         engine = _engine(model, faults=inj, tick_timeout=0)
         _warm(engine)
+        inj.add(serving.FaultSpec(
+            site="decode_tick", kind="hang", delay=1.5,
+            skip=inj.visits("decode_tick") + 1))
         engine.start()
         try:
             fut = engine.submit([1, 2], max_new_tokens=10)
@@ -298,12 +303,13 @@ class TestWatchdog:
         """A stall overwrites DRAINING with FAILED; the recovery
         restart must restore DRAINING — never reopen a draining engine
         as DEGRADED behind a still-open listener."""
-        inj = serving.FaultInjector([
-            serving.FaultSpec(site="decode_tick", kind="hang",
-                              delay=0.8, skip=1)])
+        inj = serving.FaultInjector()
         engine = _engine(model, faults=inj, tick_timeout=0.2,
                          watchdog_interval=0.02)
         _warm(engine)
+        inj.add(serving.FaultSpec(
+            site="decode_tick", kind="hang", delay=0.8,
+            skip=inj.visits("decode_tick") + 1))
         engine.start()
         try:
             fut = engine.submit([1, 2], max_new_tokens=20)
@@ -337,6 +343,124 @@ class TestWatchdog:
                 with pytest.raises(serving.EngineStalledError):
                     f.result(timeout=10.0)
             assert _wait_for(lambda: engine.health == "healthy")
+        finally:
+            engine.stop()
+
+
+class TestDecodeFetchFaults:
+    """Faults at the overlapped pipeline's deferred-fetch boundary —
+    the one host sync per steady-state tick, where an async device
+    failure from the PREVIOUS tick actually surfaces.  The invariant
+    is unchanged: every submitted request resolves with tokens or a
+    typed error, and the engine recovers to oracle-exact output with
+    zero decode recompiles."""
+
+    def test_fetch_raise_fails_inflight_and_restarts(self, model):
+        params, cfg = model
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_fetch", kind="raise",
+                              skip=2)])
+        engine = _engine(model, faults=inj)
+        assert engine.engine_cfg.overlap  # the deferred-fetch path
+        futs = [engine.submit([3, 4, 5], max_new_tokens=8),
+                engine.submit([7, 8], max_new_tokens=8)]
+        _run_until_done(engine, futs)
+        for f in futs:
+            with pytest.raises(serving.EngineFailedError):
+                f.result(timeout=0)
+        assert inj.fired[0][0] == "decode_fetch"
+        s = engine.stats()
+        assert s["engine_failures"] == 1 and s["engine_restarts"] == 1
+        # recovery: fresh pipeline state, oracle-exact output
+        fut = engine.submit([3, 4, 5], max_new_tokens=8)
+        _run_until_done(engine, [fut])
+        assert fut.result(timeout=0) == _ref_greedy(params, cfg,
+                                                    [3, 4, 5], 8)
+        assert engine.decode_compilations == 1
+
+    def test_fetch_hang_trips_watchdog(self, model):
+        """A fetch that never returns (device wedged after accepting
+        the dispatch): the watchdog resolves in-flight AND queued
+        futures inside its budget, and the engine recovers when the
+        fetch finally lands."""
+        params, cfg = model
+        inj = serving.FaultInjector()
+        engine = _engine(model, faults=inj, n_slots=1,
+                         tick_timeout=0.25, watchdog_interval=0.02)
+        _warm(engine)
+        inj.add(serving.FaultSpec(
+            site="decode_fetch", kind="hang", delay=1.0,
+            skip=inj.visits("decode_fetch") + 1))
+        engine.start()
+        try:
+            t0 = time.monotonic()
+            f_run = engine.submit([11, 12], max_new_tokens=30)
+            f_queued = engine.submit([13], max_new_tokens=30)
+            for f in (f_run, f_queued):
+                with pytest.raises(serving.EngineStalledError):
+                    f.result(timeout=10.0)
+            assert time.monotonic() - t0 < 1.0  # before the hang ends
+            assert _wait_for(lambda: engine.health == "healthy")
+            fut = engine.submit([11, 12], max_new_tokens=5)
+            assert fut.result(timeout=10.0) == _ref_greedy(
+                params, cfg, [11, 12], 5)
+        finally:
+            engine.stop()
+
+    def test_invariant_under_mixed_fetch_faults(self, model):
+        """Chaos invariant at the new site with overlap on: raise and
+        hang at decode_fetch under load — 100% of requests resolve
+        with tokens or a typed error, and the engine ends healthy and
+        oracle-exact."""
+        params, cfg = model
+        inj = serving.FaultInjector(seed=3)
+        engine = _engine(model, faults=inj, n_slots=2, max_restarts=10,
+                         tick_timeout=0.3, watchdog_interval=0.02,
+                         max_queue_depth=32)
+        _warm(engine)
+        base = inj.visits("decode_fetch")
+        inj.add(
+            serving.FaultSpec(site="decode_fetch", kind="raise",
+                              skip=base + 3),
+            serving.FaultSpec(site="decode_fetch", kind="hang",
+                              delay=0.8, skip=base + 9),
+        )
+        engine.start()
+        rng = np.random.default_rng(7)
+        try:
+            futs = []
+            for i in range(10):
+                prompt = rng.integers(0, cfg.vocab_size,
+                                      2 + i % 3).tolist()
+                try:
+                    futs.append(engine.submit(prompt, max_new_tokens=10))
+                except serving.ServingError:
+                    pass
+            for f in futs:
+                try:
+                    f.result(timeout=30.0)
+                except serving.ServingError:
+                    pass  # typed = resolved; TimeoutError would fail
+            assert all(f.done() for f in futs)
+            burn = time.monotonic() + 20.0
+            while not inj.exhausted:
+                assert time.monotonic() < burn, "faults never exhausted"
+                if engine.health in ("healthy", "degraded"):
+                    try:
+                        f = engine.submit([1, 2], max_new_tokens=6)
+                        try:
+                            f.result(timeout=10.0)
+                        except serving.ServingError:
+                            pass
+                    except serving.ServingError:
+                        pass
+                else:
+                    time.sleep(0.05)
+            assert _wait_for(lambda: engine.health == "healthy")
+            fut = engine.submit([30, 31], max_new_tokens=8)
+            assert fut.result(timeout=15.0) == _ref_greedy(
+                params, cfg, [30, 31], 8)
+            assert engine.stats()["decode_compilations"] == 1
         finally:
             engine.stop()
 
@@ -395,18 +519,23 @@ class TestChaosInvariant:
         engine recovers, serves oracle-identical greedy output, and
         the restarts + health transitions are visible in stats."""
         params, cfg = model
-        inj = serving.FaultInjector([
-            serving.FaultSpec(site="prefill", kind="raise", skip=3),
-            serving.FaultSpec(site="decode_tick", kind="raise", skip=6),
-            serving.FaultSpec(site="decode_tick", kind="nonfinite",
-                              skip=11),
-            serving.FaultSpec(site="decode_tick", kind="hang",
-                              delay=0.8, skip=16),
-        ], seed=0)
+        inj = serving.FaultInjector(seed=0)
         engine = _engine(model, faults=inj, n_slots=4, max_restarts=10,
                          tick_timeout=0.3, watchdog_interval=0.02,
                          max_queue_depth=64)
-        _warm(engine, prompt_lens=(3, 7))  # both prefill buckets
+        _warm(engine, prompt_lens=(3, 7))  # both buckets, every k
+        # Faults scheduled RELATIVE to the post-warm visit counts so
+        # every spec fires under the load phase, not during warmup.
+        pre, dec = inj.visits("prefill"), inj.visits("decode_tick")
+        inj.add(
+            serving.FaultSpec(site="prefill", kind="raise", skip=pre + 1),
+            serving.FaultSpec(site="decode_tick", kind="raise",
+                              skip=dec + 4),
+            serving.FaultSpec(site="decode_tick", kind="nonfinite",
+                              skip=dec + 9),
+            serving.FaultSpec(site="decode_tick", kind="hang",
+                              delay=0.8, skip=dec + 14),
+        )
         engine.start()
         rng = np.random.default_rng(5)
         t0 = time.monotonic()
@@ -557,6 +686,7 @@ class TestServerFaultTolerance:
                               delay=0.03, max_fires=None)])
         engine = _engine(model, faults=inj, n_slots=4)
         _warm(engine)
+        warm_admitted = engine.metrics.admitted.value
         srv = self._serve(engine, request_timeout=60.0).start()
         host, port = srv.address
         base = f"http://{host}:{port}"
@@ -572,7 +702,9 @@ class TestServerFaultTolerance:
             t.start()
         # every client is IN the system (admitted or queued) before the
         # drain starts — none may be shed as 503 by a racing stop()
+        # (admissions counted relative to the warm-up's)
         assert _wait_for(lambda: engine.metrics.admitted.value
+                         - warm_admitted
                          + engine.scheduler.depth >= 6)
 
         t0 = time.monotonic()

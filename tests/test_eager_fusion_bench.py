@@ -11,6 +11,8 @@ import pytest
 
 from horovod_tpu import native
 
+pytestmark = pytest.mark.perf  # bench-shaped: drives a benchmarks/ script
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
