@@ -152,13 +152,87 @@ def _ab_decode(args, cfg, params):
     }
 
 
+def _ab_tracing(args, cfg, params):
+    """The tracing-overhead A/B (docs/observability.md): steady-state
+    decode tok/s with request tracing ENABLED vs DISABLED, identical
+    overlapped-pipeline workload, reps interleaved and compared at the
+    per-tick p25 exactly like :func:`_ab_decode`.  The disabled run IS
+    the instrumented engine with no tracer attached — the cost of the
+    hooks themselves (one global read per site) — so
+    ``tracing_overhead_ratio`` near 1.0 demonstrates the off-by-default
+    path is free, and the enabled ratio is the price of a full trace
+    (bounds guarded by the perf-marked test in tests/test_obs.py:
+    <=2% disabled, <=5% enabled)."""
+    import tempfile
+
+    from horovod_tpu import serving
+    from horovod_tpu.obs import tracing as obs_tracing
+
+    S = args.slots
+    prompt = np.random.default_rng(2).integers(
+        0, cfg.vocab_size, max(args.prompt_len // 2, 1)).tolist()
+
+    tracer = obs_tracing.get()
+    own_path = None
+    if tracer is None:
+        fd, own_path = tempfile.mkstemp(prefix="hvd_trace_ab_",
+                                        suffix=".json")
+        os.close(fd)
+        tracer = obs_tracing.start(own_path)
+    obs_tracing.deactivate()
+
+    engines = {}
+    try:
+        for name in ("notracing", "tracing"):
+            eng = serving.InferenceEngine(
+                params, cfg, serving.EngineConfig(
+                    n_slots=S, max_len=cfg.max_seq,
+                    max_prefills_per_tick=args.max_prefills_per_tick,
+                    max_queue_depth=max(2 * S, 8), overlap=True))
+            eng.warmup([len(prompt)])
+            engines[name] = (eng, [])
+
+        steps = max(min(max(args.steps, 24),
+                        cfg.max_seq - len(prompt) + 1), 1)
+        for _ in range(max(args.iters, 4)):
+            for name, (eng, dts) in engines.items():
+                obs_tracing.activate(tracer if name == "tracing" else None)
+                futs = [eng.submit(prompt, max_new_tokens=steps)
+                        for _ in range(S)]
+                while not all(f.done() for f in futs):
+                    full = eng.slots.active_count == S
+                    t0 = time.perf_counter()
+                    eng.step()
+                    dt = time.perf_counter() - t0
+                    if full and eng.slots.active_count == S:
+                        dts.append(dt)
+                obs_tracing.deactivate()
+    finally:
+        obs_tracing.activate(tracer)
+        if own_path is not None:
+            obs_tracing.stop()
+            os.unlink(own_path)
+
+    q = {name: float(np.percentile(dts, 25))
+         for name, (_, dts) in engines.items()}
+    return {
+        "decode_tok_s_tracing": round(S / q["tracing"], 2),
+        "decode_tok_s_notracing": round(S / q["notracing"], 2),
+        "tracing_overhead_ratio": round(q["tracing"] / q["notracing"], 4),
+    }
+
+
 def _engine_mode(args, T, cfg, params) -> None:
     """Open-loop continuous-batching benchmark: Poisson arrivals at
     ``--arrival-rate`` req/s with prompt lengths mixed over
     [prompt_len/2, prompt_len], against the engine's S-slot pool
     (overlapped pipeline — the production default), followed by the
-    steady-state overlap-vs-sync decode A/B (:func:`_ab_decode`) and
-    the static-batch closed-loop ceiling."""
+    steady-state overlap-vs-sync decode A/B (:func:`_ab_decode`), the
+    tracing-overhead A/B (:func:`_ab_tracing`), and the static-batch
+    closed-loop ceiling.  With ``--trace`` the open-loop run records a
+    Perfetto trace + JSONL request log, and the JSON line carries the
+    trace file path; the line always carries the full metrics-registry
+    snapshot so BENCH_r* runs double as observability fixtures."""
     rng = np.random.default_rng(0)
     lengths = rng.integers(max(args.prompt_len // 2, 1),
                            args.prompt_len + 1, args.n_requests)
@@ -167,9 +241,20 @@ def _engine_mode(args, T, cfg, params) -> None:
     arrival = np.cumsum(rng.exponential(1.0 / args.arrival_rate,
                                         args.n_requests))
 
+    tracer = None
+    if args.trace:
+        from horovod_tpu.obs import tracing as obs_tracing
+
+        tracer = obs_tracing.start(args.trace,
+                                   jsonl_path=args.trace + ".jsonl")
     over = _run_engine_once(args, cfg, params, prompts, arrival,
                             overlap=True)
+    if tracer is not None:
+        from horovod_tpu.obs import tracing as obs_tracing
+
+        obs_tracing.stop()
     ab = None if args.overlap_only else _ab_decode(args, cfg, params)
+    tab = None if args.overlap_only else _ab_tracing(args, cfg, params)
 
     engine, snap = over["engine"], over["snap"]
     ttft = snap["ttft_seconds"]
@@ -198,9 +283,18 @@ def _engine_mode(args, T, cfg, params) -> None:
             snap["tick_device_wait_seconds"]["mean"],
         "tick_host_mean_s": snap["tick_host_seconds"]["mean"],
         "chip": jax.devices()[0].device_kind,
+        # The full registry snapshot rides the JSON line so BENCH_r*
+        # artifacts carry the observability data (counters, gauges,
+        # and histogram populations) for the run that produced them.
+        "registry": engine.metrics.registry.snapshot(),
     }
+    if args.trace:
+        result["trace_file"] = args.trace
+        result["trace_jsonl"] = args.trace + ".jsonl"
     if ab is not None:
         result.update(ab)
+    if tab is not None:
+        result.update(tab)
 
     # Static-batch reference at B = n_slots: the closed-loop ceiling the
     # engine is measured against (same cfg, full batch decoding in
@@ -243,6 +337,10 @@ def _engine_mode(args, T, cfg, params) -> None:
         print(f"A/B      steady decode {ab['decode_tok_s_overlap']:9.1f} "
               f"tok/s overlapped vs {ab['decode_tok_s_sync']:9.1f} sync "
               f"-> {ab['overlap_decode_speedup']}x")
+    if tab is not None:
+        print(f"tracing  {tab['decode_tok_s_tracing']:9.1f} tok/s traced "
+              f"vs {tab['decode_tok_s_notracing']:9.1f} untraced -> "
+              f"{tab['tracing_overhead_ratio']}x per-tick")
     print(f"static   B={B} {result['static_batch_decode_tok_s']:9.1f} "
           f"tok/s (closed-loop ceiling)")
     print(json.dumps(result))
@@ -273,7 +371,12 @@ def main() -> None:
     ap.add_argument("--n-requests", type=int, default=32)
     ap.add_argument("--overlap-only", action="store_true",
                     help="engine mode: skip the synchronous-baseline "
-                         "run (no overlap A/B)")
+                         "run (no overlap A/B, no tracing A/B)")
+    ap.add_argument("--trace", default="",
+                    help="engine mode: record the open-loop run as a "
+                         "Perfetto/Chrome trace at this path (plus "
+                         "<path>.jsonl request log) and report the "
+                         "path in the JSON line")
     args = ap.parse_args()
 
     from horovod_tpu.models import transformer as T

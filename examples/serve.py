@@ -7,10 +7,20 @@ slot-based KV cache + FCFS scheduler + engine loop + stdlib-HTTP front
 batching at work.  Runs on any backend, including JAX_PLATFORMS=cpu.
 
 Run:  python examples/serve.py [--steps 30] [--port 8000] [--keep]
+      python examples/serve.py --trace /tmp/serve_trace.json --chaos
 
 With ``--keep`` the server stays up (curl it yourself):
     curl -s localhost:8000/generate -d '{"tokens": [3,4,5], "max_new_tokens": 8}'
     curl -s localhost:8000/stats
+    curl -s localhost:8000/metrics          # Prometheus text exposition
+
+``--trace PATH`` records ONE Perfetto/Chrome trace (open in
+https://ui.perfetto.dev) interleaving the training steps, every serving
+request's queue/prefill/decode spans (with trace ids), the engine
+tick-phase spans, and instant events for XLA compiles — plus a
+``PATH.jsonl`` structured request log.  ``--chaos`` injects one decode
+fault after the demo burst so the trace also shows a supervised engine
+restart (docs/observability.md).
 
 Shutdown is GRACEFUL: SIGTERM (what Kubernetes / systemd send) and
 Ctrl-C both trigger a drain — /healthz flips to 503 ``draining``, new
@@ -24,6 +34,8 @@ import argparse
 import json
 import signal
 import threading
+import time
+import urllib.error
 import urllib.request
 
 import jax
@@ -53,9 +65,14 @@ def train_toy_lm(steps: int):
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
+    from horovod_tpu import obs
+
     loss = None
     for _ in range(steps):
-        params, opt_state, loss = step(params, opt_state)
+        # Span + step-time histogram: with --trace the training steps
+        # land on the same Perfetto time axis as the serving requests.
+        with obs.training_step():
+            params, opt_state, loss = step(params, opt_state)
     print(f"trained {steps} steps, loss {float(loss):.3f}")
     return params, cfg
 
@@ -70,17 +87,28 @@ def main() -> None:
                     help="demo burst size")
     ap.add_argument("--keep", action="store_true",
                     help="keep serving after the demo burst")
+    ap.add_argument("--trace", default="",
+                    help="record a Perfetto/Chrome trace (training + "
+                         "serving on one time axis) at this path, plus "
+                         "a <path>.jsonl request log")
+    ap.add_argument("--chaos", action="store_true",
+                    help="inject one decode fault after the demo burst "
+                         "so the trace shows a supervised engine restart")
     args = ap.parse_args()
 
     import horovod_tpu as hvd
-    from horovod_tpu import serving
+    from horovod_tpu import obs, serving
 
     hvd.init()
+    if args.trace:
+        obs.tracing.start(args.trace, jsonl_path=args.trace + ".jsonl")
     params, cfg = train_toy_lm(args.steps)
 
+    inj = serving.FaultInjector() if args.chaos else None
     engine = serving.InferenceEngine(
         params, cfg,
-        serving.EngineConfig(n_slots=args.slots, max_len=cfg.max_seq),
+        serving.EngineConfig(n_slots=args.slots, max_len=cfg.max_seq,
+                             restart_backoff=0.05, faults=inj),
         detokenize=lambda t: f" {t}")
     # SIGTERM (k8s/systemd stop) -> graceful drain, same as Ctrl-C —
     # installed for the WHOLE serving lifetime, demo burst included:
@@ -127,6 +155,36 @@ def main() -> None:
           f"decode compiles {stats['decode_compilations']}, "
           f"TTFT p50 {stats['ttft_seconds']['p50']}s")
 
+    if args.chaos:
+        # One injected decode fault: the probe request fails typed
+        # (503 engine_failed, trace id intact), the engine restarts
+        # with a fresh cache, and the trace gains an engine_restart
+        # instant next to the request spans.
+        inj.add(serving.FaultSpec(
+            site="decode_tick", kind="raise",
+            skip=inj.visits("decode_tick") + 1))
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"tokens": [1, 2, 3],
+                             "max_new_tokens": 8}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Trace-Id": "chaos-demo"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                code, resp = r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            code, resp = e.code, json.loads(e.read())
+        print(f"chaos: injected decode fault -> HTTP {code} "
+              f"({resp.get('type')}, trace {resp.get('trace_id')})")
+        deadline = time.monotonic() + 30
+        while engine.health != "healthy" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        with urllib.request.urlopen(req, timeout=60) as r:
+            resp = json.loads(r.read())
+        print(f"chaos: recovered ->{resp['text']}  "
+              f"(engine restarts: "
+              f"{engine.metrics.engine_restarts.value})")
+
     if args.keep and not stop_requested.is_set():
         print("serving until SIGTERM / Ctrl-C ...")
         try:
@@ -136,6 +194,11 @@ def main() -> None:
     print("draining (in-flight requests run to completion) ...")
     srv.stop(drain_timeout=30.0)
     print(f"stopped; final engine state: {engine.health}")
+    if args.trace:
+        obs.tracing.stop()
+        print(f"trace written: {args.trace} (open in "
+              f"https://ui.perfetto.dev); request log: "
+              f"{args.trace}.jsonl")
     hvd.shutdown()
 
 

@@ -83,7 +83,7 @@ from horovod_tpu.state import (
     broadcast_parameters,
 )
 from horovod_tpu.join import join, masked_average
-from horovod_tpu import callbacks, data, elastic, spmd, parallel, timeline
+from horovod_tpu import callbacks, data, elastic, obs, spmd, parallel, timeline
 from horovod_tpu.data import DataLoader
 from horovod_tpu.timeline import start_timeline, stop_timeline
 

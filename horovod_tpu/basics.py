@@ -272,6 +272,7 @@ def init(
         from horovod_tpu.autotune import Autotuner
 
         _context.autotuner = Autotuner.from_env()
+    _register_process_metrics(_context)
     logger.debug(
         "horovod_tpu initialized: size=%d local_size=%d process=%d/%d",
         mesh.devices.size,
@@ -279,6 +280,33 @@ def init(
         _context.process_rank,
         _context.num_processes,
     )
+
+
+def _register_process_metrics(ctx: _Context) -> None:
+    """Seed the process-wide observability registry at init: topology
+    gauges plus the training and elastic metric FAMILIES (so a
+    ``/metrics`` scrape always exposes them, zero-valued until used —
+    probes should not have to special-case a cold process)."""
+    try:
+        from horovod_tpu.obs import registry as obs_registry
+
+        r = obs_registry.default_registry()
+        r.counter("horovod_inits_total",
+                  "horovod_tpu.init() calls (re-inits included)",
+                  exist_ok=True).inc()
+        r.gauge("horovod_world_size", "Total workers (TPU chips)",
+                exist_ok=True).set(ctx.mesh.devices.size)
+        r.gauge("horovod_local_size", "Workers on this host",
+                exist_ok=True).set(ctx.local_device_count)
+        r.gauge("horovod_num_processes", "Processes in the job",
+                exist_ok=True).set(ctx.num_processes)
+        obs_registry.training_metrics()
+        obs_registry.elastic_metrics()
+        from horovod_tpu import timeline as _timeline_mod
+
+        _timeline_mod._dropped_events_counter()
+    except Exception as e:  # pragma: no cover - metrics never gate init
+        logger.warning("observability registry unavailable: %s", e)
 
 
 def shutdown() -> None:
@@ -314,6 +342,16 @@ def reinit(
     env instead."""
     shutdown()
     init(devices=devices, axis_name=axis_name)
+    try:
+        from horovod_tpu.obs import tracing as obs_tracing
+        from horovod_tpu.obs.registry import elastic_metrics
+
+        elastic_metrics().rendezvous.inc()
+        obs_tracing.instant("elastic_rerendezvous", {
+            "epoch": os.environ.get("HOROVOD_ELASTIC_EPOCH"),
+            "size": size()})
+    except Exception:  # pragma: no cover - metrics never gate recovery
+        pass
 
 
 atexit.register(shutdown)

@@ -74,9 +74,25 @@ class EagerRuntime:
                            "reducescatter", "barrier")}
         # Fusion observability (reference timeline's per-response grouping,
         # as cheap counters): responses executed vs tensors they carried —
-        # tensors/responses is the achieved fusion ratio.
+        # tensors/responses is the achieved fusion ratio.  Mirrored into
+        # the process registry so /metrics scrapes see the eager path.
         self.responses_executed = 0
         self.tensors_executed = 0
+        try:
+            from horovod_tpu.obs.registry import default_registry
+
+            r = default_registry()
+            self._m_responses = r.counter(
+                "eager_responses_executed_total",
+                "Eager collective responses executed (post-fusion groups)",
+                exist_ok=True)
+            self._m_tensors = r.counter(
+                "eager_tensors_executed_total",
+                "Tensors carried by executed eager responses "
+                "(tensors/responses = achieved fusion ratio)",
+                exist_ok=True)
+        except Exception:  # pragma: no cover - metrics never gate eager ops
+            self._m_responses = self._m_tensors = None
         rt.set_executor(self._execute)
 
     # ---- naming (reference: "allreduce.noname.N" convention in the torch
@@ -154,6 +170,9 @@ class EagerRuntime:
         _, to_op = _op_maps()
         self.responses_executed += 1
         self.tensors_executed += len(resp.tensor_names)
+        if self._m_responses is not None:
+            self._m_responses.inc()
+            self._m_tensors.inc(len(resp.tensor_names))
         try:
             with self._lock:
                 inputs = []

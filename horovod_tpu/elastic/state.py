@@ -31,6 +31,23 @@ from horovod_tpu import basics
 from horovod_tpu import state as S
 from horovod_tpu.elastic.interrupts import HostsUpdatedInterrupt
 
+_metrics = None
+
+
+def _em():
+    """Lazy create-or-fetch of the elastic metric family (commit and
+    rollback run every step — resolve the registry once, never let it
+    gate training)."""
+    global _metrics
+    if _metrics is None:
+        try:
+            from horovod_tpu.obs.registry import elastic_metrics
+
+            _metrics = elastic_metrics()
+        except Exception:  # pragma: no cover
+            _metrics = False
+    return _metrics or None
+
 
 def _writable(v: Any) -> Any:
     """Re-own read-only numpy leaves.  Eager broadcasts hand back numpy
@@ -114,6 +131,9 @@ class State:
         with self._commit_lock:
             for k in self._keys:
                 setattr(self, k, _copy_value(self._saved[k]))
+        m = _em()
+        if m is not None:
+            m.rollbacks.inc()
 
     def commit(self, path: Optional[str] = None) -> None:
         """Mark the current state as committed: snapshot in memory, write a
@@ -126,6 +146,9 @@ class State:
         a driver-supervised RESPAWN, and only a durable commit survives a
         respawn — committing without ``path`` there is warned once."""
         self.save_snapshot()
+        m = _em()
+        if m is not None:
+            m.commits.inc()
         if path is not None:
             self.save(path)
         elif not self._warned_memory_only:

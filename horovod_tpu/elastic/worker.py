@@ -153,6 +153,25 @@ class WorkerNotificationManager:
 notification_manager = WorkerNotificationManager()
 
 
+def _record_elastic_event(name: str, args=None, *,
+                          count_restart: bool = True) -> None:
+    """Mark a worker-side elastic recovery event on the active
+    trace/timeline and, for FAILURE recoveries (``count_restart``),
+    count it in ``elastic_restarts_total`` — a planned commit-boundary
+    membership change is not a restart (it is already counted in
+    ``elastic_rendezvous_total`` by the re-init), and conflating the
+    two would fire failure alerts on routine scale events."""
+    try:
+        from horovod_tpu.obs import tracing as obs_tracing
+        from horovod_tpu.obs.registry import elastic_metrics
+
+        if count_restart:
+            elastic_metrics().restarts.inc()
+        obs_tracing.instant(name, args)
+    except Exception:  # pragma: no cover - metrics never gate recovery
+        pass
+
+
 def _exit_for_respawn() -> None:
     """Leave the process for a driver-supervised respawn: attempt a clean
     runtime teardown (closing the native control-plane sockets promptly
@@ -230,12 +249,16 @@ def run(train_fn):
                     logger.warning(
                         "elastic: hosts updated at commit boundary; "
                         "re-rendezvousing")
+                    _record_elastic_event("elastic_hosts_updated",
+                                          count_restart=False)
                 except (HorovodInternalError, CollectiveError) as e:
                     logger.warning(
                         "elastic: collective failed mid-step (%s); rolling "
                         "back to last commit", e)
                     state.rollback()
                     resets += 1
+                    _record_elastic_event("elastic_worker_rollback_retry",
+                                          {"resets": resets})
                     if reset_limit and resets > reset_limit:
                         raise
                 if not _rebuild_in_process():

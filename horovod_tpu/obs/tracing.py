@@ -1,0 +1,337 @@
+"""Per-request tracing: Dapper-style trace ids through the serving
+stack, exported onto the ONE process timeline.
+
+Horovod's flagship debugging tool was its timeline — per-tensor
+lifecycle events on one time axis (``native/src/timeline.{h,cc}``).
+This module extends that idea to the serving path: every request gets a
+**trace id** minted at ``ServingServer`` ingress (or accepted from an
+``X-Trace-Id`` header) and carried through ``Scheduler.Request`` →
+prefill admission → per-tick decode → retirement, so "where did request
+X spend its 900 ms" has an answer:
+
+* a :class:`RequestTrace` rides the request and is stamped at each
+  stage boundary; its :meth:`~RequestTrace.breakdown` (queue wait,
+  prefill, decode, host-sync lag) is returned in the ``/generate``
+  response and appended to a structured JSONL event log;
+* the :class:`Tracer` renders request spans, engine tick-phase spans,
+  and instant events (XLA compiles, engine restarts, watchdog stalls,
+  elastic re-rendezvous) through the existing
+  :class:`horovod_tpu.timeline.Timeline` writer thread — so ONE
+  Perfetto-loadable file interleaves training-step spans and serving
+  request spans on one time axis.
+
+Tracing is **off by default**.  When off, the per-request cost is one
+module-global read per hot-path site plus a 16-hex-char id mint at
+submit; timestamps for the breakdown are stamped regardless (a handful
+of ``time.monotonic()`` calls per request — the breakdown is part of
+the ``/generate`` response contract, tracing or not).  When on, each
+tick adds three queue puts (bounded, drop-on-full — the timeline's
+writer decoupling) and each request retirement one JSONL line.
+
+All timestamps are ``time.monotonic()`` seconds — the same clock the
+timeline uses (``monotonic_ns / 1e3`` microseconds), so serving spans
+land on the same axis as training spans.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import uuid
+from typing import Dict, Optional
+
+__all__ = [
+    "TRACE_ID_HEADER", "RequestTrace", "Tracer",
+    "mint_trace_id", "valid_trace_id",
+    "start", "stop", "get", "activate", "deactivate",
+    "instant", "record_compile",
+]
+
+TRACE_ID_HEADER = "X-Trace-Id"
+
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9._\-]{1,64}$")
+
+
+def mint_trace_id() -> str:
+    """A fresh 16-hex-char trace id."""
+    return uuid.uuid4().hex[:16]
+
+
+def valid_trace_id(s) -> bool:
+    """True if ``s`` is acceptable as a caller-supplied trace id
+    (1-64 chars of ``[A-Za-z0-9._-]``) — anything else is replaced with
+    a minted id rather than echoed into logs and trace files."""
+    return isinstance(s, str) and bool(_TRACE_ID_RE.match(s))
+
+
+class RequestTrace:
+    """Per-request timing record, stamped as the request moves through
+    the stack (all instants ``time.monotonic()`` seconds):
+
+    * ``submitted_at`` — scheduler enqueue (``Scheduler.submit``);
+    * ``admitted_at`` — taken from the queue into a prefill batch;
+    * ``first_token_at`` — prefill logits fetched (TTFT instant);
+    * ``finished_at`` — future resolved (tokens OR typed error);
+    * ``decode_ticks`` — decode ticks that emitted a token to this
+      request; ``host_sync_lag`` — dispatch→host-fetch latency of the
+      latest such tick (with the overlapped pipeline this is the
+      one-tick lag made visible);
+    * ``finish`` / ``error`` — finish_reason or exception type name.
+    """
+
+    __slots__ = ("trace_id", "submitted_at", "admitted_at",
+                 "first_token_at", "finished_at", "slot", "decode_ticks",
+                 "tokens", "host_sync_lag", "finish", "error")
+
+    def __init__(self, trace_id: Optional[str] = None):
+        self.trace_id = trace_id or mint_trace_id()
+        self.submitted_at: Optional[float] = None
+        self.admitted_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        self.slot: Optional[int] = None
+        self.decode_ticks: int = 0
+        self.tokens: int = 0
+        self.host_sync_lag: Optional[float] = None
+        self.finish: Optional[str] = None
+        self.error: Optional[str] = None
+
+    def breakdown(self, now: Optional[float] = None) -> Dict:
+        """The timing breakdown the ``/generate`` response carries.
+        Safe at any stage: missing stamps yield None fields, an
+        unfinished request is measured up to ``now``."""
+        end = self.finished_at
+        if end is None:
+            end = now if now is not None else time.monotonic()
+
+        def span(a, b):
+            return round(b - a, 6) if a is not None and b is not None \
+                else None
+
+        first_wait_end = self.admitted_at if self.admitted_at is not None \
+            else end
+        return {
+            "trace_id": self.trace_id,
+            "queue_wait_s": span(self.submitted_at, first_wait_end),
+            "prefill_s": span(self.admitted_at, self.first_token_at),
+            "decode_s": span(self.first_token_at, end),
+            "decode_ticks": self.decode_ticks,
+            "tokens": self.tokens,
+            "host_sync_lag_s": round(self.host_sync_lag, 6)
+            if self.host_sync_lag is not None else None,
+            "total_s": span(self.submitted_at, end),
+            "finish": self.finish if self.finish is not None else self.error,
+        }
+
+
+class Tracer:
+    """Render request spans, tick-phase spans, instants, and a JSONL
+    event log through a :class:`horovod_tpu.timeline.Timeline`.
+
+    Thread-safe: resolution can come from the engine thread, the
+    watchdog thread, or an HTTP handler — the timeline queue and a JSONL
+    lock serialize everything.  Perfetto layout: tick-phase spans on one
+    synthetic thread row, request spans on one row per cache slot (so
+    concurrent requests never overlap on a track)."""
+
+    TICK_TID = 90           # engine tick-phase row
+    QUEUE_TID = 199         # requests rejected/resolved before admission
+    SLOT_TID_BASE = 200     # + slot index
+    TICK_BATCH = 128        # tick-phase events buffered per queue put
+
+    def __init__(self, timeline, jsonl_path: Optional[str] = None):
+        self._tl = timeline
+        self._own_timeline = False
+        self._jsonl = open(jsonl_path, "a") if jsonl_path else None
+        self._jsonl_lock = threading.Lock()
+        self.jsonl_path = jsonl_path
+        self._named_tids = set()
+        self._tid_lock = threading.Lock()
+        # Tick-phase events are the hot emitter (3 per decode tick):
+        # buffer them locally and hand the timeline ONE batch per
+        # TICK_BATCH events — a per-event queue put wakes the writer
+        # thread every time, and those context switches (not the dict
+        # builds) are what would show up in steady-state decode tok/s.
+        self._tick_buf: list = []
+        self._tick_lock = threading.Lock()
+        self._name_tid(self.TICK_TID, "serving: engine ticks")
+        self._name_tid(self.QUEUE_TID, "serving: queue")
+
+    # -- timeline emission -------------------------------------------------
+
+    def _name_tid(self, tid: int, name: str) -> None:
+        with self._tid_lock:
+            if tid in self._named_tids:
+                return
+            self._named_tids.add(tid)
+        self._tl.thread_name(tid, name)
+
+    def instant(self, name: str, args: Optional[Dict] = None) -> None:
+        self._tl.instant(name, args)
+
+    def tick_phase(self, name: str, start_s: float, dur_s: float) -> None:
+        """One engine tick phase (dispatch / device wait / host) as a
+        complete span on the tick row.  Hot path: append one TUPLE —
+        event dicts are built (and the writer woken) only once per
+        TICK_BATCH at flush, so the steady-state decode loop pays
+        nanoseconds, not queue wakeups."""
+        with self._tick_lock:
+            self._tick_buf.append((name, start_s, dur_s))
+            if len(self._tick_buf) < self.TICK_BATCH:
+                return
+            batch, self._tick_buf = self._tick_buf, []
+        self._flush_ticks(batch)
+
+    def _flush_ticks(self, batch: list) -> None:
+        pid, tid = self._tl.pid, self.TICK_TID
+        self._tl.emit_batch([
+            {"name": name, "cat": "serving.tick", "ph": "X",
+             "ts": start_s * 1e6, "dur": max(dur_s, 0.0) * 1e6,
+             "pid": pid, "tid": tid}
+            for name, start_s, dur_s in batch])
+
+    def flush(self) -> None:
+        """Hand any buffered tick-phase events to the writer."""
+        with self._tick_lock:
+            batch, self._tick_buf = self._tick_buf, []
+        if batch:
+            self._flush_ticks(batch)
+
+    def request_done(self, tr: RequestTrace) -> None:
+        """A request resolved: emit its span (with nested
+        queue/prefill/decode phases) and append the JSONL record."""
+        b = tr.breakdown()
+        if tr.slot is not None:
+            tid = self.SLOT_TID_BASE + tr.slot
+            self._name_tid(tid, f"serving: slot {tr.slot}")
+        else:
+            tid = self.QUEUE_TID
+        start, end = tr.submitted_at, tr.finished_at
+        if start is not None and end is not None:
+            self._tl.complete(f"request {tr.trace_id}", start, end - start,
+                              category="serving.request", tid=tid, args=b)
+            for phase, a, z in (
+                    ("queue", tr.submitted_at, tr.admitted_at),
+                    ("prefill", tr.admitted_at, tr.first_token_at),
+                    ("decode", tr.first_token_at, tr.finished_at)):
+                if a is not None and z is not None and z >= a:
+                    self._tl.complete(phase, a, z - a,
+                                      category="serving.request", tid=tid)
+        self.log_event({"event": "request", "wall_time": time.time(), **b})
+
+    # -- structured log ----------------------------------------------------
+
+    def log_event(self, record: Dict) -> None:
+        if self._jsonl is None:
+            return
+        line = json.dumps(record)
+        with self._jsonl_lock:
+            self._jsonl.write(line + "\n")
+            self._jsonl.flush()
+
+    def close(self) -> None:
+        self.flush()
+        if self._jsonl is not None:
+            with self._jsonl_lock:
+                self._jsonl.close()
+                self._jsonl = None
+
+
+# -- module-global tracer lifecycle ------------------------------------------
+
+_tracer: Optional[Tracer] = None
+
+
+def start(path: Optional[str] = None,
+          jsonl_path: Optional[str] = None) -> Tracer:
+    """Start request tracing.  Attaches to the already-active process
+    timeline when there is one (``HOROVOD_TIMELINE`` /
+    ``start_timeline``) so serving and training share one trace file;
+    otherwise starts a timeline at ``path``."""
+    global _tracer
+    if _tracer is not None:
+        raise ValueError("tracing already started")
+    from horovod_tpu import timeline as TL
+
+    tl = TL.get()
+    own = False
+    if tl is None:
+        if not path:
+            raise ValueError(
+                "no active timeline to attach to; pass a trace path")
+        tl = TL.start_timeline(path)
+        own = True
+    t = Tracer(tl, jsonl_path=jsonl_path)
+    t._own_timeline = own
+    _tracer = t
+    return t
+
+
+def stop() -> None:
+    """Stop tracing; closes the timeline only if :func:`start` opened
+    it (an attached training timeline keeps recording)."""
+    global _tracer
+    t, _tracer = _tracer, None
+    if t is None:
+        return
+    t.close()
+    if t._own_timeline:
+        from horovod_tpu import timeline as TL
+
+        TL.stop_timeline()
+
+
+def get() -> Optional[Tracer]:
+    """The active tracer, or None (the hot-path check — one global
+    read)."""
+    return _tracer
+
+
+def activate(tracer: Optional[Tracer]) -> Optional[Tracer]:
+    """Swap the active tracer in/out without touching its files —
+    the A/B seam for overhead benchmarks and tests.  Returns the
+    previously active tracer."""
+    global _tracer
+    prev, _tracer = _tracer, tracer
+    return prev
+
+
+def deactivate() -> Optional[Tracer]:
+    """Detach the active tracer (returned) leaving its files open;
+    re-attach with :func:`activate`."""
+    return activate(None)
+
+
+# -- cross-cutting event helpers ---------------------------------------------
+
+def instant(name: str, args: Optional[Dict] = None) -> None:
+    """Emit an instant event onto whatever is recording: the active
+    tracer's timeline, else the process timeline, else nothing.  Used
+    by the engine (restarts, stalls) and the elastic layer
+    (re-rendezvous) so lifecycle landmarks land in the trace whichever
+    subsystem opened it."""
+    tp = _tracer
+    if tp is not None:
+        tp.instant(name, args)
+        return
+    from horovod_tpu import timeline as TL
+
+    tl = TL.get()
+    if tl is not None:
+        tl.instant(name, args)
+
+
+def record_compile(fn: str) -> None:
+    """Count an XLA trace/compile event (``xla_compiles_total{fn=...}``
+    in the default registry) and mark it as an instant on the active
+    trace.  Call from inside a traced-function body — it runs exactly
+    once per (re)compilation."""
+    try:
+        from horovod_tpu.obs.registry import training_metrics
+
+        training_metrics().compiles.labels(fn=fn).inc()
+    except Exception:  # pragma: no cover - registry must never break jit
+        pass
+    instant("xla_compile", {"fn": fn})

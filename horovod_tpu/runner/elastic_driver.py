@@ -186,6 +186,12 @@ class ElasticDriver:
 
     def _register_reset(self, culprits: Set[str], restart_requested: bool) -> None:
         self.resets += 1
+        try:
+            from horovod_tpu.obs.registry import elastic_metrics
+
+            elastic_metrics().restarts.inc()
+        except Exception:  # pragma: no cover - metrics never gate recovery
+            pass
         if self._reset_limit is not None and self.resets > self._reset_limit:
             raise ElasticJobError(
                 f"elastic job aborted: reset_limit={self._reset_limit} "
@@ -231,6 +237,18 @@ class ElasticDriver:
             "hosts": [s.hostname for s in specs],
         }).encode())
         self.epoch_sizes.append(len(slots))
+        try:
+            from horovod_tpu.obs import tracing as obs_tracing
+            from horovod_tpu.obs.registry import elastic_metrics
+
+            m = elastic_metrics()
+            m.rendezvous.inc()
+            m.rendezvous_epoch.set(self.epoch)
+            obs_tracing.instant("elastic_rendezvous", {
+                "epoch": self.epoch, "size": len(slots),
+                "hosts": [s.hostname for s in specs]})
+        except Exception:  # pragma: no cover - metrics never gate the epoch
+            pass
         logger.warning(
             "elastic: epoch %d starting with %d host(s): %s",
             self.epoch, len(specs), [s.hostname for s in specs])

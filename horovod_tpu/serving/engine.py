@@ -88,6 +88,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from horovod_tpu.models import transformer as T
+from horovod_tpu.obs import tracing as obs_tracing
 from horovod_tpu.serving.cache import SlotCache, init_slot_cache  # noqa: F401
 from horovod_tpu.serving.faults import FaultInjector
 from horovod_tpu.serving.metrics import ServingMetrics
@@ -137,6 +138,13 @@ class GenerationFuture:
         self._resolve_lock = threading.Lock()
         self.finish_reason: Optional[str] = None
         self.ttft: Optional[float] = None
+        # Observability: the request's trace record (stamped by the
+        # scheduler/engine as it moves through the stack) and the
+        # tracer active at submit time — resolution emits the request
+        # span + JSONL line through it, from WHICHEVER thread resolves
+        # (engine, watchdog, or HTTP handler).
+        self.trace: Optional["obs_tracing.RequestTrace"] = None
+        self._tracer: Optional["obs_tracing.Tracer"] = None
 
     # engine-side ----------------------------------------------------------
     # Resolution is serialized by _resolve_lock: the watchdog may fail
@@ -162,14 +170,35 @@ class GenerationFuture:
             if self._done.is_set():
                 return
             self.finish_reason = reason
+            if self.trace is not None:
+                self.trace.finished_at = time.monotonic()
+                self.trace.finish = reason
+                self.trace.tokens = len(self._tokens)
             self._done.set()
+        self._emit_trace()
 
     def set_exception(self, exc: BaseException) -> None:
         with self._resolve_lock:
             if self._done.is_set():
                 return
             self._exc = exc
+            if self.trace is not None:
+                self.trace.finished_at = time.monotonic()
+                self.trace.error = type(exc).__name__
+                self.trace.tokens = len(self._tokens)
             self._done.set()
+        self._emit_trace()
+
+    def _emit_trace(self) -> None:
+        # Outside _resolve_lock (file/queue IO must not serialize
+        # resolution); only the resolving thread reaches here, exactly
+        # once — the lock's done-check gates both resolution paths.
+        tp, tr = self._tracer, self.trace
+        if tp is not None and tr is not None:
+            try:
+                tp.request_done(tr)
+            except Exception:  # pragma: no cover - tracing must not fail work
+                pass
 
     # caller-side ----------------------------------------------------------
 
@@ -195,6 +224,16 @@ class GenerationFuture:
     @property
     def cancelled(self) -> bool:
         return self.finish_reason == "cancelled"
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return self.trace.trace_id if self.trace is not None else None
+
+    def breakdown(self) -> Optional[Dict]:
+        """The request's timing breakdown (queue wait, prefill, decode,
+        host-sync lag) — final once the future resolves, measured
+        up-to-now while it is still running."""
+        return self.trace.breakdown() if self.trace is not None else None
 
     def tokens_so_far(self) -> List[int]:
         return list(self._tokens)
@@ -304,6 +343,7 @@ class InferenceEngine:
         # _lock from the watchdog would deadlock recovery).
         self._hb_lock = threading.Lock()
         self._tick_started: Optional[float] = None
+        self._last_tick_done: Optional[float] = None  # /healthz heartbeat age
         self._epoch = 0          # bumped on every restart
         self._stalled = False    # set by the watchdog, cleared on recovery
         self._health = HEALTHY
@@ -326,6 +366,9 @@ class InferenceEngine:
 
         def _tick(params, tokens, active, cache):
             self._decode_traces += 1
+            # Runs once per (re)trace: this IS a compile event — count
+            # it and mark it on the active trace/timeline.
+            obs_tracing.record_compile("serving_decode")
             logits, cache = T.decode_step_slots(
                 params, tokens, cache, self.cfg, active)
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -373,6 +416,16 @@ class InferenceEngine:
         """The state-machine trail (capped), oldest first."""
         return list(self._transitions)
 
+    @property
+    def heartbeat_age(self) -> Optional[float]:
+        """Seconds since the last COMPLETED tick (None before the
+        first) — the liveness number ``/healthz`` reports so probes
+        can tell a quiet engine from a wedged one without parsing
+        ``/stats``."""
+        with self._hb_lock:
+            t = self._last_tick_done
+        return time.monotonic() - t if t is not None else None
+
     def _set_health(self, state: str) -> None:
         with self._health_lock:
             if self._health == state:
@@ -403,8 +456,15 @@ class InferenceEngine:
                max_new_tokens: Optional[int] = None,
                eos_id: Optional[int] = None,
                deadline: Optional[float] = None,
-               on_token: Optional[Callable] = None) -> GenerationFuture:
+               on_token: Optional[Callable] = None,
+               trace_id: Optional[str] = None) -> GenerationFuture:
         """Queue a generation request; returns its future.
+
+        ``trace_id`` propagates a caller-supplied id (the server passes
+        the ``X-Trace-Id`` header) into the request's
+        :class:`~horovod_tpu.obs.tracing.RequestTrace`; a fresh id is
+        minted when absent, so :attr:`GenerationFuture.trace_id` and
+        :meth:`GenerationFuture.breakdown` are always available.
 
         Typed rejections: :class:`RequestTooLongError` (prompt +
         max_new_tokens cannot fit a cache slot — raised immediately),
@@ -443,8 +503,10 @@ class InferenceEngine:
                 f"exceeds slot capacity ({cap})")
         fut = GenerationFuture(on_token=on_token,
                                detokenize=self.detokenize)
+        fut.trace = obs_tracing.RequestTrace(trace_id)
+        fut._tracer = obs_tracing.get()
         req = Request(prompt=prompt, max_new_tokens=n_new, future=fut,
-                      eos_id=eos_id, deadline=deadline)
+                      eos_id=eos_id, deadline=deadline, trace=fut.trace)
         self.scheduler.submit(req)  # QueueFullError counts via on_reject
         # Post-enqueue re-checks close the submit-vs-shutdown races:
         # the pre-checks above can pass just before a terminal failure
@@ -506,6 +568,7 @@ class InferenceEngine:
             return True
         with self._hb_lock:
             self._tick_started = None
+            self._last_tick_done = time.monotonic()
             stalled = self._stalled
         if stalled:
             # The watchdog declared us dead mid-tick but the tick DID
@@ -573,6 +636,7 @@ class InferenceEngine:
         if fn is None:
             def _prefill(params, padded, true_lens):
                 self._prefill_traces += 1
+                obs_tracing.record_compile("serving_prefill")
                 cache = T.init_cache(self.cfg, k, bucket)
                 return T.prefill(params, padded, cache, self.cfg,
                                  true_len=true_lens)
@@ -597,6 +661,10 @@ class InferenceEngine:
         faults = self.engine_cfg.faults
         if faults is not None:
             faults.probe("prefill")
+        t_adm = time.monotonic()
+        for req in reqs:
+            if req.trace is not None:
+                req.trace.admitted_at = t_adm  # queue-wait ends here
         k = len(reqs)
         bucket = max(self._bucket(len(r.prompt)) for r in reqs)
         padded = np.zeros((k, bucket), np.int32)
@@ -618,6 +686,9 @@ class InferenceEngine:
         for slot, req, first in zip(slots, reqs, firsts):
             ttft = now - req.submitted_at
             req.future.ttft = ttft
+            if req.trace is not None:
+                req.trace.slot = slot
+                req.trace.first_token_at = now
             self.metrics.ttft.observe(ttft)
             self.metrics.admitted.inc()
             self._states[slot] = _SlotState(request=req,
@@ -697,7 +768,11 @@ class InferenceEngine:
             self.params, jnp.asarray(tokens), jnp.asarray(active),
             self.slots.cache)
         self.metrics.decode_ticks.inc()
-        self.metrics.tick_dispatch.observe(time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self.metrics.tick_dispatch.observe(dt)
+        tp = obs_tracing.get()
+        if tp is not None:
+            tp.tick_phase("tick_dispatch", t0, dt)
         # Same fetch-and-apply tail as the pipeline, just not deferred.
         self._retire_pending({
             "nxt": nxt, "mx": mx, "active": active,
@@ -741,7 +816,11 @@ class InferenceEngine:
                 self.slots.cache)
             self._dev_tokens = nxt  # tick N+2's input — never fetched
             self.metrics.decode_ticks.inc()
-            self.metrics.tick_dispatch.observe(time.monotonic() - t0)
+            dt = time.monotonic() - t0
+            self.metrics.tick_dispatch.observe(dt)
+            tp = obs_tracing.get()
+            if tp is not None:
+                tp.tick_phase("tick_dispatch", t0, dt)
             new_pending = {
                 "nxt": nxt, "mx": mx, "active": active,
                 "reqs": [st.request if st is not None else None
@@ -798,8 +877,20 @@ class InferenceEngine:
             if st is None or st.request is not p["reqs"][s]:
                 continue  # retired / re-admitted since dispatch: stale
             self.metrics.token_latency.observe(lat)
+            tr = st.request.trace
+            if tr is not None:
+                tr.decode_ticks += 1
+                # dispatch-to-fetch latency of the tick that produced
+                # this token: with the overlapped pipeline this is the
+                # one-tick lag made visible in the breakdown.
+                tr.host_sync_lag = lat
             self._emit(s, int(nxt[s]))
-        self.metrics.tick_host.observe(time.monotonic() - t1)
+        t2 = time.monotonic()
+        self.metrics.tick_host.observe(t2 - t1)
+        tp = obs_tracing.get()
+        if tp is not None:
+            tp.tick_phase("tick_device_wait", t0, t1 - t0)
+            tp.tick_phase("tick_host", t1, t2 - t1)
 
     # -- failure recovery --------------------------------------------------
 
@@ -854,6 +945,9 @@ class InferenceEngine:
                     or attempt > self.engine_cfg.max_restarts):
                 self._terminal = True
                 self._set_health(FAILED)
+                obs_tracing.instant("engine_failed", {
+                    "consecutive_failures": attempt,
+                    "max_restarts": self.engine_cfg.max_restarts})
                 self._fail_queue(exc)
                 self.metrics.queue_depth.set(0)
                 self.metrics.slot_occupancy.set(0.0)
@@ -889,6 +983,9 @@ class InferenceEngine:
             self._epoch += 1
             self._stalled = False
         self.metrics.engine_restarts.inc()
+        obs_tracing.instant("engine_restart", {
+            "epoch": self._epoch,
+            "restarts": self.metrics.engine_restarts.value})
         self._set_health(DRAINING if self._draining else DEGRADED)
 
     # -- watchdog ----------------------------------------------------------
@@ -923,6 +1020,10 @@ class InferenceEngine:
             f"engine stalled: tick exceeded the "
             f"{self.engine_cfg.tick_timeout}s watchdog budget")
         self.metrics.engine_failures.inc()
+        obs_tracing.instant("watchdog_stall", {
+            "epoch": epoch,
+            "budget_s": self.engine_cfg.tick_timeout,
+            "tick_age_s": round(time.monotonic() - started, 3)})
         self._set_health(FAILED)
         # The engine thread is hung inside _lock, so _states is frozen —
         # snapshot-read it without the lock and resolve every future a
@@ -1050,9 +1151,11 @@ class InferenceEngine:
         return self._decode_traces
 
     def stats(self) -> Dict:
+        age = self.heartbeat_age
         return {
             **self.metrics.snapshot(),
             "state": self._health,
+            "heartbeat_age_s": round(age, 3) if age is not None else None,
             "state_transitions": self.state_transitions,
             "n_slots": self.engine_cfg.n_slots,
             "slots_active": self.slots.active_count,

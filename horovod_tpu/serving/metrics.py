@@ -1,135 +1,42 @@
-"""Serving observability: counters, gauges, and fixed-bucket histograms.
+"""Serving observability: the engine's instrument panel, now a thin
+view over :mod:`horovod_tpu.obs.registry`.
 
-Deliberately dependency-free (stdlib only) and thread-safe — instruments
-are updated from the engine thread and read from HTTP handler threads.
-Snapshots are plain dicts so ``/stats`` can ``json.dumps`` them
-directly.  Percentiles come from the cumulative bucket counts (the
-Prometheus-style estimate: the reported pN is the upper edge of the
-bucket containing the N-th percentile observation), which keeps memory
-constant no matter how long the server runs.
+Historically this module owned its own Counter/Gauge/Histogram classes;
+those now live in the process-wide registry layer (same semantics,
+thread-safe, constant-memory histograms) and are re-exported here for
+backward compatibility.  :class:`ServingMetrics` registers every
+instrument under a ``serving_*`` Prometheus family name in a PRIVATE
+:class:`~horovod_tpu.obs.registry.MetricsRegistry` (one per engine
+lifetime — tests and benchmarks create many engines per process, and
+their series must not collide), keeps the original attribute API the
+engine updates (``metrics.admitted.inc()`` …), and keeps the original
+``snapshot()`` dict the ``/stats`` endpoint serves.  The server's
+``GET /metrics`` renders this registry PLUS the default registry
+(training/elastic/timeline families) as Prometheus text exposition.
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Optional
 
-
-class Counter:
-    def __init__(self) -> None:
-        self._v = 0
-        self._lock = threading.Lock()
-
-    def inc(self, n: int = 1) -> None:
-        with self._lock:
-            self._v += n
-
-    @property
-    def value(self) -> int:
-        return self._v
-
-
-class Gauge:
-    def __init__(self) -> None:
-        self._v = 0.0
-        self._lock = threading.Lock()
-
-    def set(self, v: float) -> None:
-        with self._lock:
-            self._v = float(v)
-
-    @property
-    def value(self) -> float:
-        return self._v
-
-
-# Latency buckets in seconds: 1ms .. 60s, roughly x2.5 per step — wide
-# enough for CPU-smoke ticks and TPU production alike.
-DEFAULT_LATENCY_BUCKETS = (
-    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
-    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+from horovod_tpu.obs.registry import (  # noqa: F401  (back-compat re-export)
+    DEFAULT_LATENCY_BUCKETS,
+    TICK_PHASE_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
 )
 
-# Tick-phase buckets extend down to 10us: an async dispatch (and a
-# fully-hidden device wait) is sub-millisecond, which the request-level
-# buckets above cannot resolve.
-TICK_PHASE_BUCKETS = (
-    1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
-) + DEFAULT_LATENCY_BUCKETS
-
-
-class Histogram:
-    """Fixed-bucket histogram with an implicit +Inf overflow bucket."""
-
-    def __init__(self, buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
-        self.buckets: List[float] = sorted(float(b) for b in buckets)
-        self._counts = [0] * (len(self.buckets) + 1)
-        self._sum = 0.0
-        self._count = 0
-        self._lock = threading.Lock()
-
-    def observe(self, v: float) -> None:
-        i = 0
-        while i < len(self.buckets) and v > self.buckets[i]:
-            i += 1
-        with self._lock:
-            self._counts[i] += 1
-            self._sum += v
-            self._count += 1
-
-    @property
-    def count(self) -> int:
-        return self._count
-
-    @property
-    def sum(self) -> float:
-        return self._sum
-
-    def mean(self) -> Optional[float]:
-        return self._sum / self._count if self._count else None
-
-    def _percentile(self, counts: List[int], total: int,
-                    q: float) -> Optional[float]:
-        if not total:
-            return None
-        rank = q * total
-        cum = 0
-        for i, c in enumerate(counts):
-            cum += c
-            if cum >= rank:
-                return self.buckets[i] if i < len(self.buckets) \
-                    else self.buckets[-1]
-        return self.buckets[-1]
-
-    def percentile(self, q: float) -> Optional[float]:
-        """Upper edge of the bucket holding the q-quantile observation
-        (q in [0, 1]); None when empty, +Inf bucket reports the largest
-        finite edge."""
-        with self._lock:
-            counts, total = list(self._counts), self._count
-        return self._percentile(counts, total, q)
-
-    def snapshot(self) -> Dict:
-        # One locked copy; count/sum/buckets AND percentiles all
-        # describe the same population (an observe() racing /stats must
-        # not split them).
-        with self._lock:
-            counts = list(self._counts)
-            total, s = self._count, self._sum
-        return {
-            "count": total,
-            "sum": round(s, 6),
-            "mean": round(s / total, 6) if total else None,
-            "p50": self._percentile(counts, total, 0.50),
-            "p99": self._percentile(counts, total, 0.99),
-            "buckets": {
-                ("%g" % b): c for b, c in zip(self.buckets, counts)
-            } | {"+Inf": counts[-1]},
-        }
+__all__ = [
+    "Counter", "Gauge", "Histogram", "ServingMetrics",
+    "DEFAULT_LATENCY_BUCKETS", "TICK_PHASE_BUCKETS",
+]
 
 
 class ServingMetrics:
-    """The engine's instrument panel, surfaced verbatim through /stats.
+    """The engine's instrument panel, surfaced verbatim through /stats
+    and as Prometheus families through /metrics.
 
     * ``ttft`` — submit-to-first-token latency (prefill + queueing).
     * ``token_latency`` — per-token decode-tick latency.
@@ -161,23 +68,55 @@ class ServingMetrics:
       ``block_until_ready`` creeping back onto the hot path.
     """
 
-    def __init__(self) -> None:
-        self.ttft = Histogram()
-        self.token_latency = Histogram()
-        self.queue_depth = Gauge()
-        self.slot_occupancy = Gauge()
-        self.admitted = Counter()
-        self.rejected = Counter()
-        self.completed = Counter()
-        self.cancelled = Counter()
-        self.tokens_generated = Counter()
-        self.engine_failures = Counter()
-        self.engine_restarts = Counter()
-        self.tick_dispatch = Histogram(buckets=TICK_PHASE_BUCKETS)
-        self.tick_device_wait = Histogram(buckets=TICK_PHASE_BUCKETS)
-        self.tick_host = Histogram(buckets=TICK_PHASE_BUCKETS)
-        self.decode_ticks = Counter()
-        self.host_syncs = Counter()
+    def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
+        r = registry if registry is not None else MetricsRegistry()
+        self.registry = r
+        self.ttft = r.histogram(
+            "serving_ttft_seconds",
+            "Submit-to-first-token latency (queueing + prefill)")
+        self.token_latency = r.histogram(
+            "serving_token_latency_seconds",
+            "Per-token decode-tick latency (dispatch to host fetch)")
+        self.queue_depth = r.gauge(
+            "serving_queue_depth", "Requests queued awaiting admission")
+        self.slot_occupancy = r.gauge(
+            "serving_slot_occupancy", "Active slots / total slots")
+        self.admitted = r.counter(
+            "serving_requests_admitted_total", "Requests admitted to slots")
+        self.rejected = r.counter(
+            "serving_requests_rejected_total",
+            "Typed rejections (queue-full, deadline, too-long)")
+        self.completed = r.counter(
+            "serving_requests_completed_total",
+            "Requests retired with tokens (eos/length/capacity/deadline)")
+        self.cancelled = r.counter(
+            "serving_requests_cancelled_total",
+            "Requests cancelled caller-side (incl. 504 slot reclamation)")
+        self.tokens_generated = r.counter(
+            "serving_tokens_generated_total", "Tokens emitted to futures")
+        self.engine_failures = r.counter(
+            "serving_engine_failures_total",
+            "Tick failures and watchdog stalls")
+        self.engine_restarts = r.counter(
+            "serving_engine_restarts_total",
+            "Successful supervised restarts (fresh slot cache)")
+        self.tick_dispatch = r.histogram(
+            "serving_tick_dispatch_seconds",
+            "Time to build and dispatch one decode tick (async)",
+            buckets=TICK_PHASE_BUCKETS)
+        self.tick_device_wait = r.histogram(
+            "serving_tick_device_wait_seconds",
+            "Host-visible wait fetching a tick's results",
+            buckets=TICK_PHASE_BUCKETS)
+        self.tick_host = r.histogram(
+            "serving_tick_host_seconds",
+            "Host bookkeeping per tick (emit/retire/admission)",
+            buckets=TICK_PHASE_BUCKETS)
+        self.decode_ticks = r.counter(
+            "serving_decode_ticks_total", "Decode ticks dispatched")
+        self.host_syncs = r.counter(
+            "serving_host_syncs_total",
+            "Host sync points (blocking value fetches) on the decode path")
 
     def snapshot(self) -> Dict:
         ticks = self.decode_ticks.value

@@ -73,7 +73,11 @@ class Request:
     ``prompt`` is a token-id sequence; ``deadline`` is an ABSOLUTE
     ``time.monotonic()`` instant (None = no deadline); ``future`` is the
     engine's per-request result sink (tokens stream into it, typed
-    rejections land on it as exceptions)."""
+    rejections land on it as exceptions); ``trace`` is the request's
+    :class:`~horovod_tpu.obs.tracing.RequestTrace` — the trace id and
+    timing stamps ride the request through every stage, so the
+    breakdown survives rejection, cancellation, stall, and restart
+    paths alike."""
 
     prompt: Sequence[int]
     max_new_tokens: int
@@ -81,6 +85,7 @@ class Request:
     eos_id: Optional[int] = None
     deadline: Optional[float] = None
     submitted_at: float = 0.0
+    trace: Any = None
     id: int = dataclasses.field(default_factory=lambda: next(_req_ids))
 
 
@@ -128,6 +133,8 @@ class Scheduler:
         but the constructor's ``on_reject`` IS notified, so shed load
         at submit time counts the same as shed load in :meth:`take`)."""
         req.submitted_at = self._clock()
+        if req.trace is not None:
+            req.trace.submitted_at = req.submitted_at
         err: Optional[QueueFullError] = None
         with self._lock:
             if len(self._q) >= self.max_queue_depth:

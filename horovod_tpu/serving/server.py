@@ -20,10 +20,22 @@ Endpoints:
 * ``GET /healthz`` — readiness keyed to the engine state machine:
   200 for ``healthy``/``degraded``, **503 for ``draining`` and
   ``failed``** so load balancers stop routing before teardown or after
-  an unrecovered failure.
+  an unrecovered failure.  Carries ``heartbeat_age_s`` (seconds since
+  the last completed tick) and ``engine_restarts`` so liveness probes
+  never have to parse the full ``/stats`` JSON.
 * ``GET /stats`` — the full metrics snapshot (serving/metrics.py),
   including ``state``, ``state_transitions``, ``engine_failures`` and
   ``engine_restarts``.
+* ``GET /metrics`` — Prometheus text exposition (0.0.4): the engine's
+  ``serving_*`` families plus the process default registry (training,
+  elastic, eager-runtime, timeline families) in one scrape.
+
+Tracing (docs/observability.md): every ``/generate`` request gets a
+trace id — the ``X-Trace-Id`` header when present and valid, a minted
+one otherwise — propagated through the scheduler and engine and echoed
+back in the response (``trace_id`` field + ``X-Trace-Id`` header, on
+SUCCESS AND on every typed-error path), alongside a per-request timing
+``breakdown`` (queue wait, prefill, decode, host-sync lag).
 """
 
 from __future__ import annotations
@@ -34,6 +46,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional, Sequence
 
+from horovod_tpu.obs import tracing as obs_tracing
+from horovod_tpu.obs.registry import default_registry
 from horovod_tpu.serving.engine import DEGRADED, HEALTHY, InferenceEngine
 from horovod_tpu.serving.scheduler import (
     DeadlineExceededError,
@@ -56,10 +70,15 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # quiet: metrics are the log
         pass
 
-    def _json(self, code: int, payload: dict) -> None:
+    def _json(self, code: int, payload: dict,
+              trace_id: Optional[str] = None) -> None:
+        if trace_id is not None:
+            payload.setdefault("trace_id", trace_id)
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        if trace_id is not None:
+            self.send_header(obs_tracing.TRACE_ID_HEADER, trace_id)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -69,26 +88,51 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             state = engine.health
             code = 200 if state in (HEALTHY, DEGRADED) else 503
+            age = engine.heartbeat_age
             self._json(code, {
                 "status": state,
                 "slots_free": engine.slots.free_count,
                 "queue_depth": engine.scheduler.depth,
+                "heartbeat_age_s":
+                    round(age, 3) if age is not None else None,
                 "engine_restarts": engine.metrics.engine_restarts.value,
             })
         elif self.path == "/stats":
             self._json(200, engine.stats())
+        elif self.path == "/metrics":
+            # One scrape covers everything: the engine's private
+            # serving_* registry plus the process-wide default registry
+            # (training / elastic / eager / timeline families).
+            text = (engine.metrics.registry.to_prometheus()
+                    + default_registry().to_prometheus())
+            body = text.encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):
-        # Read the body FIRST, even on error paths: HTTP/1.1 keep-alive
+        # Trace-id ingress FIRST — accept a valid X-Trace-Id
+        # (Dapper-style propagation from an upstream caller), mint
+        # otherwise — so EVERY response carries the id, including the
+        # malformed-input 400s below; a trace that dead-ends exactly on
+        # bad input is no trace at all.
+        hdr = self.headers.get(obs_tracing.TRACE_ID_HEADER)
+        trace_id = hdr if obs_tracing.valid_trace_id(hdr) \
+            else obs_tracing.mint_trace_id()
+        # Read the body, even on error paths: HTTP/1.1 keep-alive
         # reuses the connection, and unread body bytes would be parsed
         # as the next request line.
         try:
             n = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(n)
         except ValueError:
-            self._json(400, {"error": "bad Content-Length"})
+            self._json(400, {"error": "bad Content-Length"},
+                       trace_id=trace_id)
             return
         if self.path != "/generate":
             self._json(404, {"error": f"unknown path {self.path}"})
@@ -97,7 +141,8 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             req = json.loads(body or b"{}")
         except json.JSONDecodeError as e:
-            self._json(400, {"error": f"bad JSON body: {e}"})
+            self._json(400, {"error": f"bad JSON body: {e}"},
+                       trace_id=trace_id)
             return
 
         tokens = req.get("tokens")
@@ -105,13 +150,22 @@ class _Handler(BaseHTTPRequestHandler):
             encode = self.server.encode
             if encode is None:
                 self._json(400, {"error": "server has no text encoder; "
-                                          "send token ids"})
+                                          "send token ids"},
+                           trace_id=trace_id)
                 return
             tokens = encode(req["text"])
         if not tokens:
             self._json(400, {"error": "need non-empty 'tokens' (or "
-                                      "'text' with an encoder)"})
+                                      "'text' with an encoder)"},
+                       trace_id=trace_id)
             return
+
+        def fut_err(code: int, e: BaseException, etype: str) -> None:
+            payload = {"error": str(e), "type": etype}
+            b = fut.breakdown() if fut is not None else None
+            if b is not None:
+                payload["breakdown"] = b
+            self._json(code, payload, trace_id=trace_id)
 
         timeout_ms = req.get("timeout_ms")
         fut = None
@@ -127,7 +181,8 @@ class _Handler(BaseHTTPRequestHandler):
                 [int(t) for t in tokens],
                 max_new_tokens=req.get("max_new_tokens"),
                 eos_id=req.get("eos_id"),
-                deadline=deadline)
+                deadline=deadline,
+                trace_id=trace_id)
             # The engine's deadline retirement (partial result, reason
             # "deadline") should win over this hard HTTP timeout, which
             # only fires when the engine cannot retire (e.g. hung) —
@@ -135,27 +190,27 @@ class _Handler(BaseHTTPRequestHandler):
             out = fut.result(timeout=self.server.request_timeout
                              + self.server.timeout_grace)
         except QueueFullError as e:
-            self._json(429, {"error": str(e), "type": "queue_full"})
+            fut_err(429, e, "queue_full")
             return
         except RequestTooLongError as e:
-            self._json(413, {"error": str(e), "type": "too_long"})
+            fut_err(413, e, "too_long")
             return
         except DeadlineExceededError as e:
-            self._json(504, {"error": str(e), "type": "deadline_exceeded"})
+            fut_err(504, e, "deadline_exceeded")
             return
         except DrainingError as e:
-            self._json(503, {"error": str(e), "type": "draining"})
+            fut_err(503, e, "draining")
             return
         except EngineFailedError as e:
             # Submit-time (terminally failed) or result-time (this
             # request was in flight when the engine failed/stalled).
-            self._json(503, {"error": str(e), "type": "engine_failed"})
+            fut_err(503, e, "engine_failed")
             return
         except (ServingError, ValueError, TypeError) as e:
             # TypeError covers non-numeric JSON fields (timeout_ms,
             # max_new_tokens, nested token lists): a 400, not a dropped
             # connection.
-            self._json(400, {"error": str(e)})
+            self._json(400, {"error": str(e)}, trace_id=trace_id)
             return
         except TimeoutError as e:
             # 504 without cancellation would leak the slot: the engine
@@ -164,16 +219,17 @@ class _Handler(BaseHTTPRequestHandler):
             # (or purges the queue entry) on the next tick.
             if fut is not None:
                 fut.cancel()
-            self._json(504, {"error": str(e), "type": "timeout"})
+            fut_err(504, e, "timeout")
             return
         payload = {
             "tokens": out,
             "finish_reason": fut.finish_reason,
             "ttft_ms": round(fut.ttft * 1e3, 3) if fut.ttft else None,
+            "breakdown": fut.breakdown(),
         }
         if engine.detokenize is not None:
             payload["text"] = fut.text
-        self._json(200, payload)
+        self._json(200, payload, trace_id=trace_id)
 
 
 class ServingServer:

@@ -27,15 +27,38 @@ from typing import Optional
 import jax
 
 
+def _dropped_events_counter():
+    """Create-or-fetch the process-wide dropped-events counter (shared
+    by every Timeline instance; also seeded at init so /metrics exposes
+    the family before any timeline exists)."""
+    from horovod_tpu.obs.registry import default_registry
+
+    return default_registry().counter(
+        "timeline_dropped_events_total",
+        "Timeline events dropped on a full writer queue "
+        "(the trace file has gaps)", exist_ok=True)
+
+
 class Timeline:
-    def __init__(self, path: str, *, pid: Optional[int] = None) -> None:
+    def __init__(self, path: str, *, pid: Optional[int] = None,
+                 queue_size: int = 1 << 20) -> None:
         self.path = path
         self.pid = pid if pid is not None else os.getpid()
-        self._q: "queue.Queue" = queue.Queue(maxsize=1 << 20)
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)
         self._file = open(path, "w")
         self._file.write("[\n")
         self._first = True
         self._closed = False
+        # Dropped-event accounting: _emit sheds load on queue.Full to
+        # protect the hot path, but silent loss would make a sparse
+        # trace look like a quiet system — count every drop (here and
+        # in the process registry) and flush the total as a trailing
+        # event on close() so the trace file discloses its own gaps.
+        self.dropped_events = 0
+        try:
+            self._dropped_counter = _dropped_events_counter()
+        except Exception:  # pragma: no cover - registry must not gate IO
+            self._dropped_counter = None
         self._writer = threading.Thread(target=self._drain, daemon=True)
         self._writer.start()
         atexit.register(self.close)
@@ -48,7 +71,21 @@ class Timeline:
         try:
             self._q.put_nowait(ev)
         except queue.Full:  # drop rather than stall the hot path
-            pass
+            self.dropped_events += 1
+            if self._dropped_counter is not None:
+                self._dropped_counter.inc()
+
+    def emit_batch(self, evs: list) -> None:
+        """Enqueue a pre-built group of events as ONE queue item (one
+        writer wakeup) — the hot-emitter path (engine tick phases)."""
+        if self._closed or not evs:
+            return
+        try:
+            self._q.put_nowait(evs)
+        except queue.Full:
+            self.dropped_events += len(evs)
+            if self._dropped_counter is not None:
+                self._dropped_counter.inc(len(evs))
 
     def begin(self, name: str, category: str = "host", tid: int = 0) -> None:
         self._emit(
@@ -86,6 +123,37 @@ class Timeline:
             }
         )
 
+    def complete(self, name: str, start_s: float, dur_s: float,
+                 category: str = "host", tid: int = 0,
+                 args: Optional[dict] = None) -> None:
+        """A complete span (Chrome ``X`` event) with an explicit start
+        and duration in ``time.monotonic()`` SECONDS — for spans whose
+        boundaries were stamped elsewhere (the request tracer resolves
+        a span only once the request retires)."""
+        ev = {
+            "name": name,
+            "cat": category,
+            "ph": "X",
+            "ts": start_s * 1e6,
+            "dur": max(dur_s, 0.0) * 1e6,
+            "pid": self.pid,
+            "tid": tid,
+        }
+        if args:
+            ev["args"] = args
+        self._emit(ev)
+
+    def thread_name(self, tid: int, name: str) -> None:
+        """Label a synthetic thread row (Chrome ``M``/thread_name
+        metadata) — Perfetto shows the label instead of a bare tid."""
+        self._emit({
+            "name": "thread_name",
+            "ph": "M",
+            "pid": self.pid,
+            "tid": tid,
+            "args": {"name": name},
+        })
+
     def mark_cycle(self) -> None:
         """Cycle marker (``HOROVOD_TIMELINE_MARK_CYCLES``,
         ``operations.cc:392-405``) — on TPU, one per train step."""
@@ -115,10 +183,14 @@ class Timeline:
             ev = self._q.get()
             if ev is None:
                 return
-            if not self._first:
-                self._file.write(",\n")
-            self._first = False
-            json.dump(ev, self._file)
+            # A list is a pre-batched group (Tracer.tick_phase): one
+            # queue wakeup carries many events, so a hot emitter costs
+            # one writer context switch per BATCH instead of per event.
+            for e in (ev if isinstance(ev, list) else (ev,)):
+                if not self._first:
+                    self._file.write(",\n")
+                self._first = False
+                json.dump(e, self._file)
 
     def close(self) -> None:
         if self._closed:
@@ -126,6 +198,29 @@ class Timeline:
         self._closed = True
         self._q.put(None)
         self._writer.join(timeout=5)
+        if self._writer.is_alive():
+            # The writer is still draining a huge backlog: the file is
+            # NOT ours — appending the trailer or closing would
+            # interleave with (and crash) the writer.  Leave the trace
+            # truncated (no closing bracket) rather than corrupted; the
+            # daemon writer exits at the None sentinel it already has.
+            return
+        if self.dropped_events:
+            # Trailing disclosure: the writer thread is done, so the
+            # file (and the _first separator state) is ours to append
+            # the drop count as one final instant event.
+            if not self._first:
+                self._file.write(",\n")
+            self._first = False
+            json.dump({
+                "name": "TIMELINE_DROPPED_EVENTS",
+                "ph": "i",
+                "s": "g",
+                "ts": time.monotonic_ns() / 1e3,
+                "pid": self.pid,
+                "tid": 0,
+                "args": {"dropped_events": self.dropped_events},
+            }, self._file)
         self._file.write("\n]\n")
         self._file.close()
 

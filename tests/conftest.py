@@ -62,3 +62,85 @@ def http_post_json(url, payload, timeout=60.0):
             return r.status, json.loads(r.read())
     except urllib.error.HTTPError as e:
         return e.code, json.loads(e.read())
+
+
+def parse_prometheus_text(text):
+    """STRICT parser/validator for Prometheus text exposition (0.0.4);
+    the golden check behind the /metrics tests (shared by test_obs.py
+    and test_chaos.py so the format contract cannot silently diverge).
+
+    Asserts the structural rules a real scraper relies on — every
+    sample line parses, a sample's family has a preceding # TYPE,
+    sample names match their family (histograms: _bucket/_sum/_count),
+    histogram bucket counts are cumulative and the +Inf bucket equals
+    _count — and returns {family: {"type": ..., "help": ...,
+    "samples": [(name, labels_dict, value)]}}.
+    """
+    import re
+
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    sample_re = re.compile(
+        r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"          # metric name
+        r"(?:\{([^}]*)\})?"                      # optional labels
+        r" (-?(?:[0-9.]+(?:[eE][+-]?[0-9]+)?|Inf)|NaN|\+Inf)$")
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+    families = {}
+    current = None
+    for line in text.splitlines():
+        assert line.strip() == line and line, f"bad line framing: {line!r}"
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            fam = rest.split(" ", 1)[0]
+            assert name_re.match(fam), fam
+            families.setdefault(fam, {"type": None, "help": None,
+                                      "samples": []})
+            families[fam]["help"] = rest.partition(" ")[2]
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(" ")
+            assert len(parts) == 4, f"bad TYPE line: {line!r}"
+            fam, kind = parts[2], parts[3]
+            assert name_re.match(fam), fam
+            assert kind in ("counter", "gauge", "histogram"), kind
+            families.setdefault(fam, {"type": None, "help": None,
+                                      "samples": []})
+            families[fam]["type"] = kind
+            current = fam
+            continue
+        m = sample_re.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        name, raw_labels, raw_value = m.groups()
+        labels = dict(label_re.findall(raw_labels)) if raw_labels else {}
+        value = float(raw_value.replace("+Inf", "inf"))
+        # the sample must belong to the most recent TYPE'd family
+        assert current is not None, f"sample before any TYPE: {line!r}"
+        kind = families[current]["type"]
+        if kind == "histogram":
+            assert name in (current + "_bucket", current + "_sum",
+                            current + "_count"), (name, current)
+            if name.endswith("_bucket"):
+                assert "le" in labels, line
+        else:
+            assert name == current, (name, current)
+        families[current]["samples"].append((name, labels, value))
+    # histogram invariants: buckets cumulative, +Inf == _count
+    for fam, f in families.items():
+        if f["type"] != "histogram":
+            continue
+        series = {}
+        count = {}
+        for name, labels, value in f["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            if name.endswith("_bucket"):
+                series.setdefault(key, []).append(
+                    (float(labels["le"].replace("+Inf", "inf")), value))
+            elif name.endswith("_count"):
+                count[key] = value
+        for key, buckets in series.items():
+            buckets.sort()
+            values = [v for _, v in buckets]
+            assert values == sorted(values), (fam, key, "not cumulative")
+            assert buckets[-1][0] == float("inf"), (fam, key)
+            assert buckets[-1][1] == count.get(key), (fam, key)
+    return families

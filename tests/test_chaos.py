@@ -88,6 +88,7 @@ def _wait_for(pred, timeout=15.0, poll=0.01):
 
 
 from conftest import http_post_json as _post  # noqa: E402
+from conftest import parse_prometheus_text  # noqa: E402
 
 
 class TestFaultInjector:
@@ -597,6 +598,138 @@ class TestChaosInvariant:
             assert s["decode_compilations"] == 1
         finally:
             engine.stop()
+
+
+class TestTraceFailurePaths:
+    """Trace-id + breakdown propagation through the FAILURE paths: the
+    whole point of Dapper-style ids is answering "where did request X
+    go" when it did NOT come back clean — so cancel, 504, watchdog
+    stall, and supervised restart must all resolve with the id and the
+    timing stamps intact."""
+
+    def test_trace_survives_cancel(self, model):
+        engine = _engine(model)
+        fut = engine.submit([21, 22], max_new_tokens=30,
+                            trace_id="tr-cancel")
+        engine.step()
+        engine.step()
+        assert fut.cancel() is True
+        engine.step()  # reclamation tick
+        assert fut.done() and fut.finish_reason == "cancelled"
+        assert fut.trace_id == "tr-cancel"
+        b = fut.breakdown()
+        assert b["finish"] == "cancelled"
+        assert b["queue_wait_s"] >= 0 and b["prefill_s"] >= 0
+        assert b["tokens"] == len(fut.result(timeout=0))
+        assert b["total_s"] >= b["queue_wait_s"]
+
+    def test_trace_survives_restart(self, model):
+        """A mid-decode device fault: the doomed future resolves typed
+        with its trace intact (error name in the breakdown), and the
+        post-restart request traces independently."""
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_tick", kind="raise", skip=1)])
+        engine = _engine(model, faults=inj)
+        doomed = engine.submit([3, 4, 5], max_new_tokens=8,
+                               trace_id="tr-doomed")
+        _run_until_done(engine, [doomed])
+        with pytest.raises(serving.EngineFailedError):
+            doomed.result(timeout=0)
+        assert doomed.trace_id == "tr-doomed"
+        b = doomed.breakdown()
+        assert b["finish"] == "EngineFailedError"
+        assert b["queue_wait_s"] is not None and b["total_s"] > 0
+        fut = engine.submit([3, 4, 5], max_new_tokens=4,
+                            trace_id="tr-after")
+        _run_until_done(engine, [fut])
+        assert fut.breakdown()["finish"] == "length"
+        assert fut.trace_id == "tr-after"
+
+    def test_trace_survives_watchdog_stall(self, model):
+        """The watchdog resolves futures from ITS thread — the trace
+        must be stamped there too, with the stall's typed error."""
+        inj = serving.FaultInjector()
+        engine = _engine(model, faults=inj, n_slots=1,
+                         tick_timeout=0.3, watchdog_interval=0.02)
+        _warm(engine)
+        inj.add(serving.FaultSpec(
+            site="decode_tick", kind="hang", delay=1.2,
+            skip=inj.visits("decode_tick") + 2))
+        engine.start()
+        try:
+            f_run = engine.submit([11, 12, 13], max_new_tokens=30,
+                                  trace_id="tr-stalled")
+            # n_slots=1: this one stays QUEUED through the stall
+            f_queued = engine.submit([14, 15], max_new_tokens=30,
+                                     trace_id="tr-queued")
+            for f in (f_run, f_queued):
+                with pytest.raises(serving.EngineStalledError):
+                    f.result(timeout=10.0)
+            assert f_run.trace_id == "tr-stalled"
+            assert f_run.breakdown()["finish"] == "EngineStalledError"
+            # the queued one was never admitted: queue_wait covers its
+            # whole life, prefill/decode stay None
+            bq = f_queued.breakdown()
+            assert bq["trace_id"] == "tr-queued"
+            assert bq["finish"] == "EngineStalledError"
+            assert bq["prefill_s"] is None
+            assert bq["queue_wait_s"] == bq["total_s"]
+        finally:
+            engine.stop()
+
+    def test_trace_survives_http_504(self, model):
+        """The 504-timeout path: the client's X-Trace-Id comes back on
+        the error payload with the partial breakdown, and the engine's
+        cancel keeps the id through slot reclamation."""
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_tick", kind="hang",
+                              delay=0.05, max_fires=None)])
+        engine = _engine(model, faults=inj, n_slots=2)
+        _warm(engine)
+        with serving.ServingServer(engine, port=0, request_timeout=0.4,
+                                   timeout_grace=0.1) as srv:
+            host, port = srv.address
+            req = urllib.request.Request(
+                f"http://{host}:{port}/generate",
+                data=json.dumps({"tokens": [1, 2], "max_new_tokens": 38,
+                                 "timeout_ms": 60000}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Trace-Id": "tr-504"})
+            try:
+                urllib.request.urlopen(req, timeout=30)
+                raise AssertionError("expected 504")
+            except urllib.error.HTTPError as e:
+                assert e.code == 504
+                out = json.loads(e.read())
+                hdr = e.headers["X-Trace-Id"]
+            assert out["type"] == "timeout"
+            assert out["trace_id"] == hdr == "tr-504"
+            assert out["breakdown"]["trace_id"] == "tr-504"
+            assert out["breakdown"]["total_s"] > 0
+            assert _wait_for(lambda: engine.slots.active_count == 0,
+                             timeout=2.0)
+
+    def test_metrics_endpoint_valid_during_failure(self, model):
+        """GOLDEN: /metrics still parses as valid Prometheus text on a
+        terminally failed engine, and the failure counters are
+        visible in the scrape."""
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site="decode_tick", kind="raise",
+                              max_fires=None)])
+        engine = _engine(model, faults=inj, max_restarts=0)
+        with serving.ServingServer(engine, port=0) as srv:
+            host, port = srv.address
+            base = f"http://{host}:{port}"
+            code, out = _post(base + "/generate",
+                              {"tokens": [1, 2], "max_new_tokens": 4})
+            assert code == 503
+            assert _wait_for(lambda: engine.health == "failed")
+            with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+                fams = parse_prometheus_text(r.read().decode())
+            assert fams["serving_engine_failures_total"][
+                "samples"][0][2] >= 1
+            assert "serving_ttft_seconds" in fams
+            assert "elastic_restarts_total" in fams  # default registry too
 
 
 class TestServerFaultTolerance:
