@@ -87,16 +87,15 @@ def main() -> None:
     if args.model == "InceptionV3" and args.image_size == 224:
         args.image_size = 299  # Inception's native resolution
 
+    from horovod_tpu.obs import xprof
+
     n = hvd.size()
     global_batch = args.batch_size * n
     kind = jax.devices()[0].device_kind
-    peak_by_kind = {
-        "TPU v2": 46e12, "TPU v3": 123e12, "TPU v4": 275e12,
-        "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5p": 459e12,
-        "TPU v6 lite": 918e12,
-    }
-    peak = next((v for k, v in peak_by_kind.items() if kind.startswith(k)),
-                None)  # unknown chip: MFU fields become JSON null, not NaN
+    # Peak table lives in obs.xprof now (shared with
+    # benchmarks/transformer.py); unknown chip: MFU fields become JSON
+    # null, not NaN.
+    peak = xprof.chip_peak_flops()
 
     # The summary skeleton exists BEFORE any heavy work and the ONE
     # JSON line is printed from the finally-path below — so a
@@ -113,6 +112,8 @@ def main() -> None:
         "mfu": None,
         "tflops_per_sec": None,
         "xla_flops_per_img": None,
+        "hbm_peak_bytes": None,
+        "training_mfu_live": None,
         "chip": kind,
         "peak_bf16_tflops": peak / 1e12 if peak else None,
         "cpu_smoke": cpu_smoke,
@@ -258,21 +259,33 @@ def _measure(args, hvd, result, state, n, global_batch) -> None:
     # plain step(...) call after lower().compile() would compile a second
     # time — the AOT result doesn't enter jit's dispatch cache).
     # Executed FLOPs come from XLA's own cost analysis of the compiled
-    # step (forward + backward + optimizer, everything the chip actually
-    # runs); the analytic model cost (3 x 2 x 4.09 GMACs ~ 12.3
-    # GFLOPs/img for ResNet-50@224) is lower — XLA's count includes
-    # BN/padding/optimizer work — so the XLA-based MFU is the honest
-    # utilization of what was scheduled, disclosed alongside.
+    # step via obs.xprof.introspect (forward + backward + optimizer,
+    # everything the chip actually runs); the analytic model cost (3 x 2
+    # x 4.09 GMACs ~ 12.3 GFLOPs/img for ResNet-50@224) is lower — XLA's
+    # count includes BN/padding/optimizer work — so the XLA-based MFU is
+    # the honest utilization of what was scheduled, disclosed alongside.
+    from horovod_tpu import obs
+    from horovod_tpu.obs import xprof
+
     step = step.lower(params, opt_state, batch_stats, images, labels).compile()
-    ca = step.cost_analysis()
-    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-    step_flops = float(ca.get("flops", 0.0)) if ca else 0.0
+    report = xprof.introspect(step, fn="bench_train_step")
+    step_flops = report.flops or 0.0
+    result["hbm_peak_bytes"] = report.peak_hbm_bytes
     # cost_analysis() describes the per-device SPMD-partitioned module,
     # which processes the LOCAL batch shard — divide by batch/chip, not the
     # global batch, or multi-chip MFU would be understated n-fold.
     flops_per_img = step_flops / args.batch_size
     state["flops_per_img"] = flops_per_img
     result["xla_flops_per_img"] = round(flops_per_img / 1e9, 2)
+    # Arm the live training_mfu gauge: one measured unit below is an
+    # ITERATION (num_batches_per_iter steps closed by a sync), so the
+    # armed cost is the iteration's FLOPs — the gauge then tracks the
+    # same number the JSON line's `mfu` reports from the median.
+    peak = result["peak_bf16_tflops"]
+    peak = peak * 1e12 if peak else None
+    xprof.set_training_cost(
+        step_flops * args.num_batches_per_iter if step_flops else None,
+        peak)
 
     # warmup (compile + stabilize)
     for _ in range(max(args.num_warmup_batches // args.num_batches_per_iter, 1)):
@@ -309,13 +322,21 @@ def _measure(args, hvd, result, state, n, global_batch) -> None:
     fed_img_secs = state["fed_img_secs"]  # summarizes whatever landed
     for _ in range(args.num_iters):
         t0 = time.perf_counter()
-        for _ in range(args.num_batches_per_iter):
-            params, opt_state, batch_stats, loss = step(
-                params, opt_state, batch_stats, images, labels
-            )
-        _sync(loss)
+        # obs.training_step spans the iteration: observes step time in
+        # the default registry and refreshes the live `training_mfu`
+        # gauge from the cost armed above (a scrape during the run sees
+        # the same utilization the JSON line summarizes).
+        with obs.training_step("bench_iter"):
+            for _ in range(args.num_batches_per_iter):
+                params, opt_state, batch_stats, loss = step(
+                    params, opt_state, batch_stats, images, labels
+                )
+            _sync(loss)
         dt = time.perf_counter() - t0
         img_secs.append(global_batch * args.num_batches_per_iter / dt / n)
+        mfu_live = obs.training_metrics().mfu.value
+        if mfu_live:
+            result["training_mfu_live"] = round(mfu_live, 4)
         if loader is None:
             continue
         # Interleaved A/B: same chip, same minute — loader-fed variant.

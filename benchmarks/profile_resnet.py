@@ -113,9 +113,10 @@ def run(batch_size: int, scan_len: int, iters: int = 5, inner: int = 10):
 
     lowered = step.lower(params, opt_state, stats, images, labels)
     compiled = lowered.compile()
-    ca = compiled.cost_analysis()
-    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-    flops = float(ca.get("flops", float("nan"))) if ca else float("nan")
+    from horovod_tpu.obs import xprof
+
+    report = xprof.introspect(compiled, fn="profile_resnet_step")
+    flops = report.flops if report.flops is not None else float("nan")
 
     # warmup
     for _ in range(2):

@@ -44,17 +44,23 @@ def _run_engine_once(args, cfg, params, prompts, arrival, overlap):
     numbers describe serving latency, not JIT compile time."""
     from horovod_tpu import serving
 
+    from horovod_tpu.obs import xprof
+
     engine = serving.InferenceEngine(
         params, cfg, serving.EngineConfig(
             n_slots=args.slots, max_len=cfg.max_seq,
             max_prefills_per_tick=args.max_prefills_per_tick,
-            max_queue_depth=max(args.n_requests, 8), overlap=overlap))
+            max_queue_depth=max(args.n_requests, 8), overlap=overlap,
+            # achieved FLOP/s ride the snapshot in the JSON line
+            model_flops_per_token=xprof.transformer_flops_per_token(
+                params)))
 
     engine.warmup(sorted({engine._bucket(len(p)) for p in prompts}))
     warm_compiles = engine.decode_compilations
     engine.metrics = serving.ServingMetrics()
 
     engine.start()
+    engine.stats()  # first token-rate sample for achieved FLOP/s
     occ, futs = [], []
     t0 = time.monotonic()
     for i in range(args.n_requests):
@@ -73,7 +79,8 @@ def _run_engine_once(args, cfg, params, prompts, arrival, overlap):
     # request can resolve with a typed error (engine restart) instead
     # of tokens — the benchmark reports that instead of crashing.
     toks = sum(len(f.tokens_so_far()) for f in futs)
-    snap = engine.metrics.snapshot()
+    snap = engine.stats()  # superset of metrics.snapshot(): adds
+    # state/heartbeat plus the achieved-FLOP/s window closed here
     # Overlap efficiency: the share of a tick's host-visible time the
     # device wait accounts for — 1.0 means every host cycle (emit,
     # retire, admission bookkeeping, dispatch) was hidden behind
@@ -282,6 +289,8 @@ def _engine_mode(args, T, cfg, params) -> None:
         "tick_device_wait_mean_s":
             snap["tick_device_wait_seconds"]["mean"],
         "tick_host_mean_s": snap["tick_host_seconds"]["mean"],
+        "model_flops_per_token": snap["model_flops_per_token"],
+        "achieved_flops_per_sec": snap["achieved_flops_per_sec"],
         "chip": jax.devices()[0].device_kind,
         # The full registry snapshot rides the JSON line so BENCH_r*
         # artifacts carry the observability data (counters, gauges,

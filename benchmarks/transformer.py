@@ -16,6 +16,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import sys
 import time
 
 import jax
@@ -23,11 +25,9 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-PEAK_BY_KIND = {
-    "TPU v2": 46e12, "TPU v3": 123e12, "TPU v4": 275e12,
-    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-}
+# Runnable as `python benchmarks/transformer.py` without PYTHONPATH
+# (same shim as benchmarks/serving.py).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main() -> None:
@@ -147,13 +147,17 @@ def main() -> None:
             NamedSharding(mesh, batch_spec)),
     }
 
+    from horovod_tpu.obs import xprof
+
     step = step.lower(params, opt_state, tokens).compile()
-    # Analytic FLOPs (XLA's cost analysis counts a lax.scan body ONCE, so
-    # it undercounts the per-layer work n_layers-fold): 6 x matmul-params
-    # x tokens for the dense path + causal attention scores, fwd+bwd.
-    n_matmul = sum(
-        int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params)
-    ) - int(np.prod(params["embed"].shape))  # embed lookup does no matmul
+    # Peak-HBM and the chip-peak table come from obs.xprof (the
+    # library-ized form of bench.py's cost_analysis trick); the MFU
+    # numerator stays ANALYTIC on purpose — XLA's cost analysis counts
+    # a lax.scan body ONCE, so it undercounts the per-layer work
+    # n_layers-fold here: 6 x matmul-params x tokens for the dense path
+    # + causal attention scores, fwd+bwd.
+    report = xprof.introspect(step, fn="transformer_train_step")
+    n_matmul = xprof.matmul_param_count(params)
     moe_removed = 0
     if args.n_experts > 1:
         # MODEL FLOPs for top-1 MoE: each token's MLP runs ONE expert, so
@@ -178,8 +182,11 @@ def main() -> None:
     step_flops = float(dense_flops + attn_flops)
 
     kind = jax.devices()[0].device_kind
-    peak = next((v for k, v in PEAK_BY_KIND.items() if kind.startswith(k)),
-                None)
+    peak = xprof.chip_peak_flops()
+    # Arm the live training_mfu gauge; one measured unit below is an
+    # iteration of steps_per_iter steps closed by a sync.
+    xprof.set_training_cost(
+        step_flops * args.steps_per_iter if step_flops else None, peak)
 
     def _sync(x):
         return float(np.asarray(jax.device_get(x)))
@@ -189,12 +196,15 @@ def main() -> None:
             params, opt_state, loss = step(params, opt_state, tokens)
     _sync(loss)
 
+    from horovod_tpu import obs
+
     times = []
     for _ in range(args.num_iters):
         t0 = time.perf_counter()
-        for _ in range(args.steps_per_iter):
-            params, opt_state, loss = step(params, opt_state, tokens)
-        _sync(loss)
+        with obs.training_step("transformer_bench_iter"):
+            for _ in range(args.steps_per_iter):
+                params, opt_state, loss = step(params, opt_state, tokens)
+            _sync(loss)
         times.append((time.perf_counter() - t0) / args.steps_per_iter)
 
     med = float(np.median(times))
@@ -216,6 +226,7 @@ def main() -> None:
                 else None),
         "tflops_per_sec": (round(step_flops / med / 1e12, 1)
                            if step_flops else None),
+        "hbm_peak_bytes": report.peak_hbm_bytes,
         "chip": kind,
     }
     if args.n_experts > 1 and args.moe_impl == "dense" and peak:

@@ -108,7 +108,11 @@ def main() -> None:
     engine = serving.InferenceEngine(
         params, cfg,
         serving.EngineConfig(n_slots=args.slots, max_len=cfg.max_seq,
-                             restart_backoff=0.05, faults=inj),
+                             restart_backoff=0.05, faults=inj,
+                             # turns token counters into achieved
+                             # FLOP/s in /stats (docs/observability.md)
+                             model_flops_per_token=obs.xprof
+                             .transformer_flops_per_token(params)),
         detokenize=lambda t: f" {t}")
     # SIGTERM (k8s/systemd stop) -> graceful drain, same as Ctrl-C —
     # installed for the WHOLE serving lifetime, demo burst included:

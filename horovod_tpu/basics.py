@@ -252,9 +252,11 @@ def init(
     native_rt = None
     try:
         from horovod_tpu import eager_runtime
+        from horovod_tpu.timeline import expand_rank_path
 
         native_rt = eager_runtime.start(
-            timeline_path=os.environ.get("HOROVOD_TIMELINE", "")
+            timeline_path=expand_rank_path(
+                os.environ.get("HOROVOD_TIMELINE", ""))
         )
     except Exception as e:  # pragma: no cover - defensive
         logger.warning("native runtime unavailable, using direct path: %s", e)
@@ -266,7 +268,19 @@ def init(
     if timeline_path and native_rt is None:
         from horovod_tpu.timeline import Timeline
 
-        if _context.process_rank == 0:  # rank 0 writes, like the reference
+        elastic = os.environ.get("HOROVOD_ELASTIC", "0") \
+            not in ("", "0", "false")
+        if "%r" in timeline_path:
+            # Explicit per-rank substitution: every rank records its
+            # own file (merge with `python -m horovod_tpu.obs.merge`).
+            _context.timeline = Timeline(timeline_path)
+        elif elastic and _context.num_processes > 1:
+            # Elastic multi-process default: rank-suffix the path —
+            # N respawning ranks all writing one literal path would
+            # silently clobber each other's traces.
+            root, ext = os.path.splitext(timeline_path)
+            _context.timeline = Timeline(f"{root}.rank%r{ext or '.json'}")
+        elif _context.process_rank == 0:  # rank 0 writes, like the reference
             _context.timeline = Timeline(timeline_path)
     if os.environ.get("HOROVOD_AUTOTUNE", "0") not in ("", "0", "false"):
         from horovod_tpu.autotune import Autotuner

@@ -48,6 +48,12 @@ def heartbeat_key(epoch: int, rank: int) -> str:
     return f"heartbeat.{epoch}.{rank}"
 
 
+def metrics_key(epoch: int, rank: int) -> str:
+    """Where a rank publishes its registry export for the driver's
+    fleet aggregation (docs/observability.md "Fleet")."""
+    return f"metrics.{epoch}.{rank}"
+
+
 def notice_key(epoch: int) -> str:
     return f"notice.{epoch}"
 
@@ -129,6 +135,41 @@ class WorkerNotificationManager:
 
     # ---- background thread ----------------------------------------------
 
+    def _heartbeat_payload(self, now: float) -> bytes:
+        """The structured heartbeat: wall clock plus the training-step
+        telemetry the driver's straggler detector consumes (step count
+        + last step duration, read off the default registry — the
+        fields are simply absent before the first ``obs.training_step``
+        completes).  Always JSON; the driver's staleness check only
+        watches the raw value *change*, so legacy float payloads and
+        this coexist."""
+        payload = {"t": now}
+        try:
+            from horovod_tpu.obs.registry import training_metrics
+
+            m = training_metrics()
+            payload["steps"] = m.steps.value
+            last = m.last_step.value
+            if last > 0:
+                payload["step_s"] = round(last, 6)
+        except Exception:  # pragma: no cover - metrics never gate beats
+            pass
+        import json
+
+        return json.dumps(payload).encode()
+
+    def _export_payload(self) -> Optional[bytes]:
+        """This rank's mergeable registry export for the driver's fleet
+        aggregation (None when the registry is unavailable)."""
+        try:
+            from horovod_tpu.obs.registry import default_registry
+
+            import json
+
+            return json.dumps(default_registry().export()).encode()
+        except Exception:  # pragma: no cover - metrics never gate beats
+            return None
+
     def _loop(self) -> None:
         tick = max(0.1, min(self._interval or 1.0, 1.0))
         next_beat = 0.0
@@ -138,7 +179,12 @@ class WorkerNotificationManager:
                 if self._interval > 0 and now >= next_beat:
                     self._kv.put(KV_SCOPE,
                                  heartbeat_key(self._epoch, self._rank),
-                                 repr(now).encode())
+                                 self._heartbeat_payload(now))
+                    export = self._export_payload()
+                    if export is not None:
+                        self._kv.put(KV_SCOPE,
+                                     metrics_key(self._epoch, self._rank),
+                                     export)
                     next_beat = now + self._interval
                 if not self._notified:
                     if self._kv.get(KV_SCOPE,

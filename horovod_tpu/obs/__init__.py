@@ -31,7 +31,7 @@ from __future__ import annotations
 import contextlib
 import time
 
-from horovod_tpu.obs import registry, tracing  # noqa: F401
+from horovod_tpu.obs import aggregate, fleet, registry, tracing, xprof  # noqa: F401
 from horovod_tpu.obs.registry import (  # noqa: F401
     Counter,
     DuplicateMetricError,
@@ -51,7 +51,7 @@ from horovod_tpu.obs.tracing import (  # noqa: F401
 )
 
 __all__ = [
-    "registry", "tracing",
+    "aggregate", "fleet", "registry", "tracing", "xprof",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DuplicateMetricError", "default_registry",
     "training_metrics", "elastic_metrics",
@@ -63,8 +63,12 @@ __all__ = [
 @contextlib.contextmanager
 def training_step(name: str = "train_step"):
     """Span one training step: observes ``training_step_seconds`` /
-    ``training_steps_total`` in the default registry and, when a
-    timeline is recording, nests a ``train_step`` span onto the same
+    ``training_steps_total`` / ``training_last_step_seconds`` in the
+    default registry (the last-step gauge also rides the elastic
+    heartbeat, feeding the driver's straggler detector), refreshes the
+    live ``training_mfu`` gauge when
+    :func:`horovod_tpu.obs.xprof.set_training_cost` armed it, and, when
+    a timeline is recording, nests a ``train_step`` span onto the same
     time axis as the serving request spans."""
     m = training_metrics()
     from horovod_tpu import timeline as TL
@@ -81,3 +85,5 @@ def training_step(name: str = "train_step"):
             tl.end(name)
         m.step_time.observe(dt)
         m.steps.inc()
+        m.last_step.set(dt)
+        xprof.observe_step(dt, m.mfu)

@@ -167,11 +167,42 @@ class Histogram:
 
     def percentile(self, q: float) -> Optional[float]:
         """Upper edge of the bucket holding the q-quantile observation
-        (q in [0, 1]); None when empty, +Inf bucket reports the largest
-        finite edge."""
+        (q in [0, 1]); None when empty.
+
+        Edge semantics (docs/observability.md "Percentile semantics"),
+        relied on by fleet-merged p99s — these are *bucket estimates*,
+        not exact quantiles:
+
+        * the returned value is always a configured bucket **upper
+          edge** — an observation of 1.5 in buckets (1, 2, 4) reports
+          as 2;
+        * an observation exactly **on** an edge belongs to that edge's
+          bucket (``observe`` advances while ``v > edge``), so
+          ``observe(2.0)`` → ``percentile(1.0) == 2``;
+        * quantiles landing in the **+Inf overflow bucket report the
+          largest finite edge** (the histogram cannot know how far past
+          it the tail went) — a merged p99 equal to the top edge means
+          "at least this", not "exactly this";
+        * ``q == 0`` reports the smallest configured edge (whether or
+          not that bucket holds any mass) — a floor, not a minimum.
+        """
         with self._lock:
             counts, total = list(self._counts), self._count
         return self._percentile(counts, total, q)
+
+    def state(self) -> Dict:
+        """The mergeable raw state under ONE lock hold: bucket edges,
+        per-bucket (non-cumulative) counts including the trailing +Inf
+        overflow, sum, and count — the wire format
+        :mod:`horovod_tpu.obs.aggregate` merges bucket-wise across
+        ranks."""
+        with self._lock:
+            return {
+                "buckets": list(self.buckets),
+                "counts": list(self._counts),
+                "sum": self._sum,
+                "count": self._count,
+            }
 
     def snapshot(self) -> Dict:
         # One locked copy; count/sum/buckets AND percentiles all
@@ -350,6 +381,36 @@ class MetricsRegistry:
                 out[name] = one(e.obj)
         return out
 
+    def export(self) -> Dict:
+        """Typed, mergeable JSON view — the fleet-aggregation wire
+        format (:mod:`horovod_tpu.obs.aggregate`).  Unlike
+        :meth:`snapshot` (which flattens to /stats-friendly scalars and
+        loses the instrument kind), this keeps everything a remote
+        merger needs: kind, help, label names, and per-series values —
+        histograms as raw ``{buckets, counts, sum, count}`` state so
+        they merge bucket-wise::
+
+            {name: {"kind": "counter"|"gauge"|"histogram",
+                    "help": ..., "labels": [...],
+                    "series": [{"l": {label: value}, "v": scalar}
+                               | {"l": {...}, "h": histogram_state}]}}
+        """
+        with self._lock:
+            entries = sorted(self._entries.items())
+        out: Dict = {}
+        for name, e in entries:
+            series = []
+            for key, inst in self._series(e):
+                s: Dict = {"l": dict(zip(e.labelnames, key))}
+                if e.kind == "histogram":
+                    s["h"] = inst.state()
+                else:
+                    s["v"] = inst.value
+                series.append(s)
+            out[name] = {"kind": e.kind, "help": e.help,
+                         "labels": list(e.labelnames), "series": series}
+        return out
+
     def to_prometheus(self) -> str:
         """Prometheus text exposition format 0.0.4 for every registered
         metric (serve with content type
@@ -398,8 +459,12 @@ class _Namespace:
 
 def training_metrics(registry: Optional[MetricsRegistry] = None) -> _Namespace:
     """Create-or-fetch the training metric family: step time, step
-    count, and XLA compile events (labeled by instrumented function).
-    Idempotent — every caller gets the same instruments."""
+    count, XLA compile events (labeled by instrumented function), the
+    last-step-duration gauge (rides the elastic heartbeat payload so
+    the driver's straggler detector sees per-rank step time), and the
+    live MFU gauge (set by ``obs.training_step`` once
+    :func:`horovod_tpu.obs.xprof.set_training_cost` told it the step's
+    FLOPs).  Idempotent — every caller gets the same instruments."""
     r = registry if registry is not None else _default
     return _Namespace(
         step_time=r.histogram(
@@ -409,6 +474,16 @@ def training_metrics(registry: Optional[MetricsRegistry] = None) -> _Namespace:
         steps=r.counter(
             "training_steps_total",
             "Training steps completed", exist_ok=True),
+        last_step=r.gauge(
+            "training_last_step_seconds",
+            "Wall-clock duration of the most recent training step "
+            "(published in the elastic heartbeat payload)",
+            exist_ok=True),
+        mfu=r.gauge(
+            "training_mfu",
+            "Live model-FLOPs utilization of the last training step "
+            "(step FLOPs / step seconds / chip peak; requires "
+            "obs.xprof.set_training_cost)", exist_ok=True),
         compiles=r.counter(
             "xla_compiles_total",
             "XLA trace/compile events observed at instrumented jit sites",

@@ -144,6 +144,10 @@ class Tracer:
     def __init__(self, timeline, jsonl_path: Optional[str] = None):
         self._tl = timeline
         self._own_timeline = False
+        if jsonl_path:
+            from horovod_tpu.timeline import expand_rank_path
+
+            jsonl_path = expand_rank_path(jsonl_path)
         self._jsonl = open(jsonl_path, "a") if jsonl_path else None
         self._jsonl_lock = threading.Lock()
         self.jsonl_path = jsonl_path
@@ -249,7 +253,9 @@ def start(path: Optional[str] = None,
     """Start request tracing.  Attaches to the already-active process
     timeline when there is one (``HOROVOD_TIMELINE`` /
     ``start_timeline``) so serving and training share one trace file;
-    otherwise starts a timeline at ``path``."""
+    otherwise starts a timeline at ``path``.  Both paths accept the
+    ``%r`` rank substitution (docs/timeline.md) so multi-process runs
+    don't clobber each other's files."""
     global _tracer
     if _tracer is not None:
         raise ValueError("tracing already started")

@@ -1,0 +1,266 @@
+"""XLA cost/memory introspection as a library: honest FLOPs, HBM, and
+live MFU from the compiler's own analysis.
+
+``bench.py`` proved the technique — ``compiled.cost_analysis()`` counts
+the FLOPs XLA actually scheduled (forward + backward + optimizer,
+BN/padding included), which is the honest denominator-free utilization
+number TPU practice leans on (the Xprof approach) — but it lived as
+ad-hoc benchmark code.  This module library-izes it:
+
+* :func:`introspect` — one call on a lowered-and-compiled function
+  returns a :class:`CostReport` (FLOPs, bytes accessed, peak HBM from
+  ``memory_analysis``) and registers it as ``xla_flops{fn=}`` /
+  ``xla_hbm_peak_bytes{fn=}`` gauges so ``/metrics`` carries the
+  compiler's view of every instrumented program;
+* :data:`PEAK_FLOPS_BY_KIND` / :func:`chip_peak_flops` — the
+  per-generation bf16 peak table (previously duplicated by hand in
+  ``bench.py`` and ``benchmarks/transformer.py``);
+* :func:`set_training_cost` + :func:`observe_step` — tell the
+  observability layer the per-step model FLOPs once, and every
+  ``obs.training_step()`` thereafter sets the live ``training_mfu``
+  gauge from its measured wall-clock (step FLOPs / step seconds /
+  chip peak) — MFU becomes a scrapeable signal instead of a
+  benchmark-only artifact;
+* :func:`transformer_flops_per_token` — the analytic decode-side model
+  cost (2 FLOPs per parameter per token, PaLM appendix-B convention)
+  that turns the serving engine's token counters into achieved FLOP/s
+  in ``/stats`` (``EngineConfig.model_flops_per_token``).
+
+Everything degrades to ``None`` rather than raising when the backend
+cannot answer (CPU smoke runs, older JAX): observability must never
+gate the workload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional
+
+from horovod_tpu.obs.registry import (
+    MetricsRegistry,
+    default_registry,
+    training_metrics,
+)
+
+__all__ = [
+    "CostReport", "introspect", "PEAK_FLOPS_BY_KIND", "chip_peak_flops",
+    "mfu", "set_training_cost", "training_cost", "observe_step",
+    "matmul_param_count", "transformer_flops_per_token",
+]
+
+# Peak dense bf16 FLOP/s per chip by device kind (the table bench.py and
+# benchmarks/transformer.py used to carry separately).  Matching is by
+# prefix on jax's device_kind string; unknown chips yield None so MFU
+# fields become JSON null, never NaN.
+PEAK_FLOPS_BY_KIND: Dict[str, float] = {
+    "TPU v2": 46e12, "TPU v3": 123e12, "TPU v4": 275e12,
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12, "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+}
+
+
+def chip_peak_flops(device=None) -> Optional[float]:
+    """Peak bf16 FLOP/s of ``device`` (default: the first visible
+    device), or None when the chip generation is unknown (CPU
+    fallback, new hardware)."""
+    if device is None:
+        try:
+            import jax
+
+            device = jax.devices()[0]
+        except Exception:
+            return None
+    kind = getattr(device, "device_kind", "")
+    return next(
+        (v for k, v in PEAK_FLOPS_BY_KIND.items() if kind.startswith(k)),
+        None)
+
+
+@dataclasses.dataclass
+class CostReport:
+    """What the compiler knows about one compiled program (per-device:
+    cost_analysis describes the SPMD-partitioned module, i.e. the LOCAL
+    shard's work — divide by the local batch, not the global one)."""
+
+    fn: str
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    peak_hbm_bytes: Optional[float] = None
+
+    def mfu(self, step_seconds: float,
+            peak: Optional[float] = None) -> Optional[float]:
+        """Utilization of this program at the measured step time."""
+        return mfu(self.flops, step_seconds, peak)
+
+
+def _cost_dict(compiled) -> Optional[Dict]:
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    return ca or None
+
+
+def _peak_hbm(compiled) -> Optional[float]:
+    """Peak HBM of one executable from ``memory_analysis``: arguments +
+    outputs + temporaries, minus donated/aliased buffers (counted once,
+    not twice)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    ma = ma[0] if isinstance(ma, (list, tuple)) else ma
+    if ma is None:
+        return None
+    try:
+        total = (float(getattr(ma, "argument_size_in_bytes", 0) or 0)
+                 + float(getattr(ma, "output_size_in_bytes", 0) or 0)
+                 + float(getattr(ma, "temp_size_in_bytes", 0) or 0)
+                 - float(getattr(ma, "alias_size_in_bytes", 0) or 0))
+    except (TypeError, ValueError):
+        return None
+    return total if total > 0 else None
+
+
+def introspect(compiled, fn: str = "step", *,
+               registry: Optional[MetricsRegistry] = None,
+               register: bool = True) -> CostReport:
+    """Run XLA's own cost and memory analysis on a compiled function
+    (the result of ``jax.jit(f).lower(...).compile()``) and register
+    the findings as gauges.
+
+    Returns a :class:`CostReport` with ``flops`` (everything the chip
+    actually runs — higher than analytic model FLOPs, which is the
+    honest utilization of what was *scheduled*), ``bytes_accessed``,
+    and ``peak_hbm_bytes``.  With ``register`` (default), sets
+    ``xla_flops{fn=...}`` and ``xla_hbm_peak_bytes{fn=...}`` in the
+    (default) registry so a scrape carries the compiler's view.  Any
+    field the backend cannot answer is None — never an exception."""
+    ca = _cost_dict(compiled)
+    report = CostReport(
+        fn=fn,
+        flops=float(ca["flops"]) if ca and "flops" in ca else None,
+        bytes_accessed=(float(ca["bytes accessed"])
+                        if ca and "bytes accessed" in ca else None),
+        peak_hbm_bytes=_peak_hbm(compiled),
+    )
+    if register:
+        try:
+            r = registry if registry is not None else default_registry()
+            if report.flops is not None:
+                r.gauge("xla_flops",
+                        "FLOPs per execution of an instrumented "
+                        "compiled function (XLA cost_analysis)",
+                        labels=("fn",), exist_ok=True).labels(
+                            fn=fn).set(report.flops)
+            if report.peak_hbm_bytes is not None:
+                r.gauge("xla_hbm_peak_bytes",
+                        "Peak HBM bytes of an instrumented compiled "
+                        "function (XLA memory_analysis: args + outputs "
+                        "+ temps - aliased)",
+                        labels=("fn",), exist_ok=True).labels(
+                            fn=fn).set(report.peak_hbm_bytes)
+        except Exception:  # pragma: no cover - metrics never gate the run
+            pass
+    return report
+
+
+def mfu(flops_per_step: Optional[float], step_seconds: float,
+        peak: Optional[float] = None) -> Optional[float]:
+    """Model-FLOPs utilization: ``flops / seconds / chip_peak`` —
+    exactly the computation ``bench.py`` reports.  None when FLOPs or
+    the chip peak are unknown, or the step time is non-positive."""
+    if peak is None:
+        peak = chip_peak_flops()
+    if not flops_per_step or not peak or step_seconds <= 0:
+        return None
+    return flops_per_step / step_seconds / peak
+
+
+# -- live training MFU --------------------------------------------------------
+#
+# set_training_cost() is called once (after compiling the step, e.g.
+# right where bench.py runs introspect); every obs.training_step() then
+# calls observe_step(dt), which sets the `training_mfu` gauge.  The
+# disabled cost is one lock-free tuple read per step.
+
+_training_cost = (None, None)  # (flops_per_step, peak_flops)
+_training_lock = threading.Lock()
+
+
+def set_training_cost(flops_per_step: Optional[float],
+                      peak: Optional[float] = None) -> None:
+    """Arm the live ``training_mfu`` gauge: per-step model FLOPs (from
+    :func:`introspect` or an analytic count) and the chip peak
+    (defaults to :func:`chip_peak_flops`).  Pass None to disarm."""
+    global _training_cost
+    if flops_per_step is None:
+        with _training_lock:
+            _training_cost = (None, None)
+        return
+    if peak is None:
+        peak = chip_peak_flops()
+    with _training_lock:
+        _training_cost = (float(flops_per_step),
+                          float(peak) if peak else None)
+
+
+def training_cost():
+    """The armed ``(flops_per_step, peak_flops)`` pair (None, None when
+    disarmed)."""
+    return _training_cost
+
+
+def observe_step(step_seconds: float, mfu_gauge=None) -> Optional[float]:
+    """One training step took ``step_seconds``: update the
+    ``training_mfu`` gauge when armed.  Returns the MFU (or None).
+
+    ``mfu_gauge`` lets the caller hand over the gauge it already holds
+    (``obs.training_step`` does) so the armed per-step cost stays one
+    tuple read + one gauge set, not a registry lookup."""
+    flops, peak = _training_cost
+    if flops is None or peak is None or step_seconds <= 0:
+        return None
+    u = flops / step_seconds / peak
+    try:
+        (mfu_gauge if mfu_gauge is not None
+         else training_metrics().mfu).set(u)
+    except Exception:  # pragma: no cover - metrics never gate training
+        pass
+    return u
+
+
+def matmul_param_count(params) -> int:
+    """Parameters participating in matmuls: every leaf of the pytree
+    minus the ``embed`` table (lookup, not matmul).  The shared count
+    under both the analytic train-side MFU numerator
+    (benchmarks/transformer.py) and the serving-side
+    :func:`transformer_flops_per_token` — one place to adjust if the
+    model grows another non-matmul table."""
+    import numpy as np
+
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(params)
+        total = sum(int(np.prod(l.shape)) for l in leaves)
+        embed = params.get("embed") if isinstance(params, dict) else None
+        if embed is not None:
+            total -= int(np.prod(embed.shape))
+    except Exception:
+        return 0
+    return total
+
+
+def transformer_flops_per_token(params) -> float:
+    """Analytic decode-side model FLOPs per generated token: 2 FLOPs
+    per matmul parameter (forward only — the PaLM appendix-B
+    convention, attention-score term omitted as cache-length-dependent
+    and small at serving lengths).  ``params`` is the transformer
+    param pytree; the embedding table is excluded (lookup, not
+    matmul).  Feed the result to
+    ``EngineConfig.model_flops_per_token`` so the serving ``/stats``
+    reports achieved FLOP/s."""
+    return 2.0 * matmul_param_count(params)

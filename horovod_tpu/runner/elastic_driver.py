@@ -35,7 +35,14 @@ import time
 from typing import Dict, List, Optional, Set
 
 from horovod_tpu.elastic.interrupts import EXIT_CODE_RESTART
-from horovod_tpu.elastic.worker import KV_SCOPE, heartbeat_key, notice_key, state_key
+from horovod_tpu.elastic.worker import (
+    KV_SCOPE,
+    heartbeat_key,
+    metrics_key,
+    notice_key,
+    state_key,
+)
+from horovod_tpu.obs.fleet import FleetMonitor, FleetServer, parse_heartbeat
 from horovod_tpu.runner import safe_shell_exec
 from horovod_tpu.runner.discovery import FixedHostDiscovery, HostDiscovery
 from horovod_tpu.runner.hosts import Blacklist, HostSpec, allocate, parse_hosts
@@ -73,6 +80,17 @@ class ElasticDriver:
       script use a nonzero timeout so one transient script failure
       (which legitimately yields the empty set) does not abort a
       healthy job — the horovodrun CLI defaults it to 60 s there.
+    * ``metrics_port`` — serve the fleet observability endpoints
+      (``GET /metrics`` Prometheus + ``GET /fleet`` JSON, aggregated
+      across ranks with ``rank``/``host`` labels) on this port
+      (0 = ephemeral; see :attr:`fleet_address`).  None (default)
+      disables the HTTP listener; the :attr:`fleet` monitor — and its
+      straggler detection — runs either way.
+    * ``straggler_threshold`` / ``straggler_patience`` — a rank whose
+      heartbeat-reported step duration exceeds ``threshold`` × the
+      fleet median for ``patience`` consecutive step reports is flagged
+      (warning + ``elastic_straggler_total{rank=}`` + timeline
+      instant).  Report-only: the driver never evicts on slowness.
     """
 
     def __init__(
@@ -95,6 +113,9 @@ class ElasticDriver:
         discovery_interval: float = 1.0,
         output_filename: Optional[str] = None,
         coordinator_port: int = 0,
+        metrics_port: Optional[int] = None,
+        straggler_threshold: float = 2.0,
+        straggler_patience: int = 3,
         _executor=safe_shell_exec.execute,
         _sleep=time.sleep,
     ) -> None:
@@ -129,6 +150,24 @@ class ElasticDriver:
         self.epoch = 0
         self.resets = 0
         self.epoch_sizes: List[int] = []  # world size used per epoch
+        # Fleet observability (docs/observability.md "Fleet"): the
+        # monitor aggregates worker registry exports + step durations
+        # off the rendezvous KV and runs straggler detection; the HTTP
+        # listener (metrics_port) exposes /metrics + /fleet.
+        self.fleet = FleetMonitor(
+            straggler_threshold=straggler_threshold,
+            straggler_patience=straggler_patience)
+        self._metrics_port = metrics_port
+        self._fleet_server: Optional[FleetServer] = None
+        self._fleet_raw: Dict[int, tuple] = {}  # rank -> (hb, metrics)
+
+    @property
+    def fleet_address(self):
+        """(host, port) of the fleet metrics endpoint, or None when
+        ``metrics_port`` was not given or the job is not running."""
+        if self._fleet_server is None:
+            return None
+        return self._fleet_server.address
 
     # ---- public ----------------------------------------------------------
 
@@ -146,6 +185,20 @@ class ElasticDriver:
         port = server.start()
         addr = os.environ.get("HOROVOD_HOSTNAME", "127.0.0.1")
         try:
+            if self._metrics_port is not None:
+                try:
+                    # 0.0.0.0 like the rendezvous server: the scrape
+                    # endpoint exists to be reached from OFF this host.
+                    self._fleet_server = FleetServer(
+                        self.fleet, host="0.0.0.0",
+                        port=self._metrics_port).start()
+                except OSError as e:
+                    # Observability failing must not fail training —
+                    # the job runs on, just without the scrape endpoint.
+                    logger.warning(
+                        "fleet: metrics endpoint unavailable "
+                        "(port %s: %s); continuing without it",
+                        self._metrics_port, e)
             while True:
                 specs = self._wait_for_available_hosts()
                 ok, culprits, restart_requested = self._run_epoch(
@@ -161,6 +214,9 @@ class ElasticDriver:
                 self.epoch += 1
         finally:
             server.stop()
+            if self._fleet_server is not None:
+                self._fleet_server.stop()
+                self._fleet_server = None
 
     # ---- membership ------------------------------------------------------
 
@@ -310,7 +366,10 @@ class ElasticDriver:
             output_filename=out_dir, failure=failure,
             on_rank_exit=_on_exit, _executor=self._executor)
 
+        self.fleet.begin_epoch(self.epoch)
+        self._fleet_raw.clear()
         epoch_start = time.monotonic()
+        next_fleet_poll = 0.0
         hb_seen: Dict[int, tuple] = {}  # rank -> (value, driver mono time)
         while any(rc is None for rc in exit_codes):
             self._sleep(0.1)
@@ -319,6 +378,9 @@ class ElasticDriver:
                 self._check_heartbeats(server, slots, exit_codes, lock,
                                        culprits, _notify_failure,
                                        hb_seen, epoch_start)
+            if now >= next_fleet_poll:
+                next_fleet_poll = now + self._heartbeat_interval
+                self._poll_fleet(server, slots, exit_codes)
             with lock:
                 expired = (first_failure[0] is not None
                            and now - first_failure[0] >= self._shutdown_grace)
@@ -369,6 +431,33 @@ class ElasticDriver:
                 continue
             if now - prev[1] >= self._heartbeat_timeout:
                 _stale(slot, now - prev[1])
+
+    def _poll_fleet(self, server, slots, exit_codes) -> None:
+        """Feed the fleet monitor from the rendezvous KV: each live
+        rank's heartbeat payload (step durations → straggler
+        detection) and registry export (→ the aggregated /metrics).
+        Never gates the epoch — fleet observability failing must not
+        fail training."""
+        for i, slot in enumerate(slots):
+            if exit_codes[i] is not None:
+                continue
+            try:
+                hb = server.get(KV_SCOPE,
+                                heartbeat_key(self.epoch, slot.rank))
+                mx = server.get(KV_SCOPE,
+                                metrics_key(self.epoch, slot.rank))
+                prev_hb, prev_mx = self._fleet_raw.get(slot.rank,
+                                                       (None, None))
+                if hb is not None and hb != prev_hb:
+                    self.fleet.heartbeat(slot.rank, slot.hostname,
+                                         parse_heartbeat(hb))
+                if mx is not None and mx != prev_mx:
+                    self.fleet.snapshot(slot.rank, slot.hostname,
+                                        json.loads(mx))
+                self._fleet_raw[slot.rank] = (hb, mx)
+            except Exception as e:  # pragma: no cover - defensive
+                logger.debug("fleet: poll failed for rank %d: %s",
+                             slot.rank, e)
 
 
 def run_elastic(

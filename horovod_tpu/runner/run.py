@@ -144,6 +144,20 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                          "hosts before aborting (default: 60 with a "
                          "discovery script — one transient script failure "
                          "must not kill the job — else 0)")
+    el.add_argument("--metrics-port", type=int, dest="metrics_port",
+                    default=None,
+                    help="serve the fleet observability endpoints "
+                         "(GET /metrics Prometheus + GET /fleet JSON, "
+                         "aggregated across ranks) on this port "
+                         "(0 = ephemeral; docs/observability.md)")
+    el.add_argument("--straggler-threshold", type=float,
+                    dest="straggler_threshold", default=2.0,
+                    help="flag a rank as a straggler when its step time "
+                         "exceeds this multiple of the fleet median "
+                         "(report-only)")
+    el.add_argument("--straggler-patience", type=int,
+                    dest="straggler_patience", default=3,
+                    help="consecutive slow step reports before flagging")
 
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command to launch")
@@ -313,6 +327,9 @@ def _run(args: argparse.Namespace) -> int:
                 discovery_timeout=discovery_timeout,
                 output_filename=args.output_filename,
                 coordinator_port=args.start_port,
+                metrics_port=args.metrics_port,
+                straggler_threshold=args.straggler_threshold,
+                straggler_patience=args.straggler_patience,
             )
         except ElasticJobError as e:
             raise SystemExit(f"horovodrun: {e}")

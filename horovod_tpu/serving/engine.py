@@ -296,6 +296,11 @@ class EngineConfig:
     tick_timeout: float = 60.0
     watchdog_interval: float = 0.05
     faults: Optional[FaultInjector] = None
+    # Model FLOPs per generated token (e.g.
+    # obs.xprof.transformer_flops_per_token(params)): turns the token
+    # counters into achieved FLOP/s in /stats — the honest utilization
+    # number a router/capacity planner balances on.  None disables.
+    model_flops_per_token: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -402,6 +407,18 @@ class InferenceEngine:
         # tokens in the device token vector (one tiny async op).
         self._merge_tokens = jax.jit(
             lambda toks, vals, mask: jnp.where(mask, vals, toks))
+
+        # Token-rate window for achieved FLOP/s: (monotonic, tokens)
+        # samples taken at each stats() call, pruned to ~60s — the
+        # scrape cadence defines the window, no hot-path cost.
+        # Own lock (not self._lock): stats() is served from concurrent
+        # HTTP handler threads and must not contend with the tick loop.
+        self._rate_samples: List = []
+        self._rate_lock = threading.Lock()
+        self._rate_metrics = self.metrics
+        if engine_cfg.model_flops_per_token:
+            self.metrics.model_flops_per_token.set(
+                engine_cfg.model_flops_per_token)
 
     # -- lifecycle / health ------------------------------------------------
 
@@ -1150,8 +1167,44 @@ class InferenceEngine:
         zero-recompilation acceptance hook (stays 1 after warmup)."""
         return self._decode_traces
 
+    def _update_achieved_flops(self) -> None:
+        """Refresh ``serving_achieved_flops_per_sec`` from the token
+        rate between stats() samples (window capped at ~60s so the
+        number tracks current load, not job-lifetime average)."""
+        fpt = self.engine_cfg.model_flops_per_token
+        if not fpt:
+            return
+        # Re-assert the configured gauge: benchmarks swap in a fresh
+        # ServingMetrics after warmup, which would otherwise leave it 0.
+        metrics = self.metrics
+        metrics.model_flops_per_token.set(fpt)
+        now = time.monotonic()
+        with self._rate_lock:
+            if metrics is not self._rate_metrics:
+                # A fresh ServingMetrics restarts the token counter at
+                # 0; a window base from the old counter would make the
+                # next rate negative.
+                self._rate_samples.clear()
+                self._rate_metrics = metrics
+            self._rate_samples.append((now, metrics.tokens_generated.value))
+            while (len(self._rate_samples) > 2
+                   and now - self._rate_samples[0][0] > 60.0):
+                self._rate_samples.pop(0)
+            t0, n0 = self._rate_samples[0]
+            n1 = self._rate_samples[-1][1]
+        if now <= t0:
+            return
+        metrics.achieved_flops.set((n1 - n0) / (now - t0) * fpt)
+
+    def refresh_windowed_gauges(self) -> None:
+        """Refresh rate-windowed gauges (achieved FLOP/s) without
+        building a /stats snapshot — the cheap hook a /metrics scrape
+        wants."""
+        self._update_achieved_flops()
+
     def stats(self) -> Dict:
         age = self.heartbeat_age
+        self._update_achieved_flops()
         return {
             **self.metrics.snapshot(),
             "state": self._health,

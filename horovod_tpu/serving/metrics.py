@@ -117,6 +117,15 @@ class ServingMetrics:
         self.host_syncs = r.counter(
             "serving_host_syncs_total",
             "Host sync points (blocking value fetches) on the decode path")
+        self.model_flops_per_token = r.gauge(
+            "serving_model_flops_per_token",
+            "Configured model FLOPs per generated token "
+            "(EngineConfig.model_flops_per_token; 0 = not configured)")
+        self.achieved_flops = r.gauge(
+            "serving_achieved_flops_per_sec",
+            "Achieved model FLOP/s over the recent token-rate window "
+            "(tokens/sec x model_flops_per_token; 0 until configured "
+            "and two samples apart)")
 
     def snapshot(self) -> Dict:
         ticks = self.decode_ticks.value
@@ -139,4 +148,7 @@ class ServingMetrics:
             "host_syncs": self.host_syncs.value,
             "host_syncs_per_tick":
                 round(self.host_syncs.value / ticks, 4) if ticks else None,
+            "model_flops_per_token":
+                self.model_flops_per_token.value or None,
+            "achieved_flops_per_sec": self.achieved_flops.value or None,
         }

@@ -103,6 +103,9 @@ class _Handler(BaseHTTPRequestHandler):
             # One scrape covers everything: the engine's private
             # serving_* registry plus the process-wide default registry
             # (training / elastic / eager / timeline families).
+            # Windowed gauges (achieved FLOP/s) refresh per scrape,
+            # not only when someone polls /stats.
+            engine.refresh_windowed_gauges()
             text = (engine.metrics.registry.to_prometheus()
                     + default_registry().to_prometheus())
             body = text.encode()

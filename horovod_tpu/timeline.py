@@ -27,6 +27,25 @@ from typing import Optional
 import jax
 
 
+def expand_rank_path(path: str, rank: Optional[int] = None) -> str:
+    """Substitute ``%r`` in a trace-file path with this process's rank
+    (``HOROVOD_RANK``, else the initialized context's process rank,
+    else 0) — so every rank of a multi-process run writes its own file
+    instead of all clobbering one (merge them afterwards with
+    ``python -m horovod_tpu.obs.merge``)."""
+    if "%r" not in path:
+        return path
+    if rank is None:
+        env = os.environ.get("HOROVOD_RANK")
+        if env not in (None, ""):
+            rank = int(env)
+        else:
+            from horovod_tpu import basics
+
+            rank = basics.process_rank() if basics.is_initialized() else 0
+    return path.replace("%r", str(rank))
+
+
 def _dropped_events_counter():
     """Create-or-fetch the process-wide dropped-events counter (shared
     by every Timeline instance; also seeded at init so /metrics exposes
@@ -42,6 +61,7 @@ def _dropped_events_counter():
 class Timeline:
     def __init__(self, path: str, *, pid: Optional[int] = None,
                  queue_size: int = 1 << 20) -> None:
+        path = expand_rank_path(path)
         self.path = path
         self.pid = pid if pid is not None else os.getpid()
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_size)

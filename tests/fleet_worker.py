@@ -1,0 +1,49 @@
+"""Fleet-observability worker: one rank of a supervised job that only
+*trains a pretend model* — `obs.training_step()` around a sleep — while
+the real code under test runs underneath: the WorkerNotificationManager
+publishes the structured heartbeat (step durations, step count) and the
+registry export over the rendezvous KV, and the driver aggregates them
+into /metrics + /fleet and flags the artificially slowed rank
+(FLEET_SLOW_RANK x FLEET_SLOW_FACTOR).
+
+Deliberately collective-free (like elastic_hang_worker.py): the fleet
+path is KV-and-HTTP only, so the test stays fast and native-lib-free.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ["REPO"])
+
+from horovod_tpu.elastic.worker import notification_manager  # noqa: E402
+from horovod_tpu.obs import training_step  # noqa: E402
+from horovod_tpu.obs.registry import default_registry  # noqa: E402
+
+rank = int(os.environ["HOROVOD_RANK"])
+step_s = float(os.environ.get("FLEET_STEP_S", "0.05"))
+if rank == int(os.environ.get("FLEET_SLOW_RANK", "-1")):
+    step_s *= float(os.environ.get("FLEET_SLOW_FACTOR", "5.0"))
+run_s = float(os.environ.get("FLEET_RUN_S", "6.0"))
+
+# A worker-local counter the driver's fleet view must SUM across ranks.
+items = default_registry().counter(
+    "fleet_test_items_total", "items processed by this rank",
+    exist_ok=True)
+# And a gauge it must roll up per-rank (min/median/max).
+pace = default_registry().gauge(
+    "fleet_test_step_pace_seconds", "configured step pace", exist_ok=True)
+pace.set(step_s)
+
+notification_manager.init()
+
+deadline = time.monotonic() + run_s
+steps = 0
+while time.monotonic() < deadline:
+    with training_step():
+        time.sleep(step_s)
+    items.inc(2)
+    steps += 1
+
+notification_manager.stop()
+print(f"FLEET-WORKER-OK rank={rank} steps={steps}", flush=True)

@@ -1,0 +1,777 @@
+"""Fleet observability (docs/observability.md "Fleet"): cross-rank
+metric aggregation, XLA cost/memory introspection + live MFU, straggler
+detection, per-rank trace paths, and the multi-rank timeline merge.
+
+The acceptance drill lives in TestFleetEndToEnd: a real multi-process
+job (ElasticDriver + tests/fleet_worker.py) publishes snapshots over
+the rendezvous KV; the driver's /metrics passes conftest's STRICT
+Prometheus parser with rank/host labels, counters summed and
+histograms merged, and an artificially slowed rank is flagged within a
+few steps — report-only.  Everything else is unit-level: merge
+semantics (incl. the typed bucket-mismatch error), percentile edge
+semantics, the xprof<->bench MFU equivalence, and the metrics-naming
+lint that keeps the docs catalog honest.
+"""
+
+import json
+import os
+import re
+import sys
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from horovod_tpu import serving
+from horovod_tpu import timeline as TL
+from horovod_tpu.models import transformer as T
+from horovod_tpu.obs import aggregate as AGG
+from horovod_tpu.obs import fleet as FLEET
+from horovod_tpu.obs import merge as MERGE
+from horovod_tpu.obs import registry as R
+from horovod_tpu.obs import tracing as TR
+from horovod_tpu.obs import training_step, xprof
+from horovod_tpu.runner.discovery import FixedHostDiscovery
+from horovod_tpu.runner.elastic_driver import ElasticDriver
+from horovod_tpu.runner.hosts import HostSpec
+
+from conftest import parse_prometheus_text  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLEET_WORKER = os.path.join(REPO, "tests", "fleet_worker.py")
+
+
+def _two_rank_registries():
+    regs = {}
+    for rank, (c, g, obs) in enumerate(((3, 1.0, (0.05, 0.5)),
+                                        (5, 3.0, (2.0,)))):
+        r = R.MetricsRegistry()
+        r.counter("reqs_total", "requests").inc(c)
+        r.gauge("occupancy", "slots").set(g)
+        h = r.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+        for v in obs:
+            h.observe(v)
+        fam = r.counter("errs_total", "errors", labels=("kind",))
+        fam.labels(kind="oom").inc(rank + 1)
+        regs[rank] = r
+    return regs
+
+
+class TestAggregate:
+    def test_counters_sum_gauges_roll_up_histograms_merge(self):
+        regs = _two_rank_registries()
+        agg = AGG.merge_exports(
+            {r: reg.export() for r, reg in regs.items()},
+            hosts={0: "host-a", 1: "host-b"})
+        snap = agg.snapshot()
+        assert snap["reqs_total"] == 8
+        assert snap["errs_total"] == {'kind="oom"': 3}
+        assert snap["occupancy"]["per_rank"] == {"0": 1.0, "1": 3.0}
+        assert snap["occupancy"]["min"] == 1.0
+        assert snap["occupancy"]["median"] == 2.0
+        assert snap["occupancy"]["max"] == 3.0
+        # bucket-wise histogram merge is exact: counts/sum/count add
+        hs = snap["lat_seconds"]
+        assert hs["count"] == 3
+        assert hs["buckets"] == {"0.1": 1, "1": 1, "+Inf": 1}
+        assert hs["sum"] == pytest.approx(2.55)
+
+    def test_prometheus_rank_host_labels_strict_parse(self):
+        regs = _two_rank_registries()
+        agg = AGG.merge_exports(
+            {r: reg.export() for r, reg in regs.items()},
+            hosts={0: "host-a", 1: "host-b"})
+        fams = parse_prometheus_text(agg.to_prometheus())
+        # counter: ONE fleet-summed sample, no rank label
+        (name, labels, v), = fams["reqs_total"]["samples"]
+        assert v == 8.0 and "rank" not in labels
+        # labeled counter family: summed per label-set
+        (_, labels, v), = fams["errs_total"]["samples"]
+        assert labels == {"kind": "oom"} and v == 3.0
+        # gauge: one series per rank with rank+host labels ...
+        series = {(l["rank"], l["host"]): v
+                  for _, l, v in fams["occupancy"]["samples"]}
+        assert series == {("0", "host-a"): 1.0, ("1", "host-b"): 3.0}
+        # ... plus min/median/max roll-up families
+        assert fams["occupancy_min"]["samples"][0][2] == 1.0
+        assert fams["occupancy_median"]["samples"][0][2] == 2.0
+        assert fams["occupancy_max"]["samples"][0][2] == 3.0
+        # merged histogram passes the parser's cumulative invariants
+        assert fams["lat_seconds"]["type"] == "histogram"
+
+    def test_bucket_mismatch_is_typed_error(self):
+        r1, r2 = R.MetricsRegistry(), R.MetricsRegistry()
+        r1.histogram("lat_seconds", buckets=(0.1, 1.0)).observe(0.2)
+        r2.histogram("lat_seconds", buckets=(0.2, 2.0)).observe(0.2)
+        with pytest.raises(AGG.BucketMismatchError):
+            AGG.merge_exports({0: r1.export(), 1: r2.export()}) \
+               .to_prometheus()
+
+    def test_kind_mismatch_is_typed_error(self):
+        r1, r2 = R.MetricsRegistry(), R.MetricsRegistry()
+        r1.counter("thing_total").inc()
+        r2.gauge("thing_total").set(2)
+        with pytest.raises(AGG.MergeConflictError):
+            AGG.merge_exports({0: r1.export(), 1: r2.export()})
+
+    def test_export_roundtrips_through_json(self):
+        """The wire format the workers publish: json.dumps/loads must
+        preserve merge results exactly."""
+        regs = _two_rank_registries()
+        direct = AGG.merge_exports(
+            {r: reg.export() for r, reg in regs.items()}).snapshot()
+        wired = AGG.merge_exports(
+            {r: json.loads(json.dumps(reg.export()))
+             for r, reg in regs.items()}).snapshot()
+        assert direct == wired
+
+
+class TestPercentileEdgeSemantics:
+    """Histogram.percentile reports bucket UPPER EDGES, and the +Inf
+    overflow reports the largest finite edge — fleet-merged p99s are
+    bucket estimates, not exact quantiles (docs/observability.md)."""
+
+    def test_values_land_on_upper_edges(self):
+        h = R.Histogram(buckets=(1.0, 2.0, 4.0))
+        h.observe(1.5)
+        assert h.percentile(0.5) == 2.0  # 1.5 reported as its edge
+        h2 = R.Histogram(buckets=(1.0, 2.0, 4.0))
+        h2.observe(2.0)  # exactly ON an edge belongs to that bucket
+        assert h2.percentile(1.0) == 2.0
+
+    def test_inf_bucket_reports_largest_finite_edge(self):
+        h = R.Histogram(buckets=(1.0, 2.0, 4.0))
+        h.observe(100.0)
+        # "at least 4", not "exactly 4": the overflow bucket cannot
+        # know how far past the top edge the tail went
+        assert h.percentile(0.99) == 4.0
+
+    def test_empty_and_q0(self):
+        h = R.Histogram(buckets=(1.0, 2.0))
+        assert h.percentile(0.5) is None
+        h.observe(1.5)
+        # smallest configured edge — a floor, not a minimum
+        assert h.percentile(0.0) == 1.0
+
+    def test_merged_histogram_same_semantics(self):
+        h1 = R.Histogram(buckets=(1.0, 2.0, 4.0))
+        h2 = R.Histogram(buckets=(1.0, 2.0, 4.0))
+        for _ in range(99):
+            h1.observe(0.5)
+        h2.observe(50.0)  # the fleet's one outlier, in +Inf
+        m = AGG.merged_histogram([h1.state(), h2.state()])
+        assert m.count == 100
+        assert m.percentile(0.5) == 1.0
+        assert m.percentile(0.995) == 4.0  # largest finite edge
+
+
+class TestXprof:
+    @pytest.fixture(scope="class")
+    def compiled(self):
+        f = jax.jit(lambda x: jnp.tanh(x @ x).sum())
+        return f.lower(jnp.ones((64, 64), jnp.float32)).compile()
+
+    def test_introspect_matches_hand_rolled_cost_analysis(self, compiled):
+        """The MFU-epsilon guard: introspect's FLOPs are EXACTLY what
+        bench.py's hand-rolled ca.get('flops') read, so switching
+        bench.py to xprof cannot move its reported MFU."""
+        report = xprof.introspect(compiled, fn="fleet_test_fn",
+                                  register=False)
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        assert report.flops == float(ca["flops"])
+        # and the MFU formula is bench.py's: flops / seconds / peak
+        assert xprof.mfu(report.flops, 0.01, peak=1e12) == \
+            pytest.approx(report.flops / 0.01 / 1e12)
+        assert xprof.mfu(report.flops, 0.01, peak=None) is None  # CPU
+        assert xprof.mfu(None, 0.01, peak=1e12) is None
+
+    def test_introspect_registers_gauges(self, compiled):
+        r = R.MetricsRegistry()
+        report = xprof.introspect(compiled, fn="gauged", registry=r)
+        fam = r.get("xla_flops")
+        assert fam.labels(fn="gauged").value == report.flops
+        if report.peak_hbm_bytes is not None:
+            assert r.get("xla_hbm_peak_bytes").labels(
+                fn="gauged").value == report.peak_hbm_bytes
+
+    def test_peak_hbm_positive_when_available(self, compiled):
+        report = xprof.introspect(compiled, fn="hbm", register=False)
+        if report.peak_hbm_bytes is not None:  # backend-dependent
+            assert report.peak_hbm_bytes > 0
+
+    def test_live_training_mfu_gauge(self, hvd):
+        """obs.training_step() sets training_mfu from the armed cost:
+        within epsilon of the bench-style flops/dt/peak computation."""
+        xprof.set_training_cost(5e9, peak=1e12)
+        try:
+            t0 = time.monotonic()
+            with training_step():
+                time.sleep(0.02)
+            dt = time.monotonic() - t0
+            gauge = R.training_metrics().mfu.value
+            assert gauge == pytest.approx(5e9 / dt / 1e12, rel=0.5)
+            assert R.training_metrics().last_step.value >= 0.02
+        finally:
+            xprof.set_training_cost(None)
+        # disarmed: the gauge stops updating but training_step still works
+        with training_step():
+            pass
+
+    def test_transformer_flops_per_token(self):
+        cfg = T.TransformerConfig(
+            vocab_size=32, d_model=16, n_heads=2, n_layers=1, d_ff=32,
+            max_seq=16, dtype=jnp.float32)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        fpt = xprof.transformer_flops_per_token(params)
+        import numpy as np
+
+        n_params = sum(int(np.prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(params))
+        embed = int(np.prod(params["embed"].shape))
+        assert fpt == 2.0 * (n_params - embed) > 0
+
+
+class TestServingAchievedFlops:
+    def test_stats_reports_achieved_flops(self):
+        cfg = T.TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=48, dtype=jnp.float32, attention_impl="reference",
+            n_kv_heads=2)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        fpt = 1e6
+        engine = serving.InferenceEngine(
+            params, cfg, serving.EngineConfig(
+                n_slots=2, max_len=40, min_prefill_bucket=4,
+                model_flops_per_token=fpt))
+        s0 = engine.stats()  # first sample: no window yet
+        assert s0["model_flops_per_token"] == fpt
+        t0 = time.monotonic()
+        fut = engine.submit([3, 4, 5], max_new_tokens=8)
+        for _ in range(100):
+            if fut.done():
+                break
+            engine.step()
+        toks = fut.result(timeout=0)
+        s1 = engine.stats()
+        dt = time.monotonic() - t0
+        assert s1["achieved_flops_per_sec"] == pytest.approx(
+            len(toks) * fpt / dt, rel=0.5)
+        # the gauges ride the engine's Prometheus registry too
+        fams = parse_prometheus_text(engine.metrics.registry.to_prometheus())
+        assert fams["serving_model_flops_per_token"]["samples"][0][2] == fpt
+        assert fams["serving_achieved_flops_per_sec"]["samples"][0][2] > 0
+
+    def test_unconfigured_stays_null(self):
+        m = serving.ServingMetrics()
+        assert m.snapshot()["model_flops_per_token"] is None
+        assert m.snapshot()["achieved_flops_per_sec"] is None
+
+    def test_http_metrics_scrape_refreshes_gauge(self):
+        """A Prometheus scraper that only ever hits GET /metrics (the
+        documented endpoint) must see a live achieved-FLOP/s value —
+        the windowed gauge refreshes per scrape, not only on /stats."""
+        cfg = T.TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=48, dtype=jnp.float32, attention_impl="reference",
+            n_kv_heads=2)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        engine = serving.InferenceEngine(
+            params, cfg, serving.EngineConfig(
+                n_slots=2, max_len=40, min_prefill_bucket=4,
+                model_flops_per_token=1e6))
+        with serving.ServingServer(engine, port=0) as srv:
+            base = "http://%s:%d" % srv.address
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10):
+                pass  # opens the rate window
+            req = urllib.request.Request(
+                base + "/generate",
+                data=json.dumps({"tokens": [3, 4],
+                                 "max_new_tokens": 6}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.status == 200
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=10) as r:
+                fams = parse_prometheus_text(r.read().decode())
+        assert fams["serving_achieved_flops_per_sec"][
+            "samples"][0][2] > 0
+
+    def _tiny_engine(self):
+        cfg = T.TransformerConfig(
+            vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+            max_seq=48, dtype=jnp.float32, attention_impl="reference",
+            n_kv_heads=2)
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        return serving.InferenceEngine(
+            params, cfg, serving.EngineConfig(
+                n_slots=2, max_len=40, min_prefill_bucket=4,
+                model_flops_per_token=1e6))
+
+    def test_metrics_swap_resets_rate_window(self):
+        """benchmarks/serving.py swaps in a fresh ServingMetrics after
+        warmup; the rate window must restart with the new counter or
+        the next sample computes (0 - old_tokens)/dt < 0."""
+        engine = self._tiny_engine()
+        engine.metrics.tokens_generated.inc(50_000)
+        engine.stats()  # window base: (t0, 50000) from the OLD metrics
+        engine.metrics = serving.ServingMetrics()
+        time.sleep(0.01)
+        s = engine.stats()  # counter restarted at 0
+        achieved = s["achieved_flops_per_sec"]
+        assert achieved is None or achieved >= 0
+
+    def test_concurrent_stats_scrapes(self):
+        """stats() is served from ThreadingHTTPServer handler threads;
+        concurrent scrapes must not corrupt the rate window (the
+        unlocked prune could empty the list -> IndexError)."""
+        import threading as _threading
+        engine = self._tiny_engine()
+        errs = []
+
+        def scrape():
+            try:
+                for _ in range(200):
+                    engine.stats()
+            except Exception as e:  # pragma: no cover - failure path
+                errs.append(e)
+
+        threads = [_threading.Thread(target=scrape) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errs == []
+
+
+class TestStragglerDetection:
+    def _beat(self, m, rank, step, step_s):
+        m.heartbeat(rank, f"host-{rank}",
+                    {"t": 0.0, "steps": step, "step_s": step_s})
+
+    def test_sustained_straggler_flagged_within_patience(self):
+        m = FLEET.FleetMonitor(straggler_threshold=2.0,
+                               straggler_patience=3)
+        m.begin_epoch(0)
+        for step in range(1, 6):
+            for rank, s in ((0, 0.1), (1, 0.1), (2, 0.5)):
+                self._beat(m, rank, step, s)
+            if step < 3:
+                assert m.stragglers() == []  # patience not yet met
+        assert m.stragglers() == ["2"]
+        assert m.skew == pytest.approx(5.0)
+        # ONE episode = ONE count, however long it persists
+        assert m.registry.get("elastic_straggler_total").labels(
+            rank="2").value == 1
+
+    def test_recovery_clears_flag_new_episode_counts_again(self):
+        m = FLEET.FleetMonitor(straggler_threshold=2.0,
+                               straggler_patience=2)
+        m.begin_epoch(0)
+        step = 0
+        for _ in range(3):
+            step += 1
+            for rank, s in ((0, 0.1), (1, 0.1), (2, 0.9)):
+                self._beat(m, rank, step, s)
+        assert m.stragglers() == ["2"]
+        step += 1
+        for rank in (0, 1, 2):
+            self._beat(m, rank, step, 0.1)  # rank 2 recovered
+        assert m.stragglers() == []
+        for _ in range(2):
+            step += 1
+            for rank, s in ((0, 0.1), (1, 0.1), (2, 0.9)):
+                self._beat(m, rank, step, s)
+        assert m.registry.get("elastic_straggler_total").labels(
+            rank="2").value == 2
+
+    def test_two_rank_fleet_can_flag(self):
+        """The suspect is compared against the median of the OTHER
+        ranks: with self included, slowest/median is bounded below 2x
+        on a 2-rank fleet and a 10x straggler could never be
+        flagged."""
+        m = FLEET.FleetMonitor(straggler_threshold=2.0,
+                               straggler_patience=2)
+        m.begin_epoch(0)
+        for step in range(1, 4):
+            for rank, s in ((0, 0.05), (1, 0.5)):
+                self._beat(m, rank, step, s)
+        assert m.stragglers() == ["1"]
+
+    def test_no_strike_without_fresh_step(self):
+        """Driver polls faster than steps complete: re-observing the
+        same heartbeat step count must not advance the strike count."""
+        m = FLEET.FleetMonitor(straggler_threshold=2.0,
+                               straggler_patience=2)
+        m.begin_epoch(0)
+        for _ in range(10):  # same steps value, many polls
+            for rank, s in ((0, 0.1), (1, 0.1), (2, 0.9)):
+                self._beat(m, rank, 1, s)
+        assert m.stragglers() == []  # only ONE fresh step observed
+
+    def test_epoch_turnover_resets_ranks_keeps_counters(self):
+        m = FLEET.FleetMonitor(straggler_threshold=2.0,
+                               straggler_patience=1)
+        m.begin_epoch(0)
+        for rank, s in ((0, 0.1), (2, 0.1), (1, 0.9)):
+            self._beat(m, rank, 1, s)
+        assert m.stragglers() == ["1"]
+        m.begin_epoch(1)
+        assert m.stragglers() == []
+        assert m.registry.get("elastic_straggler_total").labels(
+            rank="1").value == 1  # job-lifetime fact survives
+
+    def test_parse_heartbeat_legacy_and_structured(self):
+        assert FLEET.parse_heartbeat(b"1723456.789") == {"t": 1723456.789}
+        assert FLEET.parse_heartbeat(
+            b'{"t": 1.0, "steps": 4, "step_s": 0.25}') == {
+                "t": 1.0, "steps": 4, "step_s": 0.25}
+        assert FLEET.parse_heartbeat(b"not json") == {}
+
+    def test_fleet_json_view(self):
+        m = FLEET.FleetMonitor(straggler_patience=1)
+        m.begin_epoch(3)
+        r = R.MetricsRegistry()
+        r.counter("work_total").inc(7)
+        m.snapshot(0, "host-a", r.export())
+        m.heartbeat(0, "host-a", {"t": 0.0, "steps": 1, "step_s": 0.1})
+        fl = m.fleet_json()
+        assert fl["epoch"] == 3
+        assert fl["ranks"]["0"]["host"] == "host-a"
+        assert fl["ranks"]["0"]["has_metrics"] is True
+        assert fl["ranks"]["0"]["step_seconds"] == 0.1
+        assert fl["metrics"]["work_total"] == 7
+        assert fl["stragglers"] == []
+
+
+class TestTimelineMergeTool:
+    def _write_trace(self, path, pid, names, truncated=False):
+        evs = [{"name": n, "ph": "i", "s": "p", "ts": 100.0 + i,
+                "pid": pid, "tid": 0, "args": {}}
+               for i, n in enumerate(names)]
+        text = "[\n" + ",\n".join(json.dumps(e) for e in evs)
+        if not truncated:
+            text += "\n]\n"
+        with open(path, "w") as f:
+            f.write(text)
+
+    def test_merge_remaps_pids_and_labels_ranks(self, tmp_path):
+        a = str(tmp_path / "trace.rank0.json")
+        b = str(tmp_path / "trace.rank1.json")
+        self._write_trace(a, pid=4242, names=["step_a1", "step_a2"])
+        # rank 1 killed mid-run: truncated file must still merge
+        self._write_trace(b, pid=4242, names=["step_b1"], truncated=True)
+        out = str(tmp_path / "merged.json")
+        assert MERGE.main([out, a, b]) == 0
+        events = json.load(open(out))
+        by_name = {e["name"]: e for e in events if e["ph"] == "i"}
+        # the same original pid lands on DISTINCT per-rank tracks
+        assert by_name["step_a1"]["pid"] != by_name["step_b1"]["pid"]
+        assert by_name["step_a1"]["pid"] == by_name["step_a2"]["pid"]
+        # process_name metadata labels each track by rank
+        meta = {e["pid"]: e["args"]["name"] for e in events
+                if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert meta[by_name["step_a1"]["pid"]] == "rank 0"
+        assert meta[by_name["step_b1"]["pid"]] == "rank 1"
+        # timestamps untouched (shared monotonic clock)
+        assert by_name["step_a1"]["ts"] == 100.0
+
+    def test_merge_real_timeline_files(self, tmp_path):
+        """End-to-end over the REAL writer: two Timeline instances (as
+        two ranks would produce with %r paths) merge into one Perfetto
+        file with one distinct pid track per rank."""
+        paths = []
+        for rank in (0, 1):
+            p = str(tmp_path / f"tl.rank{rank}.json")
+            tl = TL.Timeline(p)
+            tl.instant(f"from_rank_{rank}")
+            tl.close()
+            paths.append(p)
+        out = str(tmp_path / "merged.json")
+        assert MERGE.main([out] + paths) == 0
+        events = json.load(open(out))
+        pids = {e["pid"] for e in events
+                if e["name"].startswith("from_rank_")}
+        assert len(pids) == 2
+
+    def test_same_file_different_spellings_merged_once(self, tmp_path,
+                                                       monkeypatch):
+        """Input dedup is on the resolved path, not the raw argv
+        string — a glob plus an explicit spelling of the same file
+        must not yield two identical rank tracks."""
+        monkeypatch.chdir(tmp_path)
+        self._write_trace(str(tmp_path / "t0.json"), pid=7,
+                          names=["only_once"])
+        out = str(tmp_path / "merged.json")
+        assert MERGE.main([out, "t0.json", "./t0.json",
+                           str(tmp_path / "t0.json")]) == 0
+        events = json.load(open(out))
+        assert len([e for e in events if e["name"] == "only_once"]) == 1
+
+    def test_empty_and_garbage_inputs_skipped(self, tmp_path, capsys):
+        """A rank SIGKILLed before its first flush (0-byte file) or a
+        mid-write garbage file must not cost the healthy ranks their
+        merged view."""
+        good = str(tmp_path / "tl.rank0.json")
+        self._write_trace(good, pid=1, names=["kept"])
+        empty = str(tmp_path / "tl.rank1.json")
+        open(empty, "w").close()
+        garbage = str(tmp_path / "tl.rank2.json")
+        with open(garbage, "w") as f:
+            f.write("[{{{{ not json")
+        out = str(tmp_path / "merged.json")
+        assert MERGE.main([out, good, empty, garbage]) == 0
+        events = json.load(open(out))
+        assert [e["name"] for e in events if e["ph"] == "i"] == ["kept"]
+        assert "skipped" in capsys.readouterr().err
+
+    def test_missing_input_skipped(self, tmp_path, capsys):
+        """A deleted dead-rank file or an unmatched glob (kept as a
+        literal path) must be skipped like garbage, not abort the
+        merge of the healthy ranks."""
+        good = str(tmp_path / "tl.rank0.json")
+        self._write_trace(good, pid=1, names=["kept"])
+        gone = str(tmp_path / "tl.rank1.json")  # never written
+        unmatched = str(tmp_path / "other" / "tl.*.json")
+        out = str(tmp_path / "merged.json")
+        assert MERGE.main([out, good, gone, unmatched]) == 0
+        events = json.load(open(out))
+        assert [e["name"] for e in events if e["ph"] == "i"] == ["kept"]
+        assert capsys.readouterr().err.count(": skipped (") == 2
+
+    def test_all_inputs_unreadable_fails_without_output(self, tmp_path,
+                                                        capsys):
+        """Zero readable events -> non-zero exit and NO empty merged
+        file masquerading as a successful merge."""
+        out = str(tmp_path / "merged.json")
+        assert MERGE.main([out, str(tmp_path / "nope.json")]) == 1
+        assert not os.path.exists(out)
+        assert "no readable trace events" in capsys.readouterr().err
+
+    def test_wildcard_bind_reports_reachable_address(self, monkeypatch):
+        """A 0.0.0.0 bind is reported as a connectable host — the
+        documented way to learn the port with --metrics-port 0."""
+        monkeypatch.setenv("HOROVOD_HOSTNAME", "scrape-me.example")
+        srv = FLEET.FleetServer(FLEET.FleetMonitor(), host="0.0.0.0",
+                                port=0).start()
+        try:
+            host, port = srv.address
+            assert host == "scrape-me.example"
+            assert port > 0
+        finally:
+            srv.stop()
+
+    def test_mid_object_truncation_repaired(self, tmp_path):
+        """Buffered IO means a SIGKILL cuts the file at an arbitrary
+        byte — the partial trailing event is dropped, complete ones
+        survive."""
+        p = str(tmp_path / "cut.rank0.json")
+        self._write_trace(p, pid=1, names=["kept1", "kept2", "lost"])
+        text = open(p).read()
+        cut = text.rindex('"lost"') + 3  # mid-string, mid-object
+        with open(p, "w") as f:
+            f.write(text[:cut])
+        events = MERGE.load_trace(p)
+        assert [e["name"] for e in events] == ["kept1", "kept2"]
+
+    def test_percent_r_filenames_label_correctly(self):
+        """The %r path style (tl.0.json ... tl.11.json) has no 'rank'
+        in the name: the trailing number is the rank, NOT the
+        lexicographic glob position (which would call tl.10.json
+        'rank 2')."""
+        assert MERGE._label_for("/x/tl.10.json", 2) == "rank 10"
+        assert MERGE._label_for("/x/tl.2.json", 4) == "rank 2"
+        assert MERGE._label_for("/x/trace.rank7.json", 0) == "rank 7"
+        assert MERGE._label_for("/x/nonumber.json", 3) == "rank 3"
+
+    def test_align_start_rezeroes(self, tmp_path):
+        a = str(tmp_path / "r0.json")
+        self._write_trace(a, pid=1, names=["x"])
+        out = str(tmp_path / "m.json")
+        assert MERGE.main([out, a, "--align-start"]) == 0
+        events = json.load(open(out))
+        ev = next(e for e in events if e["name"] == "x")
+        assert ev["ts"] == 0.0
+
+
+class TestRankPathSubstitution:
+    def test_expand_rank_path(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_RANK", "7")
+        assert TL.expand_rank_path("/tmp/t.%r.json") == "/tmp/t.7.json"
+        assert TL.expand_rank_path("/tmp/plain.json") == "/tmp/plain.json"
+        assert TL.expand_rank_path("/tmp/t.%r.json", rank=3) == \
+            "/tmp/t.3.json"
+        monkeypatch.delenv("HOROVOD_RANK")
+        # falls back to the initialized context / 0
+        assert TL.expand_rank_path("t.%r.json").endswith(".json")
+
+    def test_timeline_writes_per_rank_file(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_RANK", "2")
+        tl = TL.Timeline(str(tmp_path / "tl.%r.json"))
+        tl.instant("hi")
+        tl.close()
+        assert (tmp_path / "tl.2.json").exists()
+        assert not (tmp_path / "tl.%r.json").exists()
+
+    def test_tracer_jsonl_per_rank(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOROVOD_RANK", "5")
+        assert TR.get() is None
+        t = TR.start(str(tmp_path / "tr.%r.json"),
+                     jsonl_path=str(tmp_path / "tr.%r.jsonl"))
+        try:
+            t.log_event({"event": "x"})
+        finally:
+            TR.stop()
+        assert (tmp_path / "tr.5.json").exists()
+        assert (tmp_path / "tr.5.jsonl").exists()
+
+
+class TestMetricsNamingLint:
+    """CI self-check (the metrics catalog stays honest): every family
+    registered in any known registry matches the Prometheus naming
+    convention and is documented in docs/observability.md."""
+
+    NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+    def test_every_family_named_and_documented(self, hvd):
+        # force the lazily-registered introspection gauges into being
+        c = jax.jit(lambda x: x * 2).lower(jnp.ones((8,))).compile()
+        xprof.introspect(c, fn="lint")
+        R.default_registry().gauge(
+            "xla_hbm_peak_bytes", "", labels=("fn",), exist_ok=True)
+        registries = {
+            "default": R.default_registry(),
+            "serving": serving.ServingMetrics().registry,
+            "fleet": FLEET.FleetMonitor().registry,
+        }
+        docs = open(os.path.join(REPO, "docs", "observability.md")).read()
+        problems = []
+        for scope, reg in registries.items():
+            for name in reg.names():
+                if not self.NAME_RE.match(name):
+                    problems.append(
+                        f"{scope}:{name} violates ^[a-z][a-z0-9_]*$")
+                if name not in docs:
+                    problems.append(
+                        f"{scope}:{name} missing from "
+                        f"docs/observability.md catalog")
+        assert not problems, "\n".join(problems)
+
+
+class TestDriverFleetResilience:
+    def test_metrics_port_conflict_does_not_fail_training(self):
+        """Observability failing must not fail training: a taken
+        metrics port logs a warning and the job runs on without the
+        scrape endpoint (and the rendezvous server is still torn
+        down cleanly)."""
+        import socket
+
+        blocker = socket.socket()
+        blocker.bind(("0.0.0.0", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        try:
+            d = ElasticDriver(
+                ["x"], FixedHostDiscovery([HostSpec("localhost-a", 1)]),
+                min_np=1, metrics_port=port,
+                _executor=lambda cmd, env=None, **kw: 0,
+                _sleep=lambda s: None)
+            assert d.run() == 0
+            assert d.fleet_address is None
+        finally:
+            blocker.close()
+
+
+class TestFleetEndToEnd:
+    """The acceptance drill: 3 real worker processes publish snapshots
+    + step durations over the rendezvous KV; the driver serves ONE
+    aggregated rank/host-labeled Prometheus scrape (strict-parser
+    clean) and flags the artificially slowed rank — report-only, the
+    job still succeeds."""
+
+    def test_fleet_scrape_and_straggler_flagging(self, tmp_path):
+        env = {
+            "PATH": os.environ.get("PATH", ""),
+            "REPO": REPO,
+            "FLEET_STEP_S": "0.05",
+            "FLEET_SLOW_RANK": "1",
+            "FLEET_SLOW_FACTOR": "6.0",
+            "FLEET_RUN_S": "8.0",
+        }
+        d = ElasticDriver(
+            [sys.executable, FLEET_WORKER],
+            FixedHostDiscovery([HostSpec("localhost-a", 1),
+                                HostSpec("localhost-b", 1),
+                                HostSpec("localhost-c", 1)]),
+            min_np=3, env=env,
+            heartbeat_interval=0.25,
+            metrics_port=0,
+            straggler_threshold=2.0, straggler_patience=2,
+            output_filename=str(tmp_path / "out"))
+        result = {}
+        t = threading.Thread(target=lambda: result.update(rc=d.run()),
+                             daemon=True)
+        t.start()
+        try:
+            deadline = time.monotonic() + 60
+            while d.fleet_address is None:
+                assert time.monotonic() < deadline, "fleet server not up"
+                time.sleep(0.05)
+            base = "http://%s:%d" % d.fleet_address
+
+            def _get(path):
+                with urllib.request.urlopen(base + path, timeout=10) as r:
+                    return r.read().decode()
+
+            # Poll until all 3 ranks report metrics AND the slow rank
+            # is flagged (workers pay a few seconds of import first).
+            fl = None
+            while time.monotonic() < deadline:
+                fl = json.loads(_get("/fleet"))
+                ready = (len(fl["ranks"]) == 3
+                         and all(st["has_metrics"]
+                                 for st in fl["ranks"].values())
+                         and fl["stragglers"])
+                if ready:
+                    break
+                time.sleep(0.25)
+            assert fl is not None and len(fl["ranks"]) == 3, fl
+            assert fl["stragglers"] == ["1"], fl
+            assert fl["ranks"]["1"]["straggler"] is True
+            assert fl["ranks"]["1"]["host"] == "localhost-b"
+            assert fl["step_time_skew"] > 2.0
+
+            # The fleet scrape: strict-parser clean, rank/host labeled.
+            fams = parse_prometheus_text(_get("/metrics"))
+            # histograms merged bucket-wise across ranks
+            assert fams["training_step_seconds"]["type"] == "histogram"
+            count = next(v for n, l, v
+                         in fams["training_step_seconds"]["samples"]
+                         if n == "training_step_seconds_count")
+            assert count > 0
+            # counters summed (worker increments by 2 per step)
+            (_, labels, items), = \
+                fams["fleet_test_items_total"]["samples"]
+            assert "rank" not in labels and items > 0 and items % 2 == 0
+            # gauges per-rank with rank+host labels + roll-ups
+            series = {l["rank"]: (l["host"], v) for _, l, v
+                      in fams["training_last_step_seconds"]["samples"]}
+            assert set(series) == {"0", "1", "2"}
+            assert series["2"][0] == "localhost-c"
+            assert "training_last_step_seconds_median" in fams
+            # the straggler counter + skew gauge ride the same scrape
+            assert any(l.get("rank") == "1" and v >= 1 for _, l, v
+                       in fams["elastic_straggler_total"]["samples"])
+            assert fams["elastic_step_time_skew"]["samples"][0][2] > 2.0
+            assert fams["fleet_ranks_reporting"]["samples"][0][2] == 3
+        finally:
+            t.join(timeout=60)
+        assert not t.is_alive(), "driver did not finish"
+        # report-only: the slowed rank was flagged, NOT evicted
+        assert result.get("rc") == 0
+        assert d.blacklist.hosts() == []
+        assert d.epoch_sizes == [3]
