@@ -16,8 +16,12 @@ load shape a static-batch number can't see — and reports tok/s,
 p50/p99 TTFT, and mean slot occupancy next to a static-batch decode
 reference at B = n_slots, PLUS the EngineConfig.overlap A/B
 (steady-state decode tok/s, pipelined vs synchronous, identical
-workload) and the pipeline phase metrics (overlap_efficiency =
-device-wait share of the tick, host_syncs_per_tick):
+workload), the EngineConfig.paged A/B (decode tok/s and max concurrent
+mixed-length requests at a fixed HBM budget, page pool vs the
+slot-contiguous baseline, with kv_bytes_per_token and the page-pool
+high-water mark in the JSON line) and the pipeline phase metrics
+(overlap_efficiency = device-wait share of the tick,
+host_syncs_per_tick):
 
     python benchmarks/serving.py --engine [--slots 8] [--arrival-rate 4]
 """
@@ -141,8 +145,8 @@ def _ab_decode(args, cfg, params):
                 dt = time.perf_counter() - t0
                 if full and eng.slots.active_count == S:
                     dts.append(dt)  # a pure steady-state decode step
-            toks[name] = toks.get(name, 0) + sum(
-                len(f.tokens_so_far()) for f in futs)
+            toks.setdefault(name, []).extend(
+                f.tokens_so_far() for f in futs)
 
     # p25, not mean/median: host noise is one-sided (a preempted tick
     # is only ever SLOWER), so a low percentile estimates the clean
@@ -156,6 +160,102 @@ def _ab_decode(args, cfg, params):
         "overlap_decode_speedup": round(q["sync"] / q["overlap"], 3),
         "equal_output_tokens": toks["overlap"] == toks["sync"],
         "ab_steps_sampled": {n: len(d) for n, (_, d) in engines.items()},
+    }
+
+
+def _ab_paged(args, cfg, params):
+    """The EngineConfig.paged A/B (docs/serving.md "Paged KV cache"):
+
+    1. Steady-state decode tok/s, paged pool vs the slot-contiguous
+       baseline on the IDENTICAL workload, reps interleaved and
+       compared at the per-tick p25 exactly like :func:`_ab_decode`.
+       The page-table gather is indirection the contiguous layout does
+       not pay, so a ratio near 1.0 is the goal — the paged win is the
+       byte/concurrency column, not this one.
+    2. Max concurrent requests at a FIXED HBM budget of cache tokens
+       (2 worst-case slots' worth): the slot-contiguous layout admits
+       ``budget // max_len`` requests no matter their actual length —
+       that ceiling is the layout, not a measurement — while the paged
+       engine admits short mixed-length requests page by page until
+       the same bytes are genuinely full.
+    """
+    from horovod_tpu import serving
+
+    S = args.slots
+    prompt = np.random.default_rng(3).integers(
+        0, cfg.vocab_size, max(args.prompt_len // 2, 1)).tolist()
+    engines = {}
+    for name, paged in (("paged", True), ("unpaged", False)):
+        eng = serving.InferenceEngine(
+            params, cfg, serving.EngineConfig(
+                n_slots=S, max_len=cfg.max_seq,
+                max_prefills_per_tick=args.max_prefills_per_tick,
+                max_queue_depth=max(2 * S, 8), paged=paged))
+        eng.warmup([len(prompt)])
+        engines[name] = (eng, [])
+
+    toks = {}
+    steps = max(min(max(args.steps, 24), cfg.max_seq - len(prompt) + 1), 1)
+    for _ in range(max(args.iters, 4)):
+        for name, (eng, dts) in engines.items():
+            futs = [eng.submit(prompt, max_new_tokens=steps)
+                    for _ in range(S)]
+            while not all(f.done() for f in futs):
+                full = eng.slots.active_count == S
+                t0 = time.perf_counter()
+                eng.step()
+                dt = time.perf_counter() - t0
+                if full and eng.slots.active_count == S:
+                    dts.append(dt)
+            # The SEQUENCES, not counts (counts are equal by
+            # construction — every future runs to max_new_tokens):
+            # this is the benchmark's live token-identity check.
+            toks.setdefault(name, []).extend(
+                f.tokens_so_far() for f in futs)
+    q = {name: float(np.percentile(dts, 25))
+         for name, (_, dts) in engines.items()}
+
+    # -- fixed-HBM-budget concurrency ------------------------------------
+    ps = 16
+    max_len = cfg.max_seq
+    budget_tokens = 2 * max_len  # two worst-case slots' worth of bytes
+    unpaged_ceiling = budget_tokens // max_len
+    rng = np.random.default_rng(4)
+    n_req = 2 * S
+    # Short mixed-length requests (~one page each): the traffic shape
+    # the contiguous layout wastes a full max_len reservation on.
+    frag_prompts = [rng.integers(0, cfg.vocab_size,
+                                 int(n)).tolist()
+                    for n in rng.integers(max(ps // 4, 1),
+                                          ps // 2 + 1, n_req)]
+    eng = serving.InferenceEngine(
+        params, cfg, serving.EngineConfig(
+            n_slots=S, max_len=max_len, page_size=ps,
+            n_pages=budget_tokens // ps, max_prefills_per_tick=S,
+            max_queue_depth=n_req))
+    eng.warmup(sorted({eng._bucket(len(p)) for p in frag_prompts}))
+    futs = [eng.submit(p, max_new_tokens=ps // 4) for p in frag_prompts]
+    peak = 0
+    while not all(f.done() for f in futs):
+        eng.step()
+        peak = max(peak, eng.slots.active_count)
+    preempted = 0
+    for f in futs:
+        try:
+            f.result(timeout=0)
+        except serving.CacheOutOfPagesError:
+            preempted += 1
+
+    return {
+        "decode_tok_s_paged": round(S / q["paged"], 2),
+        "decode_tok_s_unpaged": round(S / q["unpaged"], 2),
+        "paged_decode_ratio": round(q["unpaged"] / q["paged"], 3),
+        "paged_equal_output_tokens": toks["paged"] == toks["unpaged"],
+        "fixed_budget_tokens": budget_tokens,
+        "max_concurrent_paged": peak,
+        "max_concurrent_unpaged": unpaged_ceiling,
+        "fixed_budget_preempted": preempted,
+        "fixed_budget_pages_high_water": eng.slots.pages_high_water,
     }
 
 
@@ -261,6 +361,7 @@ def _engine_mode(args, T, cfg, params) -> None:
 
         obs_tracing.stop()
     ab = None if args.overlap_only else _ab_decode(args, cfg, params)
+    pab = None if args.overlap_only else _ab_paged(args, cfg, params)
     tab = None if args.overlap_only else _ab_tracing(args, cfg, params)
 
     engine, snap = over["engine"], over["snap"]
@@ -291,6 +392,14 @@ def _engine_mode(args, T, cfg, params) -> None:
         "tick_host_mean_s": snap["tick_host_seconds"]["mean"],
         "model_flops_per_token": snap["model_flops_per_token"],
         "achieved_flops_per_sec": snap["achieved_flops_per_sec"],
+        # Page-pool pressure for the (paged-by-default) open-loop run:
+        # per-token cache cost, pool size, and the high-water mark that
+        # sizes n_pages for this traffic shape.
+        "paged": snap["paged"],
+        "kv_bytes_per_token": snap["kv_bytes_per_token"],
+        "kv_pages_total": snap["kv_pages_total"],
+        "kv_pages_high_water": snap.get("kv_pages_high_water"),
+        "kv_page_size": snap.get("page_size"),
         "chip": jax.devices()[0].device_kind,
         # The full registry snapshot rides the JSON line so BENCH_r*
         # artifacts carry the observability data (counters, gauges,
@@ -302,6 +411,8 @@ def _engine_mode(args, T, cfg, params) -> None:
         result["trace_jsonl"] = args.trace + ".jsonl"
     if ab is not None:
         result.update(ab)
+    if pab is not None:
+        result.update(pab)
     if tab is not None:
         result.update(tab)
 
@@ -346,6 +457,13 @@ def _engine_mode(args, T, cfg, params) -> None:
         print(f"A/B      steady decode {ab['decode_tok_s_overlap']:9.1f} "
               f"tok/s overlapped vs {ab['decode_tok_s_sync']:9.1f} sync "
               f"-> {ab['overlap_decode_speedup']}x")
+    if pab is not None:
+        print(f"paged    steady decode {pab['decode_tok_s_paged']:9.1f} "
+              f"tok/s paged vs {pab['decode_tok_s_unpaged']:9.1f} "
+              f"contiguous -> {pab['paged_decode_ratio']}x | "
+              f"{pab['fixed_budget_tokens']}-token budget holds "
+              f"{pab['max_concurrent_paged']} concurrent paged vs "
+              f"{pab['max_concurrent_unpaged']} slot-contiguous")
     if tab is not None:
         print(f"tracing  {tab['decode_tok_s_tracing']:9.1f} tok/s traced "
               f"vs {tab['decode_tok_s_notracing']:9.1f} untraced -> "

@@ -681,6 +681,239 @@ def decode_step_slots(params: Dict, tokens_t, cache: Dict,
     }
 
 
+# --- paged KV cache (block tables resolved inside the tick) -------------------
+
+
+_KV_QUANT_EPS = 1e-8
+
+
+def kv_quantize(x):
+    """Symmetric per-vector int8 quantization over the trailing head
+    dim (the KIVI/KVQuant-style per-token granularity): each ``(..., Dh)``
+    vector gets its own f32 scale, so a later write never has to
+    re-quantize earlier positions — the scale is written once, in the
+    same scatter as the int8 payload, and write-before-attend carries
+    over to quantized pages unchanged.  Returns ``(q int8, scale f32)``
+    with ``scale`` lacking the trailing dim."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, _KV_QUANT_EPS) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def kv_dequantize(q, scale, dtype):
+    """Inverse of :func:`kv_quantize`: ``q * scale`` cast to ``dtype``."""
+    return (q.astype(jnp.float32)
+            * scale[..., None].astype(jnp.float32)).astype(dtype)
+
+
+def _gather_pages(pool_l, table):
+    """Resolve one layer's page pool through a page table: ``pool_l``
+    ``(P, H_kv, page, Dh)`` gathered by ``table`` ``(S, max_pages)`` ->
+    the per-slot LOGICAL cache ``(S, H_kv, max_pages * page, Dh)``.
+    The table is DATA (int32 indices), so the gather is one executable
+    for every allocation pattern — pages can come, go, grow, and be
+    shared without recompiling the tick."""
+    S, max_pages = table.shape
+    _, Hkv, ps, Dh = pool_l.shape
+    g = pool_l[table]                      # (S, max_pages, H_kv, ps, Dh)
+    return jnp.moveaxis(g, 1, 2).reshape(S, Hkv, max_pages * ps, Dh)
+
+
+def _gather_scales(scale_l, table):
+    """Scale companion of :func:`_gather_pages`: ``(P, H_kv, page)`` ->
+    ``(S, H_kv, max_pages * page)``."""
+    S, max_pages = table.shape
+    _, Hkv, ps = scale_l.shape
+    g = scale_l[table]                     # (S, max_pages, H_kv, ps)
+    return jnp.moveaxis(g, 1, 2).reshape(S, Hkv, max_pages * ps)
+
+
+def _attention_decode_paged(x, p, cfg: TransformerConfig, k_pool, v_pool,
+                            k_scale, v_scale, table, pos, active):
+    """Per-slot one-token attention against a PAGED cache: row ``s``
+    writes its K/V at logical position ``pos[s]`` — resolved through
+    the page table to ``(page table[s, pos//page], offset pos%page)`` —
+    then gathers its pages back into logical order and attends
+    positions ``<= pos[s]`` (the shared :func:`_cache_attend` math).
+
+    Inactive rows are routed to physical page 0, the reserved NULL/
+    trash page no live slot's table ever maps below its own position:
+    unlike the slot-contiguous layout, a stale write here could land in
+    a page that has since been re-granted or shared, so the inactive
+    scribble is not merely harmless-by-overwrite — it must be (and is)
+    aimed somewhere no one attends.  Active rows never collide: the
+    host allocator guarantees every active slot's write page is
+    PRIVATE (refcount 1; copy-on-write splits a shared page before any
+    write targets it).
+
+    ``k_scale``/``v_scale`` are the per-(head, position) f32 scales of
+    int8 pools (None for bf16/f32 storage): the payload is dequantized
+    AFTER the gather, so only the logical view — not the whole pool —
+    is ever materialized at compute dtype."""
+    S = x.shape[0]
+    max_pages = table.shape[1]
+    ps = k_pool.shape[2]
+    quantized = k_scale is not None
+    qh, k_t, v_t = _qkv_proj(x, p, cfg, positions=pos[:, None])
+    k_t1 = k_t[:, :, 0, :]                      # (S, H_kv, Dh)
+    v_t1 = v_t[:, :, 0, :]
+    idx = jnp.clip(pos // ps, 0, max_pages - 1)
+    phys = jnp.where(active, table[jnp.arange(S), idx], 0)
+    off = pos % ps
+    if quantized:
+        qk, sk = kv_quantize(k_t1)
+        qv, sv = kv_quantize(v_t1)
+        k_pool = k_pool.at[phys, :, off, :].set(qk)
+        v_pool = v_pool.at[phys, :, off, :].set(qv)
+        k_scale = k_scale.at[phys, :, off].set(sk)
+        v_scale = v_scale.at[phys, :, off].set(sv)
+        kg = kv_dequantize(_gather_pages(k_pool, table),
+                           _gather_scales(k_scale, table), cfg.dtype)
+        vg = kv_dequantize(_gather_pages(v_pool, table),
+                           _gather_scales(v_scale, table), cfg.dtype)
+    else:
+        k_pool = k_pool.at[phys, :, off, :].set(k_t1.astype(k_pool.dtype))
+        v_pool = v_pool.at[phys, :, off, :].set(v_t1.astype(v_pool.dtype))
+        kg = _gather_pages(k_pool, table)
+        vg = _gather_pages(v_pool, table)
+    T = max_pages * ps
+    mask = lax.broadcasted_iota(jnp.int32, (T,), 0)[None, :] <= pos[:, None]
+    o = _cache_attend(qh, kg, vg, mask[:, None, None, :])
+    return (_out_proj(o.astype(cfg.dtype), p, cfg),
+            k_pool, v_pool, k_scale, v_scale)
+
+
+def decode_step_paged(params: Dict, tokens_t, pool: Dict, table,
+                      cfg: TransformerConfig, active):
+    """One continuous-batching decode tick over a PAGED KV cache.
+
+    ``pool``: the page pool (:func:`horovod_tpu.serving.cache.
+    init_page_pool`) — ``k``/``v`` shaped ``(L, P, H_kv, page, Dh)``
+    (plus ``k_scale``/``v_scale`` ``(L, P, H_kv, page)`` for int8
+    storage) and per-slot ``pos`` ``(S,)``; ``table``: ``(S,
+    max_pages)`` int32 page ids, logical position ``t`` of slot ``s``
+    living at ``(table[s, t // page], t % page)``.  Shapes are static
+    in S, P, and max_pages; the table and the live mask are DATA, so
+    ONE compiled executable serves every allocation pattern — requests
+    coming, going, growing pages, and sharing prefix pages never
+    recompile the tick (the paged analogue of
+    :func:`decode_step_slots`, whose per-row logits it matches exactly
+    for any table that lays the slot's positions out in order).
+
+    Returns ``(logits (S, V) float32, updated pool)`` — the table is
+    host-owned and passed back unchanged."""
+    pos = pool["pos"]
+    T_cap = table.shape[1] * pool["k"].shape[3]
+    if not isinstance(pos, jax.core.Tracer) and not isinstance(
+            active, jax.core.Tracer):
+        over = np.asarray(active) & (np.asarray(pos) >= T_cap)
+        if over.any():
+            raise ValueError(
+                f"decode_step_paged past table capacity (slots "
+                f"{np.nonzero(over)[0].tolist()} at pos >= {T_cap}); "
+                "init_page_pool with more pages per slot")
+    x = params["embed"].astype(cfg.dtype)[tokens_t][:, None]  # (S, 1, D)
+    x = jnp.where(active[:, None, None], x, jnp.zeros_like(x))
+    quantized = "k_scale" in pool
+
+    def layer(x, inp):
+        if quantized:
+            p, k_c, v_c, ks_c, vs_c = inp
+        else:
+            (p, k_c, v_c), ks_c, vs_c = inp, None, None
+        h, k_new, v_new, ks_new, vs_new = _attention_decode_paged(
+            _rmsnorm(x, p["ln1"]), p, cfg, k_c, v_c, ks_c, vs_c,
+            table, pos, active)
+        out = (k_new, v_new) + ((ks_new, vs_new) if quantized else ())
+        return _mlp_block(x + h, p, cfg, moe_impl="dense"), out
+
+    xs = (params["layers"], pool["k"], pool["v"])
+    if quantized:
+        xs = xs + (pool["k_scale"], pool["v_scale"])
+    x, new = lax.scan(layer, x, xs)
+    logits = _lm_head(x, params["ln_f"], params["head"], cfg)
+    out = {"k": new[0], "v": new[1],
+           "pos": pos + active.astype(jnp.int32)}
+    if quantized:
+        out["k_scale"], out["v_scale"] = new[2], new[3]
+    return logits[:, 0], out
+
+
+def prefill_with_prefix(params: Dict, suffix, prefix_k, prefix_v,
+                        prefix_len, cfg: TransformerConfig, *,
+                        true_len, moe_impl: str = "dropless"):
+    """Prefill a (K, S0) SUFFIX whose first ``prefix_len`` logical
+    positions already exist as cached K/V — the prefix-sharing prefill:
+    a registered system prompt is prefilled ONCE, and every request
+    that starts with it runs only its suffix through the model,
+    attending the shared prefix K/V read back from its (refcounted)
+    pages.
+
+    ``prefix_k``/``prefix_v``: ``(L, H_kv, P0, Dh)`` with ``P0 >=
+    prefix_len`` (page-granular gathers round up; positions ``>=
+    prefix_len`` are masked out, so page-tail junk is inert), shared by
+    every row.  ``true_len``: ``(K,)`` per-row REAL suffix token counts
+    (rows are right-padded to the bucket S0).  Suffix queries sit at
+    global positions ``prefix_len + i`` (RoPE) and attend the full
+    prefix plus their causal suffix span.  Returns ``(last-real-
+    position logits (K, V), {"k": (L, K, H_kv, S0, Dh), "v": ...,
+    "pos": prefix_len + true_len})`` — the suffix K/V for page landing,
+    exactly :func:`prefill`'s contract shifted by the prefix.
+
+    Position-wise the suffix K/V (and logits) match a full-prompt
+    :func:`prefill` bit-for-bit at f32: K/V at a position depend only
+    on the tokens at and before it, and the shared math
+    (``_qkv_proj`` / ``_cache_attend``-style grouped attention /
+    ``_mlp_block`` / ``_lm_head``) is the same code."""
+    K, S0 = suffix.shape
+    P0 = prefix_k.shape[2]
+    p0 = jnp.asarray(prefix_len, jnp.int32)
+    true_len = jnp.asarray(true_len, jnp.int32)
+    positions = p0 + jnp.arange(S0, dtype=jnp.int32)
+    x = params["embed"].astype(cfg.dtype)[suffix]
+    H, Hkv, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    G = H // Hkv
+    # (S0, P0 + S0) mask: the real prefix is fully visible, page-tail
+    # junk (>= p0) never, and the suffix is causal within itself.
+    pre_vis = lax.broadcasted_iota(jnp.int32, (P0,), 0)[None, :] < p0
+    pre_vis = jnp.broadcast_to(pre_vis, (S0, P0))
+    suf_vis = (lax.broadcasted_iota(jnp.int32, (S0, S0), 1)
+               <= lax.broadcasted_iota(jnp.int32, (S0, S0), 0))
+    mask = jnp.concatenate([pre_vis, suf_vis], axis=1)[None, None, None]
+
+    def layer(x, inp):
+        p, pk, pv = inp
+        h = _rmsnorm(x, p["ln1"])
+        qh, kh, vh = _qkv_proj(h, p, cfg, positions=positions)
+        k_full = jnp.concatenate(
+            [jnp.broadcast_to(pk[None].astype(kh.dtype), (K, Hkv, P0, Dh)),
+             kh], axis=2)
+        v_full = jnp.concatenate(
+            [jnp.broadcast_to(pv[None].astype(vh.dtype), (K, Hkv, P0, Dh)),
+             vh], axis=2)
+        # Grouped-query attention with the prefix mask — the same
+        # bandwidth discipline as _cache_attend, S0 queries wide.
+        qg = qh.reshape(K, Hkv, G, S0, Dh)
+        s = jnp.einsum("bkgsd,bktd->bkgst", qg.astype(k_full.dtype),
+                       k_full, preferred_element_type=jnp.float32
+                       ) / np.sqrt(Dh)
+        s = jnp.where(mask, s, -1e30)
+        w = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bkgst,bktd->bkgsd", w.astype(v_full.dtype),
+                       v_full, preferred_element_type=jnp.float32)
+        oh = o.reshape(K, H, S0, Dh)
+        out = _out_proj(oh.astype(cfg.dtype), p, cfg)
+        return _mlp_block(x + out, p, cfg, moe_impl=moe_impl), (kh, vh)
+
+    x, (k_all, v_all) = lax.scan(
+        layer, x, (params["layers"], prefix_k, prefix_v))
+    last = jnp.take_along_axis(x, (true_len - 1)[:, None, None], axis=1)
+    logits = _lm_head(last, params["ln_f"], params["head"], cfg)
+    return logits[:, 0], {"k": k_all, "v": v_all, "pos": p0 + true_len}
+
+
 def _attention_prefill(x, p, cfg: TransformerConfig):
     """Full-sequence attention that ALSO returns the (unexpanded,
     post-RoPE) per-layer K/V for cache filling.  Shares the projection

@@ -22,7 +22,9 @@ path (docs/serving.md "Performance").
 """
 
 from horovod_tpu.serving.cache import (
+    PagedSlotCache,
     SlotCache,
+    init_page_pool,
     init_slot_cache,
     insert_prefill,
     insert_prefill_batch,
@@ -48,6 +50,7 @@ from horovod_tpu.serving.metrics import (
     ServingMetrics,
 )
 from horovod_tpu.serving.scheduler import (
+    CacheOutOfPagesError,
     DeadlineExceededError,
     DrainingError,
     EngineFailedError,
@@ -61,14 +64,14 @@ from horovod_tpu.serving.scheduler import (
 from horovod_tpu.serving.server import ServingServer
 
 __all__ = [
-    "SlotCache", "init_slot_cache", "insert_prefill",
-    "insert_prefill_batch",
+    "SlotCache", "PagedSlotCache", "init_slot_cache", "init_page_pool",
+    "insert_prefill", "insert_prefill_batch",
     "EngineConfig", "GenerationFuture", "InferenceEngine",
     "HEALTHY", "DEGRADED", "DRAINING", "FAILED",
     "FaultInjector", "FaultSpec", "InjectedFaultError",
     "Counter", "Gauge", "Histogram", "ServingMetrics",
-    "DeadlineExceededError", "DrainingError", "EngineFailedError",
-    "EngineStalledError", "QueueFullError", "Request",
-    "RequestTooLongError", "Scheduler", "ServingError",
+    "CacheOutOfPagesError", "DeadlineExceededError", "DrainingError",
+    "EngineFailedError", "EngineStalledError", "QueueFullError",
+    "Request", "RequestTooLongError", "Scheduler", "ServingError",
     "ServingServer",
 ]

@@ -20,7 +20,8 @@ spelled out on ``decode_step_slots``; the no-contamination test in
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import heapq
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -28,6 +29,7 @@ import numpy as np
 from jax import lax
 
 from horovod_tpu.models import transformer as T
+from horovod_tpu.serving.scheduler import CacheOutOfPagesError
 
 
 def init_slot_cache(cfg: "T.TransformerConfig", n_slots: int,
@@ -116,7 +118,10 @@ class SlotCache:
         """Lowest free slot index, or ``None`` when the pool is full."""
         if not self._free:
             return None
-        slot = self._free.pop(0)
+        # A min-heap keeps FCFS-lowest-index assignment at O(log S) per
+        # op; the old list.pop(0) + sort() was O(S log S) per
+        # retirement on the hot path.
+        slot = heapq.heappop(self._free)
         self._active[slot] = True
         return slot
 
@@ -124,8 +129,7 @@ class SlotCache:
         if not self._active[slot]:
             raise ValueError(f"slot {slot} is not active")
         self._active[slot] = False
-        self._free.append(slot)
-        self._free.sort()  # keep FCFS assignment at the lowest index
+        heapq.heappush(self._free, slot)
 
     def release_all(self) -> None:
         """Host-side reset: every slot freed (device K/V left in place —
@@ -174,3 +178,420 @@ class SlotCache:
                 raise ValueError(f"slot {s} is not allocated")
         self.cache = self._insert_batch(
             self.cache, np.asarray(slots, np.int32), prefilled)
+
+
+# --- paged layout (block allocator + page tables) -----------------------------
+#
+# The slot-contiguous layout above reserves max_len x S positions up
+# front, so occupancy is bounded by the WORST-CASE request and mixed
+# lengths fragment HBM.  The paged layout (PagedAttention, Kwon et al.,
+# SOSP 2023) stores K/V as a pool of fixed-size pages; each slot owns an
+# int32 page-table row, resolved INSIDE the compiled decode tick
+# (models/transformer.py:decode_step_paged) — page tables are DATA, not
+# structure, so allocation patterns never recompile anything.  Page 0 is
+# the reserved NULL/trash page: never granted, the routing target for
+# inactive rows' writes and unpopulated table entries.
+
+NULL_PAGE = 0
+
+_KV_DTYPES = {"bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+              "f32": jnp.float32, "float32": jnp.float32,
+              "int8": jnp.int8}
+
+
+def resolve_kv_dtype(cfg: "T.TransformerConfig", kv_dtype):
+    """``(storage dtype, quantized?)`` for a ``kv_dtype`` spec: None =
+    the model's compute dtype, "bf16" halves f32 cache bytes, "int8"
+    quarters them (per-vector scales ride alongside;
+    dequantize-on-attend in the tick)."""
+    if kv_dtype is None:
+        return cfg.dtype, False
+    if isinstance(kv_dtype, str):
+        if kv_dtype not in _KV_DTYPES:
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r}; expected "
+                             f"one of {sorted(_KV_DTYPES)} or None")
+        kv_dtype = _KV_DTYPES[kv_dtype]
+    return kv_dtype, jnp.dtype(kv_dtype) == jnp.int8
+
+
+def init_page_pool(cfg: "T.TransformerConfig", n_slots: int, n_pages: int,
+                   page_size: int, kv_dtype=None) -> Dict:
+    """The paged device cache: ``k``/``v`` are ``(L, P, H_kv, page,
+    Dh)`` page pools (``P`` counts the NULL page), ``pos`` is the
+    per-slot ``(S,)`` logical write position, and int8 storage adds
+    ``k_scale``/``v_scale`` ``(L, P, H_kv, page)`` per-vector f32
+    scales.  The page table itself is HOST state
+    (:attr:`PagedSlotCache.table`), uploaded as data each tick."""
+    dt, quant = resolve_kv_dtype(cfg, kv_dtype)
+    L, Hkv, Dh = cfg.n_layers, cfg.kv_heads, cfg.head_dim
+    pool = {
+        "k": jnp.zeros((L, n_pages, Hkv, page_size, Dh), dt),
+        "v": jnp.zeros((L, n_pages, Hkv, page_size, Dh), dt),
+        "pos": jnp.zeros((n_slots,), jnp.int32),
+    }
+    if quant:
+        pool["k_scale"] = jnp.zeros((L, n_pages, Hkv, page_size),
+                                    jnp.float32)
+        pool["v_scale"] = jnp.zeros((L, n_pages, Hkv, page_size),
+                                    jnp.float32)
+    return pool
+
+
+def paged_insert(pool: Dict, slots, new_pos, phys, off,
+                 prefilled_k, prefilled_v) -> Dict:
+    """Land a prefilled K/V block into pages: position ``t`` of row
+    ``i`` scatters to ``(page phys[i, t], offset off[i, t])`` — the
+    index arrays are host-built DATA, so one executable per
+    ``(K, bucket)`` shape serves every page assignment, every bucket
+    alignment (suffix landings start mid-page after a COW), and junk
+    routing (padding positions point at the NULL page).  ``slots`` /
+    ``new_pos`` adopt the per-row positions (empty for slotless
+    landings — prefix registration).  int8 pools quantize per vector
+    on the way in, writing payload and scale in the same scatter."""
+    k, v = prefilled_k, prefilled_v  # (L, K, H_kv, Tb, Dh)
+    quant = "k_scale" in pool
+    out = dict(pool)
+    if quant:
+        qk, sk = T.kv_quantize(k)
+        qv, sv = T.kv_quantize(v)
+        out["k"] = pool["k"].at[:, phys, :, off, :].set(
+            jnp.transpose(qk, (1, 3, 0, 2, 4)))
+        out["v"] = pool["v"].at[:, phys, :, off, :].set(
+            jnp.transpose(qv, (1, 3, 0, 2, 4)))
+        out["k_scale"] = pool["k_scale"].at[:, phys, :, off].set(
+            jnp.transpose(sk, (1, 3, 0, 2)))
+        out["v_scale"] = pool["v_scale"].at[:, phys, :, off].set(
+            jnp.transpose(sv, (1, 3, 0, 2)))
+    else:
+        dt = pool["k"].dtype
+        out["k"] = pool["k"].at[:, phys, :, off, :].set(
+            jnp.transpose(k.astype(dt), (1, 3, 0, 2, 4)))
+        out["v"] = pool["v"].at[:, phys, :, off, :].set(
+            jnp.transpose(v.astype(dt), (1, 3, 0, 2, 4)))
+    out["pos"] = pool["pos"].at[slots].set(new_pos)
+    return out
+
+
+def copy_page(pool: Dict, src, dst) -> Dict:
+    """Copy one physical page (all layers, payload + scales) — the
+    copy-on-write primitive.  ``src``/``dst`` are traced scalars, so
+    one compile covers every copy."""
+    out = dict(pool)
+    for name in ("k", "v", "k_scale", "v_scale"):
+        if name in pool:
+            out[name] = pool[name].at[:, dst].set(pool[name][:, src])
+    return out
+
+
+def gather_prefix_pages(pool: Dict, pages):
+    """Materialize ``pages`` (a ``(n,)`` id vector) as contiguous
+    ``(k, v)`` of shape ``(L, H_kv, n * page, Dh)`` — the shared-prefix
+    K/V handed to :func:`~horovod_tpu.models.transformer.
+    prefill_with_prefix`.  int8 pools dequantize here (f32), so the
+    suffix prefill attends real values."""
+    k = pool["k"][:, pages]                   # (L, n, H_kv, ps, Dh)
+    v = pool["v"][:, pages]
+    L, n, Hkv, ps, Dh = k.shape
+    k = jnp.moveaxis(k, 1, 2).reshape(L, Hkv, n * ps, Dh)
+    v = jnp.moveaxis(v, 1, 2).reshape(L, Hkv, n * ps, Dh)
+    if "k_scale" in pool:
+        ks = jnp.moveaxis(pool["k_scale"][:, pages], 1, 2
+                          ).reshape(L, Hkv, n * ps)
+        vs = jnp.moveaxis(pool["v_scale"][:, pages], 1, 2
+                          ).reshape(L, Hkv, n * ps)
+        k = T.kv_dequantize(k, ks, jnp.float32)
+        v = T.kv_dequantize(v, vs, jnp.float32)
+    return k, v
+
+
+class PagedSlotCache:
+    """Host-side page allocator + slot bookkeeping over one device page
+    pool.  API-compatible with :class:`SlotCache` where the engine
+    touches it (alloc/free/active_mask/occupancy/...), plus the paging
+    surface: per-slot page tables (:attr:`table`, uploaded as tick
+    data; :attr:`table_version` bumps on every change so the engine
+    re-uploads only then), a heapq free list of pages, REFCOUNTED pages
+    for prefix sharing (:meth:`attach` / :meth:`grant_raw`), and
+    copy-on-write (:meth:`cow`) so a shared page is copied only when a
+    slot must write into it.
+
+    Freed pages are NOT scrubbed: a page's next owner writes every
+    position before first attending it (prefill landing covers the
+    prompt span; decode writes position ``p`` the same tick it first
+    attends ``p``) — the slot-contiguous write-before-attend argument,
+    re-proven per page by the no-contamination test in
+    ``tests/test_paged.py``."""
+
+    def __init__(self, cfg: "T.TransformerConfig", n_slots: int,
+                 max_len: int = 0, *, page_size: int = 16,
+                 n_pages: int = 0, kv_dtype=None):
+        if n_slots < 1:
+            raise ValueError(f"need at least one slot, got {n_slots}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.max_len = max_len or cfg.max_seq
+        self.page_size = page_size
+        self.max_pages = -(-self.max_len // page_size)
+        # 0 = capacity parity with the slot-contiguous layout (every
+        # slot can grow to max_len); a smaller pool is the whole point
+        # — mixed-length traffic rarely needs worst case, and the
+        # admission back-pressure handles the tail.
+        self.n_pages = n_pages or n_slots * self.max_pages
+        self.kv_dtype = kv_dtype
+        self._storage_dtype, self.quantized = resolve_kv_dtype(
+            cfg, kv_dtype)
+        self.cache = init_page_pool(cfg, n_slots, self.n_pages + 1,
+                                    page_size, kv_dtype)
+        self.table = np.zeros((n_slots, self.max_pages), np.int32)
+        self.table_version = 0
+        self._ref = np.zeros(self.n_pages + 1, np.int64)
+        self._ref[NULL_PAGE] = 1  # never granted
+        self._free_pages: List[int] = list(range(1, self.n_pages + 1))
+        self._min_free = self.n_pages
+        self._active = np.zeros(n_slots, bool)
+        self._free: List[int] = list(range(n_slots))  # heap (sorted)
+        # jax.jit caches one executable per input shape, so single
+        # callables cover every (K, bucket) landing, every copy, and
+        # every prefix-gather length.
+        self._insert = jax.jit(paged_insert, donate_argnums=(0,))
+        self._copy = jax.jit(copy_page, donate_argnums=(0,))
+        self._gather = jax.jit(gather_prefix_pages)
+        self._set_pos = jax.jit(
+            lambda pool, s, v: {**pool, "pos": pool["pos"].at[s].set(v)},
+            donate_argnums=(0,))
+
+    # -- slot allocation (SlotCache-compatible) -----------------------------
+
+    def alloc(self) -> Optional[int]:
+        if not self._free:
+            return None
+        slot = heapq.heappop(self._free)
+        self._active[slot] = True
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Retire a slot: every page its table references is
+        dereferenced (a page reaching refcount 0 returns to the free
+        heap — shared prefix pages survive until their last reference,
+        including the registry's own pin, drops)."""
+        if not self._active[slot]:
+            raise ValueError(f"slot {slot} is not active")
+        self._active[slot] = False
+        heapq.heappush(self._free, slot)
+        for pg in self.table[slot]:
+            self._decref(int(pg))
+        self.table[slot, :] = NULL_PAGE
+        self.table_version += 1
+
+    def release_all(self) -> None:
+        """Host-side reset of slots AND pages (terminal/restart paths).
+        Any prefix-registry pins die with this — the engine invalidates
+        its registry whenever it resets the cache."""
+        self._active[:] = False
+        self._free = list(range(self.n_slots))
+        self.table[:, :] = NULL_PAGE
+        self.table_version += 1
+        self._ref[:] = 0
+        self._ref[NULL_PAGE] = 1
+        self._free_pages = list(range(1, self.n_pages + 1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_count(self) -> int:
+        return int(self._active.sum())
+
+    @property
+    def occupancy(self) -> float:
+        return self.active_count / self.n_slots
+
+    def active_mask(self) -> np.ndarray:
+        """(S,) bool — a COPY, safe to hand to jit."""
+        return self._active.copy()
+
+    def positions(self) -> np.ndarray:
+        return np.asarray(self.cache["pos"])
+
+    # -- page accounting ----------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free_pages)
+
+    @property
+    def pages_shared(self) -> int:
+        """Pages referenced more than once (prefix sharing in effect)."""
+        return int((self._ref[1:] > 1).sum())
+
+    @property
+    def pages_high_water(self) -> int:
+        """Most pages ever simultaneously allocated."""
+        return self.n_pages - self._min_free
+
+    @property
+    def bytes_per_token(self) -> int:
+        """KV bytes one token costs in this pool (the quantization
+        lever made legible): payload for k+v across layers, plus the
+        per-vector scales for int8."""
+        elem = jnp.dtype(self._storage_dtype).itemsize
+        n = self.cfg.n_layers * self.cfg.kv_heads
+        b = 2 * n * self.cfg.head_dim * elem
+        if self.quantized:
+            b += 2 * n * 4  # f32 scale per (layer, head, token) vector
+        return b
+
+    def pages_for(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_size) if n_tokens > 0 else 0
+
+    def _pop_page(self) -> int:
+        if not self._free_pages:
+            raise CacheOutOfPagesError(
+                f"page pool exhausted ({self.n_pages} pages, "
+                f"{self.pages_shared} shared)")
+        pg = heapq.heappop(self._free_pages)
+        self._min_free = min(self._min_free, len(self._free_pages))
+        return pg
+
+    def _decref(self, pg: int) -> None:
+        if pg == NULL_PAGE:
+            return
+        self._ref[pg] -= 1
+        if self._ref[pg] == 0:
+            heapq.heappush(self._free_pages, pg)
+        elif self._ref[pg] < 0:  # pragma: no cover - allocator invariant
+            raise AssertionError(f"page {pg} refcount underflow")
+
+    # -- grants / sharing / COW --------------------------------------------
+
+    def grant(self, slot: int, idx: int) -> int:
+        """Grant a fresh PRIVATE page at table index ``idx`` (on-demand
+        growth at a tick boundary).  Raises
+        :class:`CacheOutOfPagesError` on an empty pool — the engine
+        turns that into preemption or back-pressure, never silent
+        over-allocation."""
+        if self.table[slot, idx] != NULL_PAGE:
+            raise ValueError(
+                f"slot {slot} already has page {self.table[slot, idx]} "
+                f"at index {idx}")
+        pg = self._pop_page()
+        self._ref[pg] = 1
+        self.table[slot, idx] = pg
+        self.table_version += 1
+        return pg
+
+    def grant_raw(self, n: int) -> List[int]:
+        """``n`` pages owned by the CALLER (the prefix registry's pin),
+        refcount 1 each, bound to no slot.  All-or-nothing."""
+        if len(self._free_pages) < n:
+            raise CacheOutOfPagesError(
+                f"need {n} pages for prefix registration, "
+                f"{len(self._free_pages)} free of {self.n_pages}")
+        pages = []
+        for _ in range(n):
+            pg = self._pop_page()
+            self._ref[pg] = 1
+            pages.append(pg)
+        return pages
+
+    def release_raw(self, pages: Sequence[int]) -> None:
+        """Drop a :meth:`grant_raw` pin (prefix unregistration)."""
+        for pg in pages:
+            self._decref(int(pg))
+
+    def attach(self, slot: int, pages: Sequence[int]) -> None:
+        """Reference shared pages from table indices ``0..len-1`` —
+        prefix sharing: refcount++ per page, no copy, no compute."""
+        for i, pg in enumerate(pages):
+            if self.table[slot, i] != NULL_PAGE:
+                raise ValueError(f"slot {slot} index {i} already mapped")
+            self.table[slot, i] = pg
+            self._ref[pg] += 1
+        self.table_version += 1
+
+    def cow(self, slot: int, idx: int) -> int:
+        """Copy-on-write: make the page at table index ``idx`` PRIVATE
+        to ``slot``.  A no-op if it already is; otherwise a fresh page
+        is granted, the shared page's payload is copied on device, the
+        table repointed, and the shared page dereferenced.  Called
+        before ANY write can target a shared page — suffix landing
+        into a partially-filled prefix page, or decode growing into
+        one."""
+        src = int(self.table[slot, idx])
+        if src == NULL_PAGE:
+            raise ValueError(f"slot {slot} has no page at index {idx}")
+        if self._ref[src] <= 1:
+            return src
+        dst = self._pop_page()
+        self._ref[dst] = 1
+        self.cache = self._copy(self.cache, jnp.int32(src), jnp.int32(dst))
+        self.table[slot, idx] = dst
+        self._decref(src)
+        self.table_version += 1
+        return dst
+
+    # -- device ops ---------------------------------------------------------
+
+    def _phys_off(self, rows: Sequence[Sequence[int]], start: int,
+                  true_lens, bucket: int):
+        """Host-built landing indices: row ``i``'s position ``start +
+        t`` maps to its page table unless past ``true_lens[i]`` (bucket
+        padding), which routes to the NULL page."""
+        ps = self.page_size
+        logical = start + np.arange(bucket)
+        idxs = np.clip(logical // ps, 0, self.max_pages - 1)
+        phys = np.zeros((len(rows), bucket), np.int32)
+        for i, row in enumerate(rows):
+            p = np.asarray(row, np.int32)[idxs]
+            phys[i] = np.where(logical < start + int(true_lens[i]), p,
+                               NULL_PAGE)
+        return phys, np.asarray(logical % ps, np.int32)
+
+    def land(self, slots: Sequence[int], prefilled: Dict,
+             true_lens, start: int = 0) -> None:
+        """Land a prefilled (or suffix-prefilled) K/V block into the
+        slots' granted pages with ONE scatter, and adopt the per-row
+        positions from ``prefilled["pos"]``.  ``start`` is the logical
+        position of bucket column 0 (0 for full prompts, the shared
+        prefix length for suffix landings)."""
+        for s in slots:
+            if not self._active[s]:
+                raise ValueError(f"slot {s} is not allocated")
+        bucket = prefilled["k"].shape[3]
+        phys, off = self._phys_off([self.table[s] for s in slots], start,
+                                   true_lens, bucket)
+        self.cache = self._insert(
+            self.cache, np.asarray(slots, np.int32),
+            prefilled["pos"].astype(jnp.int32), phys,
+            np.broadcast_to(off, phys.shape), prefilled["k"],
+            prefilled["v"])
+
+    def land_raw(self, pages: Sequence[int], prefilled: Dict,
+                 true_len: int) -> None:
+        """Slotless landing into raw pages (prefix registration): the
+        prefix block fills ``pages`` in order; no slot position is
+        touched."""
+        bucket = prefilled["k"].shape[3]
+        row = list(pages) + [NULL_PAGE] * max(
+            0, self.max_pages - len(pages))
+        phys, off = self._phys_off([row], 0, [true_len], bucket)
+        empty = np.zeros((0,), np.int32)
+        self.cache = self._insert(
+            self.cache, empty, jnp.zeros((0,), jnp.int32), phys,
+            np.broadcast_to(off, phys.shape), prefilled["k"],
+            prefilled["v"])
+
+    def set_pos(self, slots: Sequence[int], vals: Sequence[int]) -> None:
+        """Adopt positions without landing (attach-only admission — the
+        whole prompt already lives in shared pages)."""
+        self.cache = self._set_pos(
+            self.cache, np.asarray(slots, np.int32),
+            np.asarray(vals, np.int32))
+
+    def gather_prefix(self, pages: Sequence[int]):
+        """Contiguous ``(k, v)`` for a shared prefix's pages (see
+        :func:`gather_prefix_pages`)."""
+        return self._gather(self.cache, np.asarray(pages, np.int32))

@@ -89,10 +89,16 @@ import numpy as np
 
 from horovod_tpu.models import transformer as T
 from horovod_tpu.obs import tracing as obs_tracing
-from horovod_tpu.serving.cache import SlotCache, init_slot_cache  # noqa: F401
+from horovod_tpu.serving.cache import (  # noqa: F401
+    NULL_PAGE,
+    PagedSlotCache,
+    SlotCache,
+    init_slot_cache,
+)
 from horovod_tpu.serving.faults import FaultInjector
 from horovod_tpu.serving.metrics import ServingMetrics
 from horovod_tpu.serving.scheduler import (
+    CacheOutOfPagesError,
     DrainingError,
     EngineFailedError,
     EngineStalledError,
@@ -271,6 +277,21 @@ class EngineConfig:
     synchronous A/B baseline: fetch-and-apply in the same step, same
     tokens, ~the device wait slower per tick.
 
+    Paged KV cache (``paged``, default on — docs/serving.md "Paged KV
+    cache"): K/V live in a pool of ``n_pages`` fixed-size pages
+    (``page_size`` tokens each; ``n_pages=0`` sizes the pool for
+    capacity parity with the slot-contiguous layout, smaller pools
+    trade worst-case capacity for admission headroom), resolved
+    through per-slot page tables INSIDE the one compiled tick.  Pages
+    are granted on demand at tick boundaries, refcounted for prefix
+    sharing (:meth:`InferenceEngine.register_prefix`), and
+    copy-on-write: a shared page is copied only when a slot must write
+    into it.  ``kv_dtype`` selects page storage: None = the model
+    dtype, "bf16" halves f32 cache bytes (exact for bf16 models),
+    "int8" quarters them (per-vector scales, dequantize-on-attend —
+    lossy).  ``paged=False`` keeps the slot-contiguous
+    :class:`SlotCache` — the A/B oracle baseline.
+
     Fault tolerance: ``max_restarts`` bounds CONSECUTIVE supervised
     restarts before the engine goes terminally ``failed`` (a clean tick
     resets the count); ``restart_backoff`` / ``restart_backoff_max``
@@ -287,6 +308,10 @@ class EngineConfig:
     max_len: int = 0
     max_prefills_per_tick: int = 2
     overlap: bool = True
+    paged: bool = True
+    page_size: int = 16
+    n_pages: int = 0
+    kv_dtype: Optional[str] = None
     max_queue_depth: int = 64
     default_max_new_tokens: int = 64
     min_prefill_bucket: int = 8
@@ -310,6 +335,21 @@ class _SlotState:
     n_generated: int
 
 
+@dataclasses.dataclass
+class _PrefixEntry:
+    """One registered shared prefix: its tokens, the refcount-pinned
+    pages its K/V lives in, and the first greedy continuation token
+    (cached so a prompt that IS the prefix admits with zero prefill
+    compute).  ``epoch`` stamps which cache lifetime the pages belong
+    to — a supervised restart replaces the pool, so stale entries
+    lazily re-prefill on next use."""
+
+    tokens: tuple
+    pages: Optional[List[int]] = None
+    first_token: int = 0
+    epoch: int = -1
+
+
 class InferenceEngine:
     """Continuous-batching engine over one model's params + config.
 
@@ -325,7 +365,7 @@ class InferenceEngine:
         self.cfg = cfg
         self.engine_cfg = engine_cfg
         self.detokenize = detokenize
-        self.slots = SlotCache(cfg, engine_cfg.n_slots, engine_cfg.max_len)
+        self.slots = self._make_slots()
         self.metrics = ServingMetrics()
         self.scheduler = Scheduler(
             max_queue_depth=engine_cfg.max_queue_depth,
@@ -369,27 +409,74 @@ class InferenceEngine:
         # after warmup.
         self._decode_traces = 0
 
-        def _tick(params, tokens, active, cache):
-            self._decode_traces += 1
-            # Runs once per (re)trace: this IS a compile event — count
-            # it and mark it on the active trace/timeline.
-            obs_tracing.record_compile("serving_decode")
-            logits, cache = T.decode_step_slots(
-                params, tokens, cache, self.cfg, active)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            # Per-slot max logit rides along for the host-side
-            # finiteness check: NaN/Inf logits (bad params, flaky
-            # hardware) must become a typed engine failure, not
-            # silently-greedy garbage tokens.
-            mx = jnp.max(logits, axis=-1)
-            return jnp.where(active, nxt, 0), mx, cache
+        if engine_cfg.paged:
+            def _tick(params, tokens, active, table, pool):
+                self._decode_traces += 1
+                obs_tracing.record_compile("serving_decode")
+                logits, pool = T.decode_step_paged(
+                    params, tokens, pool, table, self.cfg, active)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                mx = jnp.max(logits, axis=-1)
+                return jnp.where(active, nxt, 0), mx, pool
+
+            donate = 4
+        else:
+            def _tick(params, tokens, active, cache):
+                self._decode_traces += 1
+                # Runs once per (re)trace: this IS a compile event —
+                # count it and mark it on the active trace/timeline.
+                obs_tracing.record_compile("serving_decode")
+                logits, cache = T.decode_step_slots(
+                    params, tokens, cache, self.cfg, active)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # Per-slot max logit rides along for the host-side
+                # finiteness check: NaN/Inf logits (bad params, flaky
+                # hardware) must become a typed engine failure, not
+                # silently-greedy garbage tokens.
+                mx = jnp.max(logits, axis=-1)
+                return jnp.where(active, nxt, 0), mx, cache
+
+            donate = 3
 
         # Donate the cache: without it XLA keeps input AND output caches
         # alive across the tick (2x the KV HBM — half the servable
-        # slots) and copies the whole cache every token.
-        self._tick_fn = jax.jit(_tick, donate_argnums=(3,))
+        # slots) and copies the whole cache every token.  (The page
+        # TABLE is not donated — it is host-owned tick data, like the
+        # active mask.)
+        self._tick_fn = jax.jit(_tick, donate_argnums=(donate,))
         self._prefill_fns: Dict[tuple, Callable] = {}
         self._prefill_traces = 0
+        self._prefill_calls = 0  # prefill FORWARD PASSES (sharing hook)
+
+        # Paged-cache host state: _page_pos mirrors each slot's device
+        # write position AT DISPATCH TIME (admission sets it to the
+        # prompt length; every dispatched tick advances active rows by
+        # one, exactly like the device-side pos) — page grants and COW
+        # happen against this mirror at tick boundaries, BEFORE the
+        # write that needs them.  _dev_table caches the device upload
+        # of the page table, refreshed only when table_version moves.
+        self._page_pos = np.zeros(engine_cfg.n_slots, np.int64)
+        self._dev_table = None
+        self._table_uploaded = -1
+        # Registered shared prefixes (token tuple -> entry); epoch
+        # stamps which cache lifetime the pinned pages belong to.
+        self._prefixes: Dict[tuple, _PrefixEntry] = {}
+        self._prefix_version = 0  # bumps on (un)register: match cache
+        self._cache_epoch = 0
+        if engine_cfg.paged:
+            def _suffix_prefill(params, padded, lens, pk, pv, p0):
+                self._prefill_traces += 1
+                obs_tracing.record_compile("serving_prefill")
+                return T.prefill_with_prefix(
+                    params, padded, pk, pv, p0, self.cfg, true_len=lens)
+
+            # jax.jit caches per (n_prefix_pages, bucket, k) shape; the
+            # prefix length p0 is a traced scalar, so prefixes of any
+            # length share the page-granular compile set.
+            self._suffix_prefill = jax.jit(_suffix_prefill)
+            self.metrics.kv_pages_total.set(self.slots.n_pages)
+            self.metrics.kv_pages_free.set(self.slots.free_pages)
+            self.metrics.kv_bytes_per_token.set(self.slots.bytes_per_token)
 
         # Overlapped-pipeline state (engine_cfg.overlap).  _pending is
         # the ONE in-flight decode tick: its un-fetched device outputs
@@ -518,6 +605,16 @@ class InferenceEngine:
             raise RequestTooLongError(
                 f"prompt ({len(prompt)}) + max_new_tokens ({n_new}) "
                 f"exceeds slot capacity ({cap})")
+        if (self.engine_cfg.paged
+                and self.slots.pages_for(len(prompt) + n_new - 1)
+                > self.slots.n_pages):
+            # Could NEVER run, even with the whole pool to itself — a
+            # typed rejection now, not an admission stall forever.
+            self.metrics.rejected.inc()
+            raise CacheOutOfPagesError(
+                f"prompt ({len(prompt)}) + max_new_tokens ({n_new}) "
+                f"needs {self.slots.pages_for(len(prompt) + n_new - 1)} "
+                f"pages; the pool holds {self.slots.n_pages}")
         fut = GenerationFuture(on_token=on_token,
                                detokenize=self.detokenize)
         fut.trace = obs_tracing.RequestTrace(trace_id)
@@ -545,6 +642,236 @@ class InferenceEngine:
             raise exc
         self.metrics.queue_depth.set(self.scheduler.depth)
         return fut
+
+    # -- paged cache plumbing ----------------------------------------------
+
+    def _make_slots(self):
+        ec = self.engine_cfg
+        if ec.paged:
+            return PagedSlotCache(self.cfg, ec.n_slots, ec.max_len,
+                                  page_size=ec.page_size,
+                                  n_pages=ec.n_pages, kv_dtype=ec.kv_dtype)
+        return SlotCache(self.cfg, ec.n_slots, ec.max_len)
+
+    def register_prefix(self, tokens: Sequence[int]) -> None:
+        """Register a SHARED PREFIX (e.g. the system prompt): its K/V
+        is prefilled ONCE into refcount-pinned pages, and every future
+        request whose prompt starts with it attaches those pages and
+        prefills only its suffix — N concurrent requests, one prefix
+        prefill.  A request whose prompt IS the prefix admits with no
+        prefill at all (the first greedy token is cached here).  Pages
+        stay pinned across slot churn; a supervised restart invalidates
+        the entry, which lazily re-prefills on next use.  Requires a
+        paged engine."""
+        if not self.engine_cfg.paged:
+            raise ValueError("prefix sharing requires EngineConfig.paged")
+        tokens = tuple(int(t) for t in tokens)
+        if not tokens:
+            raise ServingError("empty prefix")
+        if len(tokens) > self.slots.max_len:
+            raise RequestTooLongError(
+                f"prefix ({len(tokens)}) exceeds slot capacity "
+                f"({self.slots.max_len})")
+        with self._lock:
+            fresh = tokens not in self._prefixes
+            entry = self._prefixes.setdefault(tokens,
+                                              _PrefixEntry(tokens=tokens))
+            try:
+                self._ensure_prefix(entry)
+            except BaseException:
+                if fresh:
+                    # A failed registration must leave NOTHING behind:
+                    # a phantom entry would lazily re-pin pages later
+                    # for a prefix the caller was told never registered
+                    # (and so will never unregister).
+                    self._prefixes.pop(tokens, None)
+                raise
+            if fresh:
+                self._prefix_version += 1
+
+    def unregister_prefix(self, tokens: Sequence[int]) -> None:
+        """Drop a registered prefix's pin; its pages return to the free
+        heap once the last attached slot retires."""
+        with self._lock:
+            entry = self._prefixes.pop(tuple(int(t) for t in tokens), None)
+            if entry is not None:
+                self._prefix_version += 1
+            if (entry is not None and entry.pages
+                    and entry.epoch == self._cache_epoch):
+                self.slots.release_raw(entry.pages)
+
+    def _matched_prefix(self, req: Request) -> Optional[_PrefixEntry]:
+        """:meth:`_match_prefix`, once per request: the match is
+        needed by ``_group_key`` (scheduler take), ``_plan_pages``
+        (page budget), and ``_admit_paged`` — an O(prefixes x
+        prefix_len) prompt scan each, every tick the request waits
+        under back-pressure.  Cached on the request, invalidated when
+        the registration set changes."""
+        cached = getattr(req, "_prefix_match", None)
+        if cached is not None and cached[0] == self._prefix_version:
+            return cached[1]
+        entry = self._match_prefix(req.prompt)
+        req._prefix_match = (self._prefix_version, entry)
+        return entry
+
+    def _match_prefix(self, prompt) -> Optional[_PrefixEntry]:
+        """Longest registered prefix the prompt starts with."""
+        best = None
+        for entry in self._prefixes.values():
+            n = len(entry.tokens)
+            if n <= len(prompt) and tuple(prompt[:n]) == entry.tokens:
+                if best is None or n > len(best.tokens):
+                    best = entry
+        return best
+
+    def _ensure_prefix(self, entry: _PrefixEntry) -> None:
+        """(Re-)prefill a prefix entry into pinned pages — the ONE
+        prefix forward pass its sharers amortize.  Raises
+        :class:`CacheOutOfPagesError` if the pool cannot pin it."""
+        if entry.pages is not None and entry.epoch == self._cache_epoch:
+            return
+        p0 = len(entry.tokens)
+        pages = self.slots.grant_raw(self.slots.pages_for(p0))
+        try:
+            bucket = self._bucket(p0)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :p0] = entry.tokens
+            logits, pre = self._prefill_fn(bucket, 1)(
+                self.params, jnp.asarray(padded),
+                jnp.asarray([p0], np.int32))
+            self._prefill_calls += 1
+            self.slots.land_raw(pages, pre, p0)
+            self.metrics.host_syncs.inc()  # the argmax fetch blocks
+            entry.first_token = int(jnp.argmax(logits[0]))  # cold sync
+        except BaseException:
+            # Unpin on ANY failure (compile OOM, device fault at the
+            # blocking sync): without this the pages leak at refcount 1
+            # and every retry drains the pool a little further.
+            self.slots.release_raw(pages)
+            raise
+        entry.pages = pages
+        entry.epoch = self._cache_epoch
+
+    def _plan_pages(self, req: Request) -> int:
+        """Pages an admission would consume (private grants + one COW/
+        growth margin page) — the scheduler back-pressure budget.
+        Shared prefix pages cost nothing: attaching is a refcount."""
+        ps = self.slots.page_size
+        n_idx = (len(req.prompt) - 1) // ps + 1
+        entry = self._matched_prefix(req)
+        if (entry is not None and entry.pages is not None
+                and entry.epoch == self._cache_epoch):
+            p0 = len(entry.tokens)
+            if len(req.prompt) == p0:
+                return 1  # attach-only; margin covers the first COW/grant
+            return n_idx - p0 // ps + 1
+        return n_idx + 1
+
+    def _group_key(self, req: Request):
+        """Admission-group key for :meth:`Scheduler.take`: groups must
+        share one prefill executable, so the key is the prompt bucket —
+        and, when paged, the matched prefix (one shared-prefix gather +
+        suffix prefill serves the whole group) with the SUFFIX bucket."""
+        if not self.engine_cfg.paged:
+            return self._bucket(len(req.prompt))
+        entry = self._matched_prefix(req)
+        if entry is None:
+            return ("full", self._bucket(len(req.prompt)))
+        suf = len(req.prompt) - len(entry.tokens)
+        if suf == 0:
+            return ("attach", entry.tokens)
+        return ("suffix", entry.tokens, self._bucket(suf))
+
+    def _evict_for_pages(self) -> bool:
+        """Preempt the YOUNGEST admitted request (highest request id —
+        oldest work keeps its progress, FCFS-fairly) to reclaim pages;
+        its future resolves with the typed
+        :class:`CacheOutOfPagesError`.  False when nothing is left to
+        evict."""
+        victims = [(st.request.id, s)
+                   for s, st in enumerate(self._states) if st is not None]
+        if not victims:
+            return False
+        _, s = max(victims)
+        st = self._states[s]
+        st.request.future.set_exception(CacheOutOfPagesError(
+            "preempted: page pool exhausted mid-decode "
+            "(older requests keep their pages)"))
+        self.metrics.rejected.inc()
+        self._states[s] = None
+        self.slots.free(s)
+        return True
+
+    def _ensure_write_page(self, s: int) -> bool:
+        """Grant (or copy-on-write) slot ``s``'s write page for the
+        next dispatch.  On pool exhaustion, evict youngest-first until
+        the grant succeeds; returns False if ``s`` itself was the
+        victim."""
+        wp = int(self._page_pos[s])
+        if wp >= self.slots.max_len:
+            # Capacity retirement is imminent (at most one stale
+            # pipeline tick); the kernel clamps the write into the
+            # slot's own last page.
+            return True
+        idx = wp // self.slots.page_size
+        st = self._states[s]
+        if (st is not None and self.slots.table[s, idx] == NULL_PAGE
+                and wp >= (len(st.request.prompt)
+                           + st.request.max_new_tokens - 1)):
+            # Past the request's last real write: only the overlapped
+            # pipeline's one-tick-lag junk dispatch (the tick after the
+            # final token, dropped by _retire_pending) can target this
+            # position.  With no page mapped the kernel routes the
+            # write to the NULL page — granting here could evict a LIVE
+            # request to buy a page for a token nobody keeps.
+            return True
+        while True:
+            try:
+                if self.slots.table[s, idx] == NULL_PAGE:
+                    self.slots.grant(s, idx)
+                else:
+                    # Present but possibly shared (a prompt that IS the
+                    # prefix grows into the shared partial page): COW
+                    # makes it private before the write targets it.
+                    self.slots.cow(s, idx)
+                return True
+            except CacheOutOfPagesError:
+                self._evict_for_pages()
+                if self._states[s] is None:
+                    return False  # s was the youngest — it paid
+
+    def _prepare_paged_tick(self) -> None:
+        """Tick-boundary page maintenance: every active slot gets a
+        PRIVATE page under its write position (grant on demand, COW on
+        sharing, preemption on exhaustion), then the page table is
+        re-uploaded iff it changed — table updates are host bookkeeping
+        plus one async upload, never a device sync."""
+        for s in range(self.engine_cfg.n_slots):
+            if self._states[s] is not None:
+                self._ensure_write_page(s)
+        if (self._dev_table is None
+                or self._table_uploaded != self.slots.table_version):
+            self._dev_table = jnp.asarray(self.slots.table)
+            self._table_uploaded = self.slots.table_version
+
+    def _run_tick(self, tokens_dev, active_dev):
+        """Dispatch ONE compiled decode tick (paged or slot-contiguous
+        — same contract: ``(next_tokens, max_logits, new cache)``)."""
+        if self.engine_cfg.paged:
+            return self._tick_fn(self.params, tokens_dev, active_dev,
+                                 self._dev_table, self.slots.cache)
+        return self._tick_fn(self.params, tokens_dev, active_dev,
+                             self.slots.cache)
+
+    def _update_page_gauges(self) -> None:
+        if not self.engine_cfg.paged:
+            return
+        # Statics re-asserted too: benchmarks swap in a fresh
+        # ServingMetrics after warmup, which would otherwise zero them.
+        self.metrics.kv_pages_total.set(self.slots.n_pages)
+        self.metrics.kv_bytes_per_token.set(self.slots.bytes_per_token)
+        self.metrics.kv_pages_free.set(self.slots.free_pages)
+        self.metrics.kv_pages_shared.set(self.slots.pages_shared)
 
     # -- the tick ----------------------------------------------------------
 
@@ -575,6 +902,7 @@ class InferenceEngine:
                     worked = self._decode_tick() or worked
                 self.metrics.queue_depth.set(self.scheduler.depth)
                 self.metrics.slot_occupancy.set(self.slots.occupancy)
+                self._update_page_gauges()
         except Exception as exc:  # supervised: ANY tick failure recovers
             with self._hb_lock:
                 self._tick_started = None
@@ -628,9 +956,37 @@ class InferenceEngine:
         return worked
 
     def _admit_pending(self) -> bool:
+        admit_fn = None
+        if self.engine_cfg.paged:
+            # Page back-pressure: the take stops (requests WAIT, FCFS
+            # order intact) when the next admission's private pages
+            # would overdraw the free heap — typed starvation-free
+            # admission control instead of silent over-allocation.
+            budget = self.slots.free_pages
+            # Clamp the plan to the deepest the free heap can ever get
+            # (pool minus registry-pinned prefix pages): the plan's
+            # growth-margin page is a heuristic, and an unclamped
+            # demand above that depth would park a request the
+            # submit-time fit check accepted at the FCFS head FOREVER
+            # — admit it when the pool is as free as it gets and let
+            # on-demand grant/preemption resolve the tail instead.
+            pinned = sum(
+                len(e.pages) for e in self._prefixes.values()
+                if e.pages is not None and e.epoch == self._cache_epoch)
+            attainable = max(self.slots.n_pages - pinned, 1)
+            reserved = 0
+
+            def admit_fn(req):
+                nonlocal reserved
+                need = min(self._plan_pages(req), attainable)
+                if reserved + need > budget:
+                    return False
+                reserved += need
+                return True
+
         reqs = self.scheduler.take(
-            self.slots.free_count,
-            bucket_fn=lambda r: self._bucket(len(r.prompt)))
+            self.slots.free_count, bucket_fn=self._group_key,
+            admit_fn=admit_fn)
         self._taken = list(reqs)
         live: List[Request] = []
         for req in reqs:
@@ -682,23 +1038,17 @@ class InferenceEngine:
         for req in reqs:
             if req.trace is not None:
                 req.trace.admitted_at = t_adm  # queue-wait ends here
-        k = len(reqs)
-        bucket = max(self._bucket(len(r.prompt)) for r in reqs)
-        padded = np.zeros((k, bucket), np.int32)
-        lens = np.zeros((k,), np.int32)
-        for i, req in enumerate(reqs):
-            padded[i, :len(req.prompt)] = req.prompt
-            lens[i] = len(req.prompt)
-        logits, pre_cache = self._prefill_fn(bucket, k)(
-            self.params, jnp.asarray(padded), jnp.asarray(lens))
-        slots: List[int] = []
-        for _ in reqs:
-            slot = self.slots.alloc()
-            assert slot is not None  # take() is bounded by free_count
-            slots.append(slot)
-        self.slots.insert_batch(slots, pre_cache)
-        firsts = np.asarray(jnp.argmax(logits, axis=-1))  # one sync for K
-        self.metrics.host_syncs.inc()
+        if self.engine_cfg.paged:
+            slots, reqs, firsts, synced = self._admit_paged(reqs)
+            if not reqs:
+                return
+        else:
+            slots, reqs, firsts = self._admit_contiguous(reqs)
+            synced = True
+        if synced:
+            # Attach-only paged admission (prompt == prefix) fetches
+            # nothing — the counter tracks real blocking syncs only.
+            self.metrics.host_syncs.inc()
         now = time.monotonic()
         for slot, req, first in zip(slots, reqs, firsts):
             ttft = now - req.submitted_at
@@ -724,6 +1074,128 @@ class InferenceEngine:
                 mask[slot] = True
             self._dev_tokens = self._merge_tokens(
                 self._dev_tokens, jnp.asarray(vals), jnp.asarray(mask))
+
+    def _admit_contiguous(self, reqs: List[Request]):
+        """Slot-contiguous admission: one batch-K prefill + one
+        insert scatter (the pre-paging layout, kept as the A/B
+        oracle)."""
+        k = len(reqs)
+        bucket = max(self._bucket(len(r.prompt)) for r in reqs)
+        padded = np.zeros((k, bucket), np.int32)
+        lens = np.zeros((k,), np.int32)
+        for i, req in enumerate(reqs):
+            padded[i, :len(req.prompt)] = req.prompt
+            lens[i] = len(req.prompt)
+        logits, pre_cache = self._prefill_fn(bucket, k)(
+            self.params, jnp.asarray(padded), jnp.asarray(lens))
+        self._prefill_calls += 1
+        slots: List[int] = []
+        for _ in reqs:
+            slot = self.slots.alloc()
+            assert slot is not None  # take() is bounded by free_count
+            slots.append(slot)
+        self.slots.insert_batch(slots, pre_cache)
+        firsts = np.asarray(jnp.argmax(logits, axis=-1))  # one sync for K
+        return slots, reqs, firsts
+
+    def _map_pages(self, slot: int, req: Request,
+                   entry: Optional[_PrefixEntry]) -> None:
+        """Build one slot's page table for admission: attach the shared
+        prefix pages (refcount, no copy), COW the partially-filled
+        prefix page if the suffix must write into it, grant fresh
+        private pages for the rest of the prompt."""
+        ps = self.slots.page_size
+        n_idx = (len(req.prompt) - 1) // ps + 1
+        if entry is None:
+            for idx in range(n_idx):
+                self.slots.grant(slot, idx)
+            return
+        p0 = len(entry.tokens)
+        self.slots.attach(slot, entry.pages)
+        if len(req.prompt) == p0:
+            return  # attach-only; decode growth grants/COWs at dispatch
+        first_new = p0 // ps
+        if p0 % ps:
+            # The last prefix page is partial and the suffix lands
+            # inside it: copy-on-write BEFORE any write targets it.
+            self.slots.cow(slot, first_new)
+            first_new += 1
+        for idx in range(first_new, n_idx):
+            self.slots.grant(slot, idx)
+
+    def _admit_paged(self, reqs: List[Request]):
+        """Paged admission.  The group key guarantees every request
+        here shares one prefill shape AND one matched prefix, so the
+        whole group costs: zero prefill (prompt == prefix: attach pages
+        + cached first token), or ONE suffix prefill attending the
+        shared prefix pages, or ONE full prefill — then one landing
+        scatter into granted pages.  A request whose page plumbing
+        overdraws the pool (the admission budget is a heuristic, not a
+        reservation) is resolved with the typed
+        :class:`CacheOutOfPagesError` and the rest of the group
+        proceeds."""
+        entry = self._matched_prefix(reqs[0])
+        if entry is not None:
+            try:
+                self._ensure_prefix(entry)
+            except CacheOutOfPagesError:
+                entry = None  # degrade: full prefill, no sharing
+        p0 = len(entry.tokens) if entry is not None else 0
+        slots: List[int] = []
+        live: List[Request] = []
+        for req in reqs:
+            slot = self.slots.alloc()
+            assert slot is not None  # take() is bounded by free_count
+            try:
+                self._map_pages(slot, req, entry)
+            except CacheOutOfPagesError as e:
+                self.slots.free(slot)  # releases whatever got mapped
+                req.future.set_exception(e)
+                self.metrics.rejected.inc()
+                self._taken.remove(req)
+                continue
+            slots.append(slot)
+            live.append(req)
+        if not live:
+            return [], [], [], False
+        k = len(live)
+        synced = True  # a prefill's argmax fetch — except attach-only
+        if entry is not None:
+            suf_lens = np.asarray([len(r.prompt) - p0 for r in live],
+                                  np.int32)
+            if int(suf_lens.max()) == 0:
+                # The prompt IS the prefix: its K/V and first greedy
+                # token already exist — admission is pure bookkeeping.
+                self.slots.set_pos(slots, [p0] * k)
+                firsts = np.asarray([entry.first_token] * k)
+                synced = False
+            else:
+                bucket = self._bucket(int(suf_lens.max()))
+                padded = np.zeros((k, bucket), np.int32)
+                for i, r in enumerate(live):
+                    padded[i, :len(r.prompt) - p0] = r.prompt[p0:]
+                pk, pv = self.slots.gather_prefix(entry.pages)
+                logits, suf = self._suffix_prefill(
+                    self.params, jnp.asarray(padded),
+                    jnp.asarray(suf_lens), pk, pv, jnp.int32(p0))
+                self._prefill_calls += 1
+                self.slots.land(slots, suf, suf_lens, start=p0)
+                firsts = np.asarray(jnp.argmax(logits, axis=-1))
+        else:
+            bucket = max(self._bucket(len(r.prompt)) for r in live)
+            padded = np.zeros((k, bucket), np.int32)
+            lens = np.zeros((k,), np.int32)
+            for i, r in enumerate(live):
+                padded[i, :len(r.prompt)] = r.prompt
+                lens[i] = len(r.prompt)
+            logits, pre = self._prefill_fn(bucket, k)(
+                self.params, jnp.asarray(padded), jnp.asarray(lens))
+            self._prefill_calls += 1
+            self.slots.land(slots, pre, lens, start=0)
+            firsts = np.asarray(jnp.argmax(logits, axis=-1))
+        for slot, req in zip(slots, live):
+            self._page_pos[slot] = len(req.prompt)
+        return slots, live, firsts, synced
 
     def _emit(self, slot: int, tok: int) -> None:
         """Stream one token to the slot's future; retire on EOS,
@@ -771,6 +1243,8 @@ class InferenceEngine:
         baseline): upload tokens + mask, dispatch, fetch, and apply the
         bookkeeping all in the same step — the device idles through the
         host half, which is exactly what the pipeline hides."""
+        if self.engine_cfg.paged and self.slots.active_count:
+            self._prepare_paged_tick()  # grants/COWs; may preempt
         active = self.slots.active_mask()
         if not active.any():
             return False
@@ -781,9 +1255,9 @@ class InferenceEngine:
             if st is not None:
                 tokens[s] = st.last_token
         t0 = time.monotonic()
-        nxt, mx, self.slots.cache = self._tick_fn(
-            self.params, jnp.asarray(tokens), jnp.asarray(active),
-            self.slots.cache)
+        nxt, mx, self.slots.cache = self._run_tick(
+            jnp.asarray(tokens), jnp.asarray(active))
+        self._page_pos += active
         self.metrics.decode_ticks.inc()
         dt = time.monotonic() - t0
         self.metrics.tick_dispatch.observe(dt)
@@ -809,6 +1283,11 @@ class InferenceEngine:
         (:meth:`_retire_pending`)."""
         worked = False
         faults = self.engine_cfg.faults
+        if self.engine_cfg.paged and self.slots.active_count:
+            # Page maintenance BEFORE the mask snapshot: a preemption
+            # here must not be dispatched, and a grant/COW is host
+            # bookkeeping + async uploads — nothing blocks on device.
+            self._prepare_paged_tick()
         active = self.slots.active_mask()
         new_pending: Optional[Dict] = None
         if active.any():
@@ -828,9 +1307,9 @@ class InferenceEngine:
                     or not np.array_equal(active, self._dev_active_host)):
                 self._dev_active = jnp.asarray(active)
                 self._dev_active_host = active
-            nxt, mx, self.slots.cache = self._tick_fn(
-                self.params, self._dev_tokens, self._dev_active,
-                self.slots.cache)
+            nxt, mx, self.slots.cache = self._run_tick(
+                self._dev_tokens, self._dev_active)
+            self._page_pos += active
             self._dev_tokens = nxt  # tick N+2's input — never fetched
             self.metrics.decode_ticks.inc()
             dt = time.monotonic() - t0
@@ -925,6 +1404,12 @@ class InferenceEngine:
         self._taken = []
         self._states = [None] * self.engine_cfg.n_slots
         self.slots.release_all()
+        # release_all zeroed every page refcount, including the prefix
+        # registry's pins: bump the epoch HERE (not just in _restart)
+        # so stale entries can neither attach freed pages to a new
+        # admission in the failing/terminal window nor underflow a
+        # refcount on unregister — they lazily re-prefill instead.
+        self._cache_epoch += 1
         self._reset_pipeline()
 
     def _reset_pipeline(self) -> None:
@@ -936,6 +1421,9 @@ class InferenceEngine:
         self._dev_tokens = None
         self._dev_active = None
         self._dev_active_host = None
+        self._dev_table = None
+        self._table_uploaded = -1
+        self._page_pos[:] = 0
 
     def _fail_queue(self, exc: BaseException) -> None:
         for req in self.scheduler.drain_pending():
@@ -992,10 +1480,16 @@ class InferenceEngine:
         from the state it is replacing — a draining engine restarts
         DRAINING (still rejecting new work), everything else restarts
         DEGRADED."""
-        self.slots = SlotCache(self.cfg, self.engine_cfg.n_slots,
-                               self.engine_cfg.max_len)
+        self.slots = self._make_slots()
         self._states = [None] * self.engine_cfg.n_slots
         self._reset_pipeline()
+        # The page pool is fresh: registered prefixes' pinned pages
+        # died with the old cache — bump the epoch so entries lazily
+        # re-prefill (once) on their next use.
+        self._cache_epoch += 1
+        if self.engine_cfg.paged:
+            self.metrics.kv_pages_free.set(self.slots.free_pages)
+            self.metrics.kv_pages_shared.set(0)
         with self._hb_lock:
             self._epoch += 1
             self._stalled = False
@@ -1098,8 +1592,18 @@ class InferenceEngine:
         the engine's compile-set shape."""
         kmax = min(self.engine_cfg.max_prefills_per_tick,
                    self.engine_cfg.n_slots)
-        for n in prompt_lens:
-            prompt = [0] * max(int(n), 1)
+        prompts = [[0] * max(int(n), 1) for n in prompt_lens]
+        # Registered prefixes compile their own executables (suffix
+        # prefill per (prefix pages, suffix bucket, k), prefix-page
+        # gather): warm those too, with prompt_lens as the SUFFIX
+        # lengths — otherwise the first shared-prefix admission after
+        # start() pays XLA compilation inside the watchdog's budget.
+        for entry in list(self._prefixes.values()):
+            prompts += [list(entry.tokens) + [0] * max(int(n), 1)
+                        for n in prompt_lens
+                        if len(entry.tokens) + int(n) + 2
+                        <= self.slots.max_len]
+        for prompt in prompts:
             for k in range(1, kmax + 1):
                 # max_new_tokens=2: the second token exercises the
                 # decode tick (the first comes from prefill logits).
@@ -1216,7 +1720,15 @@ class InferenceEngine:
             "overlap": self.engine_cfg.overlap,
             "decode_compilations": self._decode_traces,
             "prefill_compilations": self._prefill_traces,
+            "prefill_calls": self._prefill_calls,
             # (bucket, batch) shape pairs the prefill has compiled for
             # — bounded by buckets x max_prefills_per_tick.
             "prefill_buckets": sorted(self._prefill_fns),
+            "paged": self.engine_cfg.paged,
+            **({
+                "page_size": self.slots.page_size,
+                "kv_dtype": str(jnp.dtype(self.slots._storage_dtype).name),
+                "kv_pages_high_water": self.slots.pages_high_water,
+                "prefixes_registered": len(self._prefixes),
+            } if self.engine_cfg.paged else {}),
         }

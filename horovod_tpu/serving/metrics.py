@@ -59,6 +59,12 @@ class ServingMetrics:
       is the overlap-efficiency number ``benchmarks/serving.py``
       reports — 1.0 means every host cycle was hidden behind device
       compute.
+    * ``kv_pages_total`` / ``kv_pages_free`` / ``kv_pages_shared`` /
+      ``kv_bytes_per_token`` — page-pool pressure gauges for the paged
+      KV cache (docs/serving.md "Paged KV cache"): pool size, free
+      heap depth (admission headroom), pages referenced by >1 owner
+      (prefix sharing in effect), and the per-token cache cost the
+      ``kv_dtype`` lever moves.  All 0 on a slot-contiguous engine.
     * ``decode_ticks`` / ``host_syncs`` — dispatched decode ticks and
       host sync points (value fetches that block on device work) on
       the decode hot path.  Steady-state overlapped decode performs
@@ -117,6 +123,20 @@ class ServingMetrics:
         self.host_syncs = r.counter(
             "serving_host_syncs_total",
             "Host sync points (blocking value fetches) on the decode path")
+        self.kv_pages_total = r.gauge(
+            "serving_kv_pages_total",
+            "KV page pool size (paged cache; 0 = slot-contiguous)")
+        self.kv_pages_free = r.gauge(
+            "serving_kv_pages_free",
+            "KV pages on the free heap (admission headroom)")
+        self.kv_pages_shared = r.gauge(
+            "serving_kv_pages_shared",
+            "KV pages referenced by more than one owner "
+            "(prefix sharing in effect)")
+        self.kv_bytes_per_token = r.gauge(
+            "serving_kv_bytes_per_token",
+            "KV cache bytes per stored token (k+v across layers, "
+            "incl. int8 scales) — the kv_dtype lever made legible")
         self.model_flops_per_token = r.gauge(
             "serving_model_flops_per_token",
             "Configured model FLOPs per generated token "
@@ -145,6 +165,10 @@ class ServingMetrics:
             "tick_device_wait_seconds": self.tick_device_wait.snapshot(),
             "tick_host_seconds": self.tick_host.snapshot(),
             "decode_ticks": ticks,
+            "kv_pages_total": self.kv_pages_total.value,
+            "kv_pages_free": self.kv_pages_free.value,
+            "kv_pages_shared": self.kv_pages_shared.value,
+            "kv_bytes_per_token": self.kv_bytes_per_token.value,
             "host_syncs": self.host_syncs.value,
             "host_syncs_per_tick":
                 round(self.host_syncs.value / ticks, 4) if ticks else None,

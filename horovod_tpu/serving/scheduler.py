@@ -43,6 +43,18 @@ class RequestTooLongError(ServingError):
     """prompt + max_new_tokens exceeds the cache slot capacity."""
 
 
+class CacheOutOfPagesError(ServingError):
+    """The paged KV cache cannot supply the pages a request needs.
+
+    Raised at submit time when ``prompt + max_new_tokens`` could never
+    fit the whole page pool; set on an ADMITTED request's future when
+    decode-time page growth exhausts the pool and the request is
+    preempted to keep older requests progressing.  Requests that merely
+    have to WAIT for pages are not rejected — they stay queued (the
+    scheduler's ``admit_fn`` back-pressure) until retirements recycle
+    pages.  HTTP maps this to 429 (shed load, retry with backoff)."""
+
+
 class EngineFailedError(ServingError):
     """The engine tick failed (device exception, non-finite logits) and
     every in-flight request was resolved with this error.  The engine
@@ -150,7 +162,8 @@ class Scheduler:
     def take(self, free_slots: int,
              on_reject: Optional[Callable[[Request, ServingError], None]]
              = None,
-             bucket_fn: Optional[Callable[[Request], int]] = None
+             bucket_fn: Optional[Callable[[Request], int]] = None,
+             admit_fn: Optional[Callable[[Request], bool]] = None
              ) -> List[Request]:
         """Up to ``min(max_prefills_per_tick, free_slots)`` admissible
         requests, FCFS.  Requests whose deadline lapsed — or whose
@@ -167,7 +180,14 @@ class Scheduler:
         the next tick — FCFS order is never reordered).  The engine
         uses this so one batched prefill serves the whole admission
         group without padding short prompts to a long prompt's bucket,
-        and the compile set stays bounded by buckets x K."""
+        and the compile set stays bounded by buckets x K.
+
+        ``admit_fn`` is resource BACK-PRESSURE (the paged KV cache's
+        page budget): a request it declines goes back to the head and
+        the take stops — it is neither rejected nor reordered, it just
+        WAITS until retirements free the resource.  Typed rejection is
+        reserved for requests that could never run
+        (:class:`CacheOutOfPagesError` at submit time)."""
         budget = min(self.max_prefills_per_tick, free_slots)
         out: List[Request] = []
         bucket: Optional[int] = None
@@ -209,6 +229,10 @@ class Scheduler:
                     with self._lock:
                         self._q.appendleft(req)  # next tick's FCFS head
                     break
+            if admit_fn is not None and not admit_fn(req):
+                with self._lock:
+                    self._q.appendleft(req)  # waits for pages, still head
+                break
             out.append(req)
             budget -= 1
         return out

@@ -50,6 +50,7 @@ from horovod_tpu.obs import tracing as obs_tracing
 from horovod_tpu.obs.registry import default_registry
 from horovod_tpu.serving.engine import DEGRADED, HEALTHY, InferenceEngine
 from horovod_tpu.serving.scheduler import (
+    CacheOutOfPagesError,
     DeadlineExceededError,
     DrainingError,
     EngineFailedError,
@@ -194,6 +195,12 @@ class _Handler(BaseHTTPRequestHandler):
                              + self.server.timeout_grace)
         except QueueFullError as e:
             fut_err(429, e, "queue_full")
+            return
+        except CacheOutOfPagesError as e:
+            # Shed load, same protocol as a full queue: the page pool
+            # cannot hold this request (submit-time) or it was
+            # preempted mid-decode — retry with backoff.
+            fut_err(429, e, "out_of_pages")
             return
         except RequestTooLongError as e:
             fut_err(413, e, "too_long")
