@@ -24,6 +24,15 @@ high-water mark in the JSON line) and the pipeline phase metrics
 host_syncs_per_tick):
 
     python benchmarks/serving.py --engine [--slots 8] [--arrival-rate 4]
+
+``--router N`` drives the REPLICATED front tier (docs/serving.md
+"Front tier"): a ReplicaSupervisor spawns N replica processes (each a
+full engine + HTTP server, seeded identically), a router proxies the
+same Poisson open-loop workload over them with join-shortest-queue,
+and the JSON line reports aggregate tok/s, per-replica request counts
+and mean occupancy, and the router's retry/failover counters:
+
+    python benchmarks/serving.py --router 2 [--slots 8] [--arrival-rate 4]
 """
 
 from __future__ import annotations
@@ -329,6 +338,143 @@ def _ab_tracing(args, cfg, params):
     }
 
 
+def _router_mode(args, cfg) -> None:
+    """Open-loop benchmark through the replicated front tier: N
+    replica PROCESSES behind the join-shortest-queue router, the same
+    Poisson arrivals as ``--engine`` — aggregate tok/s plus
+    per-replica occupancy/request spread in the JSON line.  Replicas
+    init from the same seed (replica_main), so the answers are
+    byte-identical no matter which replica serves them."""
+    import json as _json
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from horovod_tpu.serving.router import (
+        ReplicaRegistry,
+        ReplicaSpec,
+        ReplicaSupervisor,
+        RouterServer,
+    )
+
+    rng = np.random.default_rng(0)
+    lengths = rng.integers(max(args.prompt_len // 2, 1),
+                           args.prompt_len + 1, args.n_requests)
+    prompts = [rng.integers(0, cfg.vocab_size, int(n)).tolist()
+               for n in lengths]
+    arrival = np.cumsum(rng.exponential(1.0 / args.arrival_rate,
+                                        args.n_requests))
+
+    spec = ReplicaSpec(
+        seed=0, vocab=cfg.vocab_size, d_model=cfg.d_model,
+        n_heads=cfg.n_heads, n_layers=cfg.n_layers, d_ff=cfg.d_ff,
+        max_seq=cfg.max_seq, n_kv_heads=cfg.n_kv_heads or 0,
+        slots=args.slots,
+        max_prefills_per_tick=args.max_prefills_per_tick,
+        max_queue_depth=max(args.n_requests, 8),
+        warm=(max(args.prompt_len // 2, 1), args.prompt_len))
+    registry = ReplicaRegistry(poll_interval=0.2)
+    sup = ReplicaSupervisor(spec, args.router, registry=registry)
+    rt = RouterServer(registry, port=0)
+    try:
+        sup.start()
+        rt.start()
+        if not sup.wait_ready(timeout=600):
+            raise RuntimeError("replicas never became ready")
+        host, port = rt.address
+        base = f"http://{host}:{port}"
+
+        results = {}
+        occ_samples: dict = {}
+        done = threading.Event()
+
+        def occ_sampler():
+            while not done.is_set():
+                for s in registry.statuses():
+                    occ_samples.setdefault(s.endpoint.rid,
+                                           []).append(s.occupancy)
+                time.sleep(0.05)
+
+        def client(i):
+            req = urllib.request.Request(
+                base + "/generate",
+                data=_json.dumps({
+                    "tokens": prompts[i],
+                    "max_new_tokens": args.steps}).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=300) as r:
+                    results[i] = (r.status, _json.loads(r.read()),
+                                  r.headers.get("X-Router-Replica"))
+            except urllib.error.HTTPError as e:
+                results[i] = (e.code, _json.loads(e.read()), None)
+            except Exception as e:
+                # Transport-level failure: a DROPPED request.  It must
+                # show in the accounting — the front tier's whole claim
+                # is that this number stays 0.
+                results[i] = (None, {"type": repr(e)}, None)
+
+        sampler = threading.Thread(target=occ_sampler, daemon=True)
+        sampler.start()
+        threads = []
+        t0 = time.monotonic()
+        for i in range(args.n_requests):
+            now = time.monotonic() - t0
+            if now < arrival[i]:
+                time.sleep(arrival[i] - now)
+            th = threading.Thread(target=client, args=(i,))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        wall = time.monotonic() - t0
+        done.set()
+        sampler.join(1.0)
+
+        toks = sum(len(r[1].get("tokens", []))
+                   for r in results.values())
+        per_replica_req: dict = {}
+        for code, _, rid in results.values():
+            if rid is not None:
+                per_replica_req[rid] = per_replica_req.get(rid, 0) + 1
+        stats = rt.stats()
+        result = {
+            "metric": f"router open-loop tok/s ({args.router} replicas "
+                      f"x S={args.slots} slots, {args.arrival_rate}/s "
+                      f"Poisson, {args.n_requests} reqs x "
+                      f"{args.steps} toks)",
+            "value": round(toks / wall, 2) if wall else 0.0,
+            "unit": "tok/s",
+            "replicas": args.router,
+            "requests": args.n_requests,
+            "completed_with_tokens": sum(
+                1 for c, _, _ in results.values() if c == 200),
+            "typed_errors": sum(
+                1 for c, _, _ in results.values()
+                if c is not None and c != 200),
+            "dropped": args.n_requests - sum(
+                1 for c, _, _ in results.values() if c is not None),
+            "per_replica_requests": per_replica_req,
+            "per_replica_occupancy": {
+                rid: round(float(np.mean(v)), 3)
+                for rid, v in sorted(occ_samples.items())},
+            "router_retries": stats["retries"],
+            "router_failovers": stats["failovers"],
+            "router_replica_restarts": stats["replica_restarts"],
+            "proxy_latency_p50_s":
+                stats["proxy_latency_seconds"]["p50"],
+            "chip": jax.devices()[0].device_kind,
+            "registry": registry.metrics.registry.snapshot(),
+        }
+        print(f"router   {args.router} replicas {result['value']:9.1f} "
+              f"tok/s aggregate | spread {per_replica_req} | "
+              f"retries {stats['retries']:.0f}")
+        print(json.dumps(result))
+    finally:
+        rt.stop()
+        sup.stop(drain=False)
+
+
 def _engine_mode(args, T, cfg, params) -> None:
     """Open-loop continuous-batching benchmark: Poisson arrivals at
     ``--arrival-rate`` req/s with prompt lengths mixed over
@@ -489,6 +635,11 @@ def main() -> None:
                     help="continuous-batching open-loop benchmark "
                          "(horovod_tpu/serving/) instead of the "
                          "static-batch sweep")
+    ap.add_argument("--router", type=int, default=0, metavar="N",
+                    help="open-loop benchmark through the replicated "
+                         "front tier: N replica processes behind the "
+                         "join-shortest-queue router "
+                         "(docs/serving.md 'Front tier')")
     ap.add_argument("--slots", type=int, default=8,
                     help="engine mode: cache slots S")
     ap.add_argument("--max-prefills-per-tick", type=int, default=2,
@@ -524,7 +675,7 @@ def main() -> None:
         for k, v in clamped.items():
             setattr(args, k, v)
         args.batches = [b for b in args.batches if b <= 8] or [1]
-        if args.engine and args.arrival_rate < 64.0:
+        if (args.engine or args.router) and args.arrival_rate < 64.0:
             # Saturate arrivals on the smoke config: at TPU-shaped
             # arrival rates the CPU run is dominated by waiting for the
             # Poisson clock and the overlap A/B would measure sleep().
@@ -537,6 +688,17 @@ def main() -> None:
     print(f"chip={kind} d{args.d_model} L{args.n_layers} "
           f"h{args.n_heads} d_ff{args.d_ff} vocab{args.vocab} "
           f"{jnp.dtype(dtype).name}")
+
+    if args.router:
+        kv = args.kv_heads[-1] if args.kv_heads else 0
+        cfg = T.TransformerConfig(
+            vocab_size=args.vocab, d_model=args.d_model,
+            n_heads=args.n_heads, n_layers=args.n_layers, d_ff=args.d_ff,
+            max_seq=args.prompt_len + args.steps,
+            n_kv_heads=kv, attention_impl="reference", dtype=dtype,
+        )
+        _router_mode(args, cfg)
+        return
 
     if args.engine:
         kv = args.kv_heads[-1] if args.kv_heads else 0

@@ -8,6 +8,16 @@ batching at work.  Runs on any backend, including JAX_PLATFORMS=cpu.
 
 Run:  python examples/serve.py [--steps 30] [--port 8000] [--keep]
       python examples/serve.py --trace /tmp/serve_trace.json --chaos
+      python examples/serve.py --replicas 3
+
+``--replicas N`` (N > 1) stands up the REPLICATED front tier instead
+(docs/serving.md "Front tier"): the trained params are pickled once,
+a ReplicaSupervisor spawns N replica processes serving them, and a
+router proxies /generate over the pool with join-shortest-queue +
+failover.  The demo SIGKILLs one replica in the middle of the burst
+and shows every request still completing (the router retries on a
+surviving replica; the supervisor respawns the dead one).  SIGTERM /
+Ctrl-C still drain gracefully.
 
 With ``--keep`` the server stays up (curl it yourself):
     curl -s localhost:8000/generate -d '{"tokens": [3,4,5], "max_new_tokens": 8}'
@@ -77,6 +87,128 @@ def train_toy_lm(steps: int):
     return params, cfg
 
 
+def replicated_demo(args, params, cfg) -> None:
+    """The front tier end to end: N replicas serving the SAME trained
+    params behind the router, one SIGKILLed mid-burst — and every
+    request still completes (docs/serving.md "Front tier")."""
+    import os
+    import signal as _signal
+    import tempfile
+
+    from horovod_tpu.serving.router import (
+        ReplicaRegistry,
+        ReplicaSpec,
+        ReplicaSupervisor,
+        RouterServer,
+    )
+    from horovod_tpu.serving.router.replica_main import dump_model
+
+    fd, params_path = tempfile.mkstemp(prefix="serve_lm_",
+                                       suffix=".pkl")
+    os.close(fd)
+    dump_model(params_path, params, cfg)
+
+    stop_requested = threading.Event()
+    signal.signal(signal.SIGTERM,
+                  lambda signum, frame: stop_requested.set())
+
+    registry = ReplicaRegistry(poll_interval=0.2, heartbeat_stale=15.0)
+    sup = ReplicaSupervisor(
+        ReplicaSpec(params_path=params_path, slots=args.slots,
+                    warm=[8], tick_timeout=30.0, drain_timeout=10.0),
+        args.replicas, registry=registry, unhealthy_grace=3.0)
+    rt = RouterServer(registry, port=args.port)
+    try:
+        sup.start()
+        rt.start()
+        host, port = rt.address
+        base = f"http://{host}:{port}"
+        print(f"spawning {args.replicas} replicas "
+              f"(pids {[h.pid for h in sup.replicas()]}) ...")
+        if not sup.wait_ready(timeout=180):
+            raise RuntimeError("replicas never became ready")
+        print(f"router on {base}  ({args.replicas} replicas in rotation)")
+
+        # Twice the single-engine burst, through the router; replica
+        # r0 is SIGKILLed once half the requests are in flight.
+        n = 2 * args.clients
+        rng = np.random.default_rng(0)
+        out, errs = {}, {}
+        started = threading.Semaphore(0)
+
+        def client(i):
+            start = int(rng.integers(0, 24))
+            prompt = [(start + j) % 32 for j in range(2 + i % 3)]
+            req = urllib.request.Request(
+                base + "/generate",
+                data=json.dumps({"tokens": prompt,
+                                 "max_new_tokens": 6 + i % 4}).encode(),
+                headers={"Content-Type": "application/json"})
+            started.release()
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    out[i] = (prompt, json.loads(r.read()),
+                              r.headers.get("X-Router-Replica"))
+            except urllib.error.HTTPError as e:
+                errs[i] = (e.code, json.loads(e.read()))
+            except Exception as e:  # transport failure = a real DROP
+                errs[i] = (None, {"type": repr(e)})
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for _ in range(n // 2):
+            started.acquire()
+        victim = sup.handle(0)
+        print(f"SIGKILL replica {victim.rid} (pid {victim.pid}) "
+              f"mid-burst ...")
+        os.kill(victim.pid, _signal.SIGKILL)
+        for t in threads:
+            t.join()
+
+        by_rep = {}
+        for i, (prompt, resp, rep) in sorted(out.items()):
+            by_rep.setdefault(rep, []).append(i)
+            print(f"client {i:2d}: {prompt} -> {resp['tokens']}  "
+                  f"(via {rep}, {resp['finish_reason']})")
+        for i, (code, resp) in sorted(errs.items()):
+            print(f"client {i:2d}: HTTP {code} ({resp.get('type')})")
+        stats = rt.stats()
+        dropped = (n - len(out)
+                   - sum(1 for c, _ in errs.values() if c is not None))
+        print(f"{len(out) + len(errs)}/{n} requests resolved: "
+              f"{len(out)} with tokens, "
+              f"{len(errs) - dropped} typed errors, {dropped} dropped")
+        print(f"per-replica: "
+              f"{ {k: len(v) for k, v in by_rep.items()} }  "
+              f"retries={stats['retries']:.0f} "
+              f"failovers={stats['failovers']:.0f}")
+
+        deadline = time.monotonic() + 60
+        while (len(registry.in_rotation()) < args.replicas
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+        print(f"supervisor respawned {victim.rid} -> "
+              f"{sup.handle(0).rid}; "
+              f"{len(registry.in_rotation())}/{args.replicas} back in "
+              f"rotation (restarts: "
+              f"{registry.metrics.replica_restarts.value:.0f})")
+
+        if args.keep and not stop_requested.is_set():
+            print("serving until SIGTERM / Ctrl-C ...")
+            try:
+                stop_requested.wait()
+            except KeyboardInterrupt:
+                pass
+        print("draining front tier (replicas finish in-flight work) ...")
+    finally:
+        rt.stop()
+        sup.stop(drain=True)
+        os.unlink(params_path)
+    print("stopped")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30, help="train steps")
@@ -94,6 +226,10 @@ def main() -> None:
     ap.add_argument("--chaos", action="store_true",
                     help="inject one decode fault after the demo burst "
                          "so the trace shows a supervised engine restart")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="N > 1: serve through the replicated front "
+                         "tier (router + supervisor) and SIGKILL one "
+                         "replica mid-burst to demo zero-drop failover")
     args = ap.parse_args()
 
     import horovod_tpu as hvd
@@ -103,6 +239,16 @@ def main() -> None:
     if args.trace:
         obs.tracing.start(args.trace, jsonl_path=args.trace + ".jsonl")
     params, cfg = train_toy_lm(args.steps)
+
+    if args.replicas > 1:
+        replicated_demo(args, params, cfg)
+        if args.trace:
+            obs.tracing.stop()
+            print(f"trace written: {args.trace} (open in "
+                  f"https://ui.perfetto.dev); request log: "
+                  f"{args.trace}.jsonl")
+        hvd.shutdown()
+        return
 
     inj = serving.FaultInjector() if args.chaos else None
     engine = serving.InferenceEngine(

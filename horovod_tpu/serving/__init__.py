@@ -62,8 +62,12 @@ from horovod_tpu.serving.scheduler import (
     ServingError,
 )
 from horovod_tpu.serving.server import ServingServer
+# The replicated front tier (router subpackage) — imported last: it
+# builds ON the engine/server modules above, never the reverse.
+from horovod_tpu.serving import router  # noqa: E402  (docs/serving.md "Front tier")
 
 __all__ = [
+    "router",
     "SlotCache", "PagedSlotCache", "init_slot_cache", "init_page_pool",
     "insert_prefill", "insert_prefill_batch",
     "EngineConfig", "GenerationFuture", "InferenceEngine",

@@ -521,6 +521,15 @@ class InferenceEngine:
         return list(self._transitions)
 
     @property
+    def terminal(self) -> bool:
+        """True once the engine can never serve again (restart budget
+        exhausted or :meth:`terminate`) — a transient watchdog
+        ``failed`` that a supervised restart may still recover from
+        reads False.  Replica processes key their exit code on this
+        (router/replica_main.py)."""
+        return self._terminal
+
+    @property
     def heartbeat_age(self) -> Optional[float]:
         """Seconds since the last COMPLETED tick (None before the
         first) — the liveness number ``/healthz`` reports so probes
@@ -1712,7 +1721,16 @@ class InferenceEngine:
         return {
             **self.metrics.snapshot(),
             "state": self._health,
-            "heartbeat_age_s": round(age, 3) if age is not None else None,
+            # The ROUTING CONTRACT (docs/serving.md "HTTP API"): these
+            # four keys are always present and typed — the front tier
+            # balances and evicts on them, so their absence or a None
+            # must never be a reachable state.  heartbeat_age_s is
+            # -1.0 until the first tick completes (a warming engine,
+            # not a wedged one).
+            "queue_depth": int(self.scheduler.depth),
+            "occupancy": float(self.slots.occupancy),
+            "engine_state": str(self._health),
+            "heartbeat_age_s": round(age, 3) if age is not None else -1.0,
             "state_transitions": self.state_transitions,
             "n_slots": self.engine_cfg.n_slots,
             "slots_active": self.slots.active_count,

@@ -1,0 +1,59 @@
+"""Replicated serving front tier (docs/serving.md "Front tier").
+
+One engine replica is a single point of failure; this package spreads
+traffic over N of them and keeps N true.  It marries the repo's two
+halves — the elastic runner's supervision machinery (exit-code +
+heartbeat monitoring, drain, exponential-backoff respawn, exactly the
+:mod:`horovod_tpu.runner.elastic_driver` playbook pointed at serving
+workers) and the continuous-batching engine — behind one stdlib-HTTP
+front door:
+
+* :class:`~horovod_tpu.serving.router.supervisor.ReplicaSupervisor`
+  spawns N replica processes (each a full engine + HTTP server on its
+  own port, :mod:`horovod_tpu.serving.router.replica_main`), watches
+  their exit codes, and drains/respawns dead, terminally-``failed``,
+  or wedged replicas with exponential backoff;
+* :class:`~horovod_tpu.serving.router.registry.ReplicaRegistry` polls
+  each replica's ``/stats`` snapshot (the stable contract keys:
+  ``queue_depth``, ``occupancy``, ``engine_state``,
+  ``heartbeat_age_s``) on a short interval and maintains the live
+  routing set — draining/failed/stale/unreachable replicas leave
+  rotation within one poll;
+* :class:`~horovod_tpu.serving.router.server.RouterServer` proxies
+  ``/generate`` with a join-shortest-queue policy, propagates
+  ``X-Trace-Id``, and on replica failure mid-request retries on
+  another replica (capped attempts + backoff) — a SIGKILL'd replica
+  under load drops zero requests, because a failed replica resolved
+  nothing.
+
+    from horovod_tpu.serving.router import (
+        ReplicaRegistry, ReplicaSpec, ReplicaSupervisor, RouterServer)
+
+    registry = ReplicaRegistry()
+    sup = ReplicaSupervisor(ReplicaSpec(seed=0), n_replicas=3,
+                            registry=registry).start()
+    sup.wait_ready(timeout=120)
+    with RouterServer(registry, port=8000) as rt:
+        ...                       # POST /generate just like one engine
+    sup.stop()
+"""
+
+from horovod_tpu.serving.router.metrics import RouterMetrics
+from horovod_tpu.serving.router.registry import (
+    ReplicaEndpoint,
+    ReplicaRegistry,
+    ReplicaStatus,
+)
+from horovod_tpu.serving.router.server import RouterServer
+from horovod_tpu.serving.router.supervisor import (
+    EXIT_CODE_REPLICA_FAILED,
+    ReplicaHandle,
+    ReplicaSpec,
+    ReplicaSupervisor,
+)
+
+__all__ = [
+    "EXIT_CODE_REPLICA_FAILED",
+    "ReplicaEndpoint", "ReplicaHandle", "ReplicaRegistry", "ReplicaSpec",
+    "ReplicaStatus", "ReplicaSupervisor", "RouterMetrics", "RouterServer",
+]

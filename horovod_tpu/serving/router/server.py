@@ -1,0 +1,325 @@
+"""The front door: one stdlib HTTP listener proxying over N replicas.
+
+Same idiom as :mod:`horovod_tpu.serving.server` — a
+``ThreadingHTTPServer`` with one handler thread per connection — but
+each ``POST /generate`` is PROXIED to a replica chosen by
+join-shortest-queue over the registry's live routing set, instead of
+submitted to a local engine.
+
+Failover contract (docs/serving.md "Front tier"): when the chosen
+replica fails mid-request at the connection level (refused, reset,
+proxy timeout — the SIGKILL signature), the router evicts it from
+rotation immediately (:meth:`ReplicaRegistry.mark_failed`) and retries
+the SAME request on another replica, up to ``max_attempts`` with
+exponential backoff.  The retry is safe: a replica that died at the
+connection level resolved nothing — the client saw no bytes — and
+generation is repeatable, so re-running it elsewhere changes nothing
+the caller can observe.  A replica that ANSWERS, even with a typed
+error, resolved the request; 503 (draining / engine failed — the
+replica is leaving rotation and produced no tokens) and 429 (queue
+full / out of pages — another replica may have room) are relayed only
+after a retry elsewhere also fails.  Responses the replica produced
+tokens for (200, 400, 413, 504) are relayed verbatim, trace id and
+all.
+
+Endpoints:
+
+* ``POST /generate`` — proxied with failover, as above.  Adds
+  ``X-Router-Replica`` (the replica that answered) and
+  ``X-Router-Attempts``.  When no replica is in rotation: 503
+  ``{"type": "no_replicas"}`` with a ``Retry-After`` header.
+* ``GET /healthz`` — 200 while at least one replica is in rotation,
+  503 (+ ``Retry-After``) otherwise; body carries
+  ``replicas_in_rotation`` / ``replicas_total``.
+* ``GET /stats`` — the router metrics snapshot plus every replica's
+  last polled status.
+* ``GET /metrics`` — the ``router_*`` families as Prometheus text.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from horovod_tpu.obs import tracing as obs_tracing
+from horovod_tpu.serving.router.registry import ReplicaRegistry
+
+__all__ = ["RouterServer"]
+
+#: Replica responses that mean "this replica cannot take the request,
+#: but another one might": worth a retry elsewhere before relaying.
+RETRYABLE_STATUS = (429, 503)
+
+
+class _ProxyError(Exception):
+    """A proxy attempt died at the connection level: nothing was
+    resolved on the replica side, so a retry duplicates no work the
+    client could ever observe."""
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet: metrics are the log
+        pass
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _json(self, code: int, payload: dict,
+              headers: Optional[Dict[str, str]] = None) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # -- GET ---------------------------------------------------------------
+
+    def do_GET(self):
+        router: "RouterServer" = self.server.router
+        registry = router.registry
+        if self.path == "/healthz":
+            up = len(registry.in_rotation())
+            total = len(registry.statuses())
+            code = 200 if up else 503
+            hdrs = {} if up else {"Retry-After": str(router.retry_after)}
+            self._json(code, {
+                "status": "healthy" if up else "no_replicas",
+                "replicas_in_rotation": up,
+                "replicas_total": total,
+            }, headers=hdrs)
+        elif self.path == "/stats":
+            self._json(200, router.stats())
+        elif self.path == "/metrics":
+            body = registry.metrics.registry.to_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        else:
+            self._json(404, {"error": f"unknown path {self.path}"})
+
+    # -- POST /generate: proxy with failover -------------------------------
+
+    def _proxy_once(self, status_ep, body: bytes,
+                    trace_id: Optional[str],
+                    timeout: float) -> Tuple[int, bytes, Dict[str, str]]:
+        """One attempt against one replica.  Raises :class:`_ProxyError`
+        on connection-level failure (retry-safe); returns the replica's
+        full response otherwise."""
+        ep = status_ep.endpoint
+        conn = http.client.HTTPConnection(ep.host, ep.port,
+                                          timeout=timeout)
+        try:
+            headers = {"Content-Type": "application/json"}
+            if trace_id:
+                headers[obs_tracing.TRACE_ID_HEADER] = trace_id
+            conn.request("POST", "/generate", body=body, headers=headers)
+            resp = conn.getresponse()
+            payload = resp.read()
+            out_headers = {}
+            for h in (obs_tracing.TRACE_ID_HEADER, "Retry-After"):
+                v = resp.getheader(h)
+                if v is not None:
+                    out_headers[h] = v
+            return resp.status, payload, out_headers
+        except (OSError, socket.timeout, http.client.HTTPException) as e:
+            raise _ProxyError(f"replica {ep.rid}: {e}") from e
+        finally:
+            conn.close()
+
+    def do_POST(self):
+        router: "RouterServer" = self.server.router
+        registry = router.registry
+        metrics = registry.metrics
+        # Read the body FIRST, error paths included: HTTP/1.1
+        # keep-alive would parse unread body bytes as the next request.
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(n)
+        except ValueError:
+            self._json(400, {"error": "bad Content-Length"})
+            return
+        if self.path != "/generate":
+            self._json(404, {"error": f"unknown path {self.path}"})
+            return
+        hdr = self.headers.get(obs_tracing.TRACE_ID_HEADER)
+        trace_id = hdr if obs_tracing.valid_trace_id(hdr) \
+            else obs_tracing.mint_trace_id()
+        metrics.requests.inc()
+
+        tried = set()
+        attempts = 0
+        last: Optional[Tuple[int, bytes, Dict[str, str]]] = None
+        while attempts < router.max_attempts:
+            rep = registry.pick(exclude=tried)
+            if rep is None and tried:
+                # Everything in rotation was already tried; a replica
+                # may have REJOINED (or a respawn landed) — allow a
+                # fresh pick rather than failing a retryable request.
+                rep = registry.pick()
+            if rep is None:
+                break
+            if attempts:
+                metrics.retries.inc()
+                time.sleep(min(
+                    router.retry_backoff * (2.0 ** (attempts - 1)),
+                    router.retry_backoff_max))
+            attempts += 1
+            tried.add(rep.endpoint.rid)
+            t0 = time.monotonic()
+            try:
+                status, payload, hdrs = self._proxy_once(
+                    rep, body, trace_id, router.proxy_timeout)
+            except _ProxyError:
+                metrics.proxy_latency.observe(time.monotonic() - t0)
+                # Connection-level death: evict NOW (the poll thread
+                # would take up to one interval to notice) and retry —
+                # the replica resolved nothing, so the retry is safe.
+                registry.mark_failed(rep.endpoint.rid)
+                continue
+            metrics.proxy_latency.observe(time.monotonic() - t0)
+            if status in RETRYABLE_STATUS:
+                last = (status, payload, hdrs)
+                continue
+            if attempts > 1 and status == 200:
+                # Only a SUCCESS bought by a retry counts as a
+                # failover save (the documented meaning of the family).
+                metrics.failovers.inc()
+            hdrs.setdefault(obs_tracing.TRACE_ID_HEADER, trace_id)
+            hdrs["X-Router-Replica"] = rep.endpoint.rid
+            hdrs["X-Router-Attempts"] = str(attempts)
+            self._relay(status, payload, hdrs)
+            return
+
+        metrics.requests_failed.inc()
+        if last is not None:
+            # Every replica we reached answered with a typed
+            # retryable error — relay the last one (it carries the
+            # replica's own reason and trace id) rather than masking
+            # it behind a generic router error.
+            status, payload, hdrs = last
+            hdrs.setdefault(obs_tracing.TRACE_ID_HEADER, trace_id)
+            hdrs.setdefault("Retry-After", str(router.retry_after))
+            hdrs["X-Router-Attempts"] = str(attempts)
+            self._relay(status, payload, hdrs)
+            return
+        self._json(503, {
+            "error": "no replica in rotation"
+                     if not attempts else
+                     f"no replica reachable after {attempts} attempt(s)",
+            "type": "no_replicas",
+            "trace_id": trace_id,
+            "attempts": attempts,
+        }, headers={"Retry-After": str(router.retry_after),
+                    obs_tracing.TRACE_ID_HEADER: trace_id})
+
+    def _relay(self, status: int, payload: bytes,
+               headers: Dict[str, str]) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+
+class RouterServer:
+    """Own the router HTTP listener (and optionally the registry poll
+    thread) lifecycle.
+
+    >>> rt = RouterServer(registry, port=0).start()
+    >>> rt.address                       # ("127.0.0.1", 43117)
+    >>> rt.stop()
+
+    ``max_attempts`` caps placement tries per request;
+    ``retry_backoff`` / ``retry_backoff_max`` shape the exponential
+    backoff between them; ``proxy_timeout`` bounds one attempt — set
+    it ABOVE the replicas' ``request_timeout`` so a slow-but-correct
+    replica is never double-generated, and the timeout only fires for
+    replicas that genuinely wedged.  ``retry_after`` is the seconds
+    hint on 503s (load shedding guidance for well-behaved clients).
+    """
+
+    def __init__(self, registry: ReplicaRegistry, *,
+                 host: str = "127.0.0.1", port: int = 8080,
+                 max_attempts: int = 3,
+                 retry_backoff: float = 0.05,
+                 retry_backoff_max: float = 1.0,
+                 proxy_timeout: float = 150.0,
+                 retry_after: int = 1,
+                 own_registry_thread: bool = True) -> None:
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.registry = registry
+        self.host = host
+        self.port = port
+        self.max_attempts = max_attempts
+        self.retry_backoff = retry_backoff
+        self.retry_backoff_max = retry_backoff_max
+        self.proxy_timeout = proxy_timeout
+        self.retry_after = retry_after
+        self._own_registry_thread = own_registry_thread
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self):
+        """(host, port) actually bound (resolves port=0)."""
+        if self._httpd is None:
+            return (self.host, self.port)
+        return self._httpd.server_address[:2]
+
+    def stats(self) -> Dict:
+        return {
+            **self.registry.metrics.snapshot(),
+            "policy": "join-shortest-queue",
+            "max_attempts": self.max_attempts,
+            "in_rotation": sorted(
+                s.endpoint.rid for s in self.registry.in_rotation()),
+            "replicas": {s.endpoint.rid: s.as_dict()
+                         for s in self.registry.statuses()},
+        }
+
+    def start(self) -> "RouterServer":
+        if self._httpd is not None:
+            return self
+        if self._own_registry_thread:
+            self.registry.start()
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          _RouterHandler)
+        self._httpd.daemon_threads = True
+        self._httpd.router = self
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="router-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        if self._own_registry_thread:
+            self.registry.stop()
+
+    def __enter__(self) -> "RouterServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,407 @@
+"""ReplicaSupervisor: keep N engine replicas alive, forever.
+
+The elastic driver's playbook (:mod:`horovod_tpu.runner.elastic_driver`
+— exit-code watchers, heartbeat staleness, notice → grace → terminate,
+exponential backoff between epochs) pointed at serving workers instead
+of training ranks.  Differences that matter:
+
+* replicas are INDEPENDENT — there is no mesh to re-rendezvous, so a
+  death never touches the survivors: the dead slot respawns alone
+  while the registry keeps routing to the rest;
+* "failed" has two shapes HTTP can see that an exit code cannot:
+  a replica whose engine went terminally ``failed`` (the replica
+  self-exits with :data:`EXIT_CODE_REPLICA_FAILED`, and the registry
+  evicts it within a poll either way), and a WEDGED replica whose
+  process is alive but whose engine stopped ticking (stale
+  ``heartbeat_age_s``) or whose HTTP listener stopped answering.  The
+  supervisor watches the registry for replicas that stay unroutable
+  past ``unhealthy_grace`` (or never become routable within
+  ``startup_timeout``) and runs the drain sequence on them: SIGTERM
+  (the replica's graceful-drain handler), ``shutdown_grace`` to
+  comply, then SIGKILL — the exit watcher then respawns as usual;
+* restarts are UNBOUNDED: a front tier's job is to keep capacity up,
+  so a crash-looping replica is rate-limited by exponential backoff
+  (``backoff_initial``..``backoff_max``, reset after a replica
+  survives ``backoff_reset_after`` seconds), never given up on.
+
+Each spawn gets a fresh port and a fresh registry identity
+(``r<slot>g<generation>``), so a respawn can never inherit a dead
+process's poll state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from horovod_tpu.runner.run_func import _free_port
+from horovod_tpu.serving.router.registry import (
+    ReplicaEndpoint,
+    ReplicaRegistry,
+)
+
+logger = logging.getLogger("horovod_tpu")
+
+__all__ = ["EXIT_CODE_REPLICA_FAILED", "ReplicaHandle", "ReplicaSpec",
+           "ReplicaSupervisor"]
+
+#: A replica whose engine went terminally ``failed`` exits with this
+#: code (cf. the elastic worker's EXIT_CODE_RESTART=75): the exit
+#: watcher sees an unambiguous "engine dead, process fine" and
+#: respawns without waiting for the registry to notice.
+EXIT_CODE_REPLICA_FAILED = 76
+
+
+@dataclasses.dataclass
+class ReplicaSpec:
+    """What one replica process serves — rendered into a
+    ``python -m horovod_tpu.serving.router.replica_main`` command line.
+
+    Either ``params_path`` (a pickle written by
+    :func:`horovod_tpu.serving.router.replica_main.dump_model` — the
+    trained-model path ``examples/serve.py --replicas`` uses) or the
+    model-shape fields + ``seed`` (deterministic init, what the tests
+    use: every replica built from the same seed serves oracle-identical
+    greedy output).  ``faults`` are replica-side FaultInjector specs
+    (``site:kind[:skip[:delay]]``) for chaos tests.
+    """
+
+    params_path: Optional[str] = None
+    seed: int = 0
+    vocab: int = 64
+    d_model: int = 32
+    n_heads: int = 4
+    n_layers: int = 2
+    d_ff: int = 64
+    max_seq: int = 48
+    n_kv_heads: int = 2
+    slots: int = 4
+    max_queue_depth: int = 64
+    max_prefills_per_tick: int = 2
+    tick_timeout: float = 60.0
+    request_timeout: float = 120.0
+    drain_timeout: float = 10.0
+    warm: Sequence[int] = ()
+    faults: Sequence[str] = ()
+    extra_args: Sequence[str] = ()
+
+    def command(self, port: int, host: str = "127.0.0.1") -> List[str]:
+        cmd = [sys.executable, "-m",
+               "horovod_tpu.serving.router.replica_main",
+               "--host", host,
+               "--port", str(port),
+               "--slots", str(self.slots),
+               "--max-queue-depth", str(self.max_queue_depth),
+               "--max-prefills-per-tick", str(self.max_prefills_per_tick),
+               "--tick-timeout", repr(self.tick_timeout),
+               "--request-timeout", repr(self.request_timeout),
+               "--drain-timeout", repr(self.drain_timeout)]
+        if self.params_path:
+            cmd += ["--params", self.params_path]
+        else:
+            cmd += ["--seed", str(self.seed),
+                    "--vocab", str(self.vocab),
+                    "--d-model", str(self.d_model),
+                    "--n-heads", str(self.n_heads),
+                    "--n-layers", str(self.n_layers),
+                    "--d-ff", str(self.d_ff),
+                    "--max-seq", str(self.max_seq),
+                    "--kv-heads", str(self.n_kv_heads)]
+        for w in self.warm:
+            cmd += ["--warm", str(w)]
+        for f in self.faults:
+            cmd += ["--fault", f]
+        cmd += list(self.extra_args)
+        return cmd
+
+
+@dataclasses.dataclass
+class ReplicaHandle:
+    """One supervised replica slot's live process."""
+
+    slot: int
+    gen: int
+    port: int
+    proc: subprocess.Popen
+    spawned_at: float
+    restarts: int = 0            # respawns of this SLOT so far
+    term_sent_at: Optional[float] = None
+    unroutable_since: Optional[float] = None
+
+    @property
+    def rid(self) -> str:
+        return f"r{self.slot}g{self.gen}"
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+
+class ReplicaSupervisor:
+    """Spawn, monitor, drain, and respawn N replica processes.
+
+    ``spec`` is a :class:`ReplicaSpec` or a callable
+    ``(slot, port) -> command list`` for custom replica programs.  The
+    supervisor feeds the shared ``registry`` (creating one when not
+    given): endpoints are added at spawn and removed at reap, so the
+    router's routing set always reflects live processes — readiness
+    itself comes from the registry's polls.
+    """
+
+    def __init__(self, spec, n_replicas: int, *,
+                 registry: Optional[ReplicaRegistry] = None,
+                 host: str = "127.0.0.1",
+                 env: Optional[Dict[str, str]] = None,
+                 backoff_initial: float = 0.5,
+                 backoff_max: float = 10.0,
+                 backoff_reset_after: float = 30.0,
+                 shutdown_grace: float = 5.0,
+                 unhealthy_grace: float = 5.0,
+                 startup_timeout: float = 300.0,
+                 monitor_interval: float = 0.1,
+                 log_dir: Optional[str] = None) -> None:
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        self._spec = spec
+        self.n_replicas = n_replicas
+        self.registry = registry if registry is not None \
+            else ReplicaRegistry()
+        self._host = host
+        self._env = env
+        self._backoff_initial = backoff_initial
+        self._backoff_max = backoff_max
+        self._backoff_reset_after = backoff_reset_after
+        self._shutdown_grace = shutdown_grace
+        self._unhealthy_grace = unhealthy_grace
+        self._startup_timeout = startup_timeout
+        self._monitor_interval = monitor_interval
+        self._log_dir = log_dir
+        self._lock = threading.Lock()
+        self._handles: Dict[int, ReplicaHandle] = {}   # slot -> handle
+        self._respawn_at: Dict[int, float] = {}        # slot -> monotonic
+        self._gen: Dict[int, int] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ReplicaSupervisor":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        for slot in range(self.n_replicas):
+            self._spawn(slot)
+        self._thread = threading.Thread(
+            target=self._monitor_loop, name="replica-supervisor",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        """Stop supervision and tear every replica down — gracefully
+        (SIGTERM → replica drain) when ``drain``, escalating to
+        SIGKILL after ``shutdown_grace`` either way."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        with self._lock:
+            handles = list(self._handles.values())
+            self._handles.clear()
+            self._respawn_at.clear()
+        for h in handles:
+            self.registry.remove(h.rid)
+            if h.proc.poll() is None:
+                self._signal(h, signal.SIGTERM if drain else signal.SIGKILL)
+        deadline = time.monotonic() + self._shutdown_grace
+        for h in handles:
+            while h.proc.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if h.proc.poll() is None:
+                self._signal(h, signal.SIGKILL)
+                h.proc.wait()
+
+    def wait_ready(self, n: Optional[int] = None,
+                   timeout: float = 300.0) -> bool:
+        """Block until ``n`` (default: all) replicas are in rotation.
+        The registry poll thread must be running (RouterServer.start
+        does that) — or poll here when it is not."""
+        want = self.n_replicas if n is None else n
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.registry._thread is None:
+                self.registry.poll_now()
+            if len(self.registry.in_rotation()) >= want:
+                return True
+            time.sleep(0.1)
+        return False
+
+    def replicas(self) -> List[ReplicaHandle]:
+        with self._lock:
+            return list(self._handles.values())
+
+    def handle(self, slot: int) -> Optional[ReplicaHandle]:
+        with self._lock:
+            return self._handles.get(slot)
+
+    # -- spawn / reap ------------------------------------------------------
+
+    def _command(self, slot: int, port: int) -> List[str]:
+        if callable(self._spec):
+            # Custom commands own their bind address; the registry
+            # still polls self._host, so the callable must agree.
+            return list(self._spec(slot, port))
+        return self._spec.command(port, self._host)
+
+    def _spawn(self, slot: int) -> None:
+        gen = self._gen.get(slot, -1) + 1
+        self._gen[slot] = gen
+        port = _free_port()
+        env = dict(os.environ)
+        if self._env:
+            env.update(self._env)
+        # The replica must import horovod_tpu no matter where the
+        # supervisor's process got it from (checkout, PYTHONPATH, or
+        # bare cwd): pin the package's own root onto the child's path.
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else pkg_root)
+        prev = self._handles.get(slot)
+        restarts = prev.restarts + 1 if prev is not None else 0
+        out = subprocess.DEVNULL
+        if self._log_dir:
+            os.makedirs(self._log_dir, exist_ok=True)
+            out = open(os.path.join(self._log_dir,
+                                    f"r{slot}g{gen}.log"), "wb")
+        proc = subprocess.Popen(
+            self._command(slot, port), env=env,
+            stdout=out, stderr=subprocess.STDOUT if self._log_dir
+            else subprocess.DEVNULL,
+            start_new_session=True)
+        if out is not subprocess.DEVNULL:
+            out.close()  # the child holds its own fd now
+        h = ReplicaHandle(slot=slot, gen=gen, port=port, proc=proc,
+                          spawned_at=time.monotonic(), restarts=restarts)
+        with self._lock:
+            self._handles[slot] = h
+            self._respawn_at.pop(slot, None)
+        self.registry.add(ReplicaEndpoint(h.rid, self._host, port))
+        self._instant("replica_spawn" if gen == 0 else "replica_respawn",
+                      {"rid": h.rid, "pid": proc.pid, "port": port})
+        if gen:
+            self.registry.metrics.replica_restarts.inc()
+            logger.warning(
+                "router: respawned replica slot %d as %s (pid %d, "
+                "port %d, restart #%d)", slot, h.rid, proc.pid, port,
+                restarts)
+
+    def _signal(self, h: ReplicaHandle, sig: int) -> None:
+        try:
+            # The whole session: a replica that forked helpers dies
+            # with them (start_new_session=True above).
+            os.killpg(os.getpgid(h.proc.pid), sig)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                h.proc.send_signal(sig)
+            except (ProcessLookupError, OSError):
+                pass
+
+    # -- monitor -----------------------------------------------------------
+
+    def _monitor_loop(self) -> None:
+        while not self._stop.wait(self._monitor_interval):
+            try:
+                self._sweep()
+            except Exception:  # pragma: no cover - supervision survives
+                logger.exception("router: supervisor sweep failed")
+
+    def _sweep(self) -> None:
+        now = time.monotonic()
+        routable = {s.endpoint.rid
+                    for s in self.registry.in_rotation()}
+        with self._lock:
+            handles = list(self._handles.items())
+        for slot, h in handles:
+            rc = h.proc.poll()
+            if rc is not None:
+                self._reap(slot, h, rc, now)
+                continue
+            # Health policing over the registry's view: a live process
+            # whose replica is terminally failed, wedged (stale
+            # heartbeat), or unreachable gets the drain sequence.
+            if h.rid in routable:
+                h.unroutable_since = None
+                if h.term_sent_at is None:
+                    continue
+            if h.term_sent_at is not None:
+                if now - h.term_sent_at >= self._shutdown_grace:
+                    self._signal(h, signal.SIGKILL)
+                continue
+            if h.unroutable_since is None:
+                h.unroutable_since = now
+                continue
+            grace = (self._unhealthy_grace
+                     if self._was_ready(h) else self._startup_timeout)
+            if now - h.unroutable_since >= grace:
+                logger.warning(
+                    "router: replica %s (pid %d) unroutable for %.1fs; "
+                    "draining and respawning", h.rid, h.pid,
+                    now - h.unroutable_since)
+                self._instant("replica_drain", {"rid": h.rid,
+                                                "pid": h.pid})
+                h.term_sent_at = now
+                self._signal(h, signal.SIGTERM)
+
+    def _was_ready(self, h: ReplicaHandle) -> bool:
+        for s in self.registry.statuses():
+            if s.endpoint.rid == h.rid:
+                return s.ever_routable
+        return False
+
+    def _reap(self, slot: int, h: ReplicaHandle, rc: int,
+              now: float) -> None:
+        with self._lock:
+            if self._handles.get(slot) is not h:
+                return  # already replaced
+            first = slot not in self._respawn_at
+            if first:
+                if now - h.spawned_at >= self._backoff_reset_after:
+                    # Survived long enough: this death starts a FRESH
+                    # backoff sequence (crash loops back off, steady
+                    # replicas respawn instantly).
+                    h.restarts = -1  # _spawn adds 1 -> 0
+                    backoff = 0.0
+                else:
+                    backoff = min(
+                        self._backoff_initial * (2.0 ** h.restarts),
+                        self._backoff_max)
+                self._respawn_at[slot] = now + backoff
+            when = self._respawn_at[slot]
+        if first:
+            self.registry.remove(h.rid)
+            self._instant("replica_exit", {"rid": h.rid, "pid": h.pid,
+                                           "exit_code": rc})
+            logger.warning(
+                "router: replica %s (pid %d) exited with code %s%s",
+                h.rid, h.pid, rc,
+                " (engine terminally failed)"
+                if rc == EXIT_CODE_REPLICA_FAILED else "")
+        if now >= when and not self._stop.is_set():
+            self._spawn(slot)
+
+    @staticmethod
+    def _instant(name: str, args: Dict) -> None:
+        try:
+            from horovod_tpu.obs import tracing as obs_tracing
+
+            obs_tracing.instant(name, args)
+        except Exception:  # pragma: no cover
+            pass

@@ -25,7 +25,11 @@ Endpoints:
   never have to parse the full ``/stats`` JSON.
 * ``GET /stats`` — the full metrics snapshot (serving/metrics.py),
   including ``state``, ``state_transitions``, ``engine_failures`` and
-  ``engine_restarts``.
+  ``engine_restarts``.  Four keys are a STABLE ROUTING CONTRACT
+  (docs/serving.md "HTTP API") — always present, always typed:
+  ``queue_depth`` (int), ``occupancy`` (float 0..1), ``engine_state``
+  (str), ``heartbeat_age_s`` (float; -1.0 until the first tick
+  completes).  The front tier balances and evicts on exactly these.
 * ``GET /metrics`` — Prometheus text exposition (0.0.4): the engine's
   ``serving_*`` families plus the process default registry (training,
   elastic, eager-runtime, timeline families) in one scrape.
@@ -72,7 +76,8 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _json(self, code: int, payload: dict,
-              trace_id: Optional[str] = None) -> None:
+              trace_id: Optional[str] = None,
+              headers: Optional[dict] = None) -> None:
         if trace_id is not None:
             payload.setdefault("trace_id", trace_id)
         body = json.dumps(payload).encode()
@@ -80,6 +85,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         if trace_id is not None:
             self.send_header(obs_tracing.TRACE_ID_HEADER, trace_id)
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -94,10 +101,12 @@ class _Handler(BaseHTTPRequestHandler):
                 "status": state,
                 "slots_free": engine.slots.free_count,
                 "queue_depth": engine.scheduler.depth,
+                # -1.0 = no tick completed yet (same sentinel as
+                # /stats: the key is always a float, never null)
                 "heartbeat_age_s":
-                    round(age, 3) if age is not None else None,
+                    round(age, 3) if age is not None else -1.0,
                 "engine_restarts": engine.metrics.engine_restarts.value,
-            })
+            }, headers=None if code == 200 else {"Retry-After": "1"})
         elif self.path == "/stats":
             self._json(200, engine.stats())
         elif self.path == "/metrics":
@@ -164,12 +173,13 @@ class _Handler(BaseHTTPRequestHandler):
                        trace_id=trace_id)
             return
 
-        def fut_err(code: int, e: BaseException, etype: str) -> None:
+        def fut_err(code: int, e: BaseException, etype: str,
+                    headers: Optional[dict] = None) -> None:
             payload = {"error": str(e), "type": etype}
             b = fut.breakdown() if fut is not None else None
             if b is not None:
                 payload["breakdown"] = b
-            self._json(code, payload, trace_id=trace_id)
+            self._json(code, payload, trace_id=trace_id, headers=headers)
 
         timeout_ms = req.get("timeout_ms")
         fut = None
@@ -209,7 +219,10 @@ class _Handler(BaseHTTPRequestHandler):
             fut_err(504, e, "deadline_exceeded")
             return
         except DrainingError as e:
-            fut_err(503, e, "draining")
+            # Retry-After: draining is TRANSIENT from the fleet's point
+            # of view — a router retries elsewhere immediately, a bare
+            # client should come back shortly (docs/serving.md).
+            fut_err(503, e, "draining", headers={"Retry-After": "1"})
             return
         except EngineFailedError as e:
             # Submit-time (terminally failed) or result-time (this

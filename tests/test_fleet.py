@@ -647,6 +647,7 @@ class TestMetricsNamingLint:
             "default": R.default_registry(),
             "serving": serving.ServingMetrics().registry,
             "fleet": FLEET.FleetMonitor().registry,
+            "router": serving.router.RouterMetrics().registry,
         }
         docs = open(os.path.join(REPO, "docs", "observability.md")).read()
         problems = []

@@ -1,0 +1,714 @@
+"""Replicated serving front tier (horovod_tpu/serving/router/).
+
+Two layers of proof:
+
+* **Unit** (fake replicas — tiny stdlib HTTP servers serving canned
+  ``/stats`` and scriptable ``/generate`` behavior): the
+  join-shortest-queue policy, rotation eviction (state / stale
+  heartbeat / poll failure / proxy mark), retry-with-failover
+  semantics, trace-id propagation, the ``Retry-After`` headers, and
+  the ``/stats`` routing contract on a REAL engine.
+* **Chaos** (real replica subprocesses, each a full engine + HTTP
+  server): SIGKILL and FaultInjector-hang a replica mid-request under
+  concurrent load and assert the front-tier invariant — 100% of
+  submitted requests resolve with tokens or a typed error, ZERO
+  drops, the router evicts within a poll, the supervisor respawns,
+  and greedy output stays oracle-identical after failover.
+"""
+
+import json
+import os
+import signal
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import serving
+from horovod_tpu.models import transformer as T
+from horovod_tpu.serving.router import (
+    ReplicaEndpoint,
+    ReplicaRegistry,
+    ReplicaSpec,
+    ReplicaSupervisor,
+    RouterServer,
+)
+from horovod_tpu.serving.router.replica_main import parse_fault
+
+pytestmark = pytest.mark.router
+
+
+# ---------------------------------------------------------------------------
+# fakes: a scriptable replica endpoint without an engine behind it
+# ---------------------------------------------------------------------------
+
+
+class _FakeReplica:
+    """A stdlib HTTP server impersonating one replica: ``/stats``
+    serves a mutable snapshot dict, ``/generate`` behavior is scripted
+    per instance (``ok`` / ``drop`` / ``hang`` / an HTTP status)."""
+
+    def __init__(self, rid, *, queue_depth=0, occupancy=0.0,
+                 state="healthy", heartbeat=0.01):
+        self.rid = rid
+        self.stats = {"queue_depth": queue_depth, "occupancy": occupancy,
+                      "engine_state": state, "heartbeat_age_s": heartbeat}
+        self.mode = "ok"
+        self.hang_s = 10.0
+        self.seen_trace_ids = []
+        self.generate_hits = 0
+        fake = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code, payload, headers=()):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/stats":
+                    self._json(200, dict(fake.stats))
+                else:
+                    self._json(404, {})
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                fake.generate_hits += 1
+                fake.seen_trace_ids.append(
+                    self.headers.get("X-Trace-Id"))
+                if fake.mode == "drop":
+                    # Die mid-request, SIGKILL-style: no status line,
+                    # no body, just a dead socket.
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                    self.connection.close()
+                    return
+                if fake.mode == "hang":
+                    time.sleep(fake.hang_s)
+                if fake.mode == "503":
+                    self._json(503, {"error": "draining",
+                                     "type": "draining"},
+                               headers=[("Retry-After", "1")])
+                    return
+                if fake.mode == "429":
+                    self._json(429, {"error": "queue full",
+                                     "type": "queue_full"})
+                    return
+                self._json(200, {"tokens": [1, 2, 3],
+                                 "finish_reason": "length",
+                                 "served_by": fake.rid})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def endpoint(self):
+        host, port = self._httpd.server_address[:2]
+        return ReplicaEndpoint(self.rid, host, port)
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def _registry(*fakes, **kw):
+    kw.setdefault("poll_interval", 0.05)
+    kw.setdefault("poll_timeout", 1.0)
+    reg = ReplicaRegistry(**kw)
+    for f in fakes:
+        reg.add(f.endpoint)
+    reg.poll_now()
+    return reg
+
+
+def _post(base, payload, headers=(), timeout=30):
+    req = urllib.request.Request(
+        base + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **dict(headers)})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read()), dict(e.headers)
+
+
+# ---------------------------------------------------------------------------
+# registry: routing set + join-shortest-queue
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_jsq_picks_shortest_queue_then_occupancy(self):
+        fakes = [_FakeReplica("a", queue_depth=5, occupancy=0.2),
+                 _FakeReplica("b", queue_depth=1, occupancy=0.9),
+                 _FakeReplica("c", queue_depth=1, occupancy=0.1)]
+        reg = _registry(*fakes)
+        try:
+            assert reg.pick().endpoint.rid == "c"  # ties broken by occ
+            fakes[2].stats["queue_depth"] = 7
+            reg.poll_now()
+            assert reg.pick().endpoint.rid == "b"
+        finally:
+            for f in fakes:
+                f.stop()
+
+    def test_jsq_round_robin_among_ties(self):
+        fakes = [_FakeReplica("a"), _FakeReplica("b"), _FakeReplica("c")]
+        reg = _registry(*fakes)
+        try:
+            picks = [reg.pick().endpoint.rid for _ in range(6)]
+            # All equal load: every replica shares, none is dogpiled.
+            assert sorted(set(picks)) == ["a", "b", "c"]
+            assert picks[:3] != [picks[0]] * 3
+        finally:
+            for f in fakes:
+                f.stop()
+
+    def test_pick_excludes_tried_replicas(self):
+        fakes = [_FakeReplica("a"), _FakeReplica("b")]
+        reg = _registry(*fakes)
+        try:
+            assert reg.pick(exclude={"a", "b"}) is None
+            assert reg.pick(exclude={"a"}).endpoint.rid == "b"
+        finally:
+            for f in fakes:
+                f.stop()
+
+    def test_nonroutable_states_leave_rotation(self):
+        f = _FakeReplica("a")
+        reg = _registry(f)
+        try:
+            assert reg.is_routable("a")
+            for state in ("draining", "failed", "unknown"):
+                f.stats["engine_state"] = state
+                reg.poll_now()
+                assert not reg.is_routable("a"), state
+            f.stats["engine_state"] = "degraded"  # restarted = routable
+            reg.poll_now()
+            assert reg.is_routable("a")
+            assert reg.metrics.replica_evictions.value == 1
+        finally:
+            f.stop()
+
+    def test_stale_heartbeat_evicts(self):
+        f = _FakeReplica("a", heartbeat=0.01)
+        reg = _registry(f, heartbeat_stale=5.0)
+        try:
+            assert reg.is_routable("a")
+            f.stats["heartbeat_age_s"] = 99.0  # engine stopped ticking
+            reg.poll_now()
+            assert not reg.is_routable("a")
+        finally:
+            f.stop()
+
+    def test_never_ticked_gets_startup_grace_then_evicts(self):
+        f = _FakeReplica("a", heartbeat=-1.0)
+        reg = _registry(f, heartbeat_stale=5.0, startup_grace=0.2)
+        try:
+            assert reg.is_routable("a")  # warming, within grace
+            time.sleep(0.25)
+            assert not reg.is_routable("a")  # never ticked: wedged
+        finally:
+            f.stop()
+
+    def test_poll_failures_evict_after_threshold(self):
+        f = _FakeReplica("a")
+        reg = _registry(f, fail_threshold=2)
+        try:
+            assert reg.is_routable("a")
+        finally:
+            f.stop()  # replica gone: polls now fail
+        reg.poll_now()
+        assert reg.is_routable("a")  # one failure: benefit of the doubt
+        reg.poll_now()
+        assert not reg.is_routable("a")
+        assert reg.metrics.poll_errors.value == 2
+
+    def test_mark_failed_is_immediate_and_poll_readmits(self):
+        f = _FakeReplica("a")
+        reg = _registry(f)
+        try:
+            reg.mark_failed("a")
+            assert not reg.is_routable("a")
+            assert reg.pick() is None
+            reg.poll_now()  # replica actually fine: one poll re-admits
+            assert reg.is_routable("a")
+        finally:
+            f.stop()
+
+
+# ---------------------------------------------------------------------------
+# router proxy: failover semantics over fakes
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def front():
+    """(router base url, fakes dict, registry, router) over two fake
+    replicas, polls driven MANUALLY (no thread) for determinism."""
+    fakes = {"a": _FakeReplica("a"), "b": _FakeReplica("b")}
+    reg = _registry(*fakes.values())
+    rt = RouterServer(reg, port=0, max_attempts=3, retry_backoff=0.01,
+                      proxy_timeout=2.0, own_registry_thread=False)
+    rt.start()
+    host, port = rt.address
+    yield f"http://{host}:{port}", fakes, reg, rt
+    rt.stop()
+    for f in fakes.values():
+        f.stop()
+
+
+class TestRouterProxy:
+    def test_proxies_and_tags_replica(self, front):
+        base, fakes, reg, rt = front
+        code, resp, hdrs = _post(base, {"tokens": [1], "max_new_tokens": 2})
+        assert code == 200 and resp["tokens"] == [1, 2, 3]
+        assert hdrs["X-Router-Replica"] in ("a", "b")
+        assert hdrs["X-Router-Attempts"] == "1"
+        assert reg.metrics.requests.value == 1
+
+    def test_trace_id_propagates_and_echoes(self, front):
+        base, fakes, reg, rt = front
+        code, resp, hdrs = _post(base, {"tokens": [1]},
+                                 headers=[("X-Trace-Id", "tid-router-1")])
+        assert code == 200
+        assert hdrs["X-Trace-Id"] == "tid-router-1"
+        served = hdrs["X-Router-Replica"]
+        assert fakes[served].seen_trace_ids == ["tid-router-1"]
+
+    def test_connection_drop_fails_over_zero_client_errors(self, front):
+        base, fakes, reg, rt = front
+        fakes["a"].mode = "drop"
+        for _ in range(4):  # JSQ ties rotate: both replicas get tried
+            code, resp, hdrs = _post(base, {"tokens": [1]})
+            assert code == 200 and resp["served_by"] == "b"
+        assert reg.metrics.retries.value >= 1
+        assert reg.metrics.failovers.value >= 1
+        assert reg.metrics.requests_failed.value == 0
+        # The drop ALSO evicted a: until a poll clears it, b is alone.
+        assert not reg.is_routable("a")
+
+    def test_proxy_timeout_fails_over(self, front):
+        base, fakes, reg, rt = front
+        fakes["a"].mode = "hang"
+        fakes["a"].hang_s = 30.0  # >> proxy_timeout=2.0
+        t0 = time.monotonic()
+        code, resp, hdrs = _post(base, {"tokens": [1]}, timeout=30)
+        assert code == 200 and resp["served_by"] == "b"
+        assert time.monotonic() - t0 < 10.0
+        assert not reg.is_routable("a")
+
+    def test_all_replicas_dead_typed_503_with_retry_after(self, front):
+        base, fakes, reg, rt = front
+        fakes["a"].mode = fakes["b"].mode = "drop"
+        code, resp, hdrs = _post(base, {"tokens": [1]})
+        assert code == 503 and resp["type"] == "no_replicas"
+        assert "Retry-After" in hdrs
+        assert resp["attempts"] == 2
+        assert reg.metrics.requests_failed.value == 1
+
+    def test_typed_503_from_replicas_is_relayed(self, front):
+        base, fakes, reg, rt = front
+        fakes["a"].mode = fakes["b"].mode = "503"
+        code, resp, hdrs = _post(base, {"tokens": [1]})
+        assert code == 503 and resp["type"] == "draining"
+        assert "Retry-After" in hdrs
+        # Both were TRIED before giving up (retry-elsewhere-first).
+        assert fakes["a"].generate_hits + fakes["b"].generate_hits >= 2
+
+    def test_429_retried_elsewhere_then_relayed(self, front):
+        base, fakes, reg, rt = front
+        fakes["a"].mode = "429"
+        code, resp, hdrs = _post(base, {"tokens": [1]})
+        assert code == 200 and resp["served_by"] == "b"
+        fakes["b"].mode = "429"
+        code, resp, hdrs = _post(base, {"tokens": [1]})
+        assert code == 429 and resp["type"] == "queue_full"
+
+    def test_empty_rotation_healthz_503(self):
+        reg = ReplicaRegistry(poll_interval=0.05)
+        rt = RouterServer(reg, port=0, own_registry_thread=False).start()
+        try:
+            host, port = rt.address
+            try:
+                urllib.request.urlopen(
+                    f"http://{host}:{port}/healthz", timeout=5)
+                assert False, "expected 503"
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert e.headers.get("Retry-After") is not None
+                assert json.loads(e.read())["replicas_in_rotation"] == 0
+        finally:
+            rt.stop()
+
+    def test_stats_and_metrics_endpoints(self, front):
+        base, fakes, reg, rt = front
+        _post(base, {"tokens": [1]})
+        with urllib.request.urlopen(base + "/stats", timeout=5) as r:
+            s = json.loads(r.read())
+        assert s["policy"] == "join-shortest-queue"
+        assert sorted(s["in_rotation"]) == ["a", "b"]
+        assert s["replicas"]["a"]["engine_state"] == "healthy"
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "# TYPE router_requests_total counter" in text
+        assert "router_replicas_in_rotation" in text
+
+
+# ---------------------------------------------------------------------------
+# the /stats routing contract + Retry-After on a REAL engine
+# ---------------------------------------------------------------------------
+
+
+def _cfg():
+    return T.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=48, dtype=jnp.float32, attention_impl="reference",
+        n_kv_heads=2)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return T.init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+@pytest.mark.serving
+class TestStatsContract:
+    def test_contract_keys_always_present_and_typed(self, model):
+        params, cfg = model
+        engine = serving.InferenceEngine(
+            params, cfg, serving.EngineConfig(n_slots=2, max_len=16))
+        snap = engine.stats()  # BEFORE any tick: the cold-start shape
+        assert isinstance(snap["queue_depth"], int)
+        assert isinstance(snap["occupancy"], float)
+        assert isinstance(snap["engine_state"], str)
+        assert isinstance(snap["heartbeat_age_s"], float)
+        assert snap["heartbeat_age_s"] == -1.0  # no tick yet, not null
+        assert snap["engine_state"] == "healthy"
+
+        fut = engine.submit([1, 2, 3], max_new_tokens=3)
+        while not fut.done():
+            engine.step()
+        snap = engine.stats()
+        assert snap["heartbeat_age_s"] >= 0.0
+        assert isinstance(snap["occupancy"], float)
+        assert isinstance(snap["queue_depth"], int)
+
+    def test_registry_polls_a_real_server(self, model):
+        params, cfg = model
+        engine = serving.InferenceEngine(
+            params, cfg, serving.EngineConfig(n_slots=2, max_len=16))
+        with serving.ServingServer(engine, port=0) as srv:
+            host, port = srv.address
+            reg = ReplicaRegistry(poll_interval=0.05)
+            reg.add(ReplicaEndpoint("real", host, port))
+            reg.poll_now()
+            assert reg.is_routable("real")
+            engine.begin_drain()
+            reg.poll_now()
+            assert not reg.is_routable("real")  # draining leaves rotation
+
+    def test_draining_503_carries_retry_after(self, model):
+        params, cfg = model
+        engine = serving.InferenceEngine(
+            params, cfg, serving.EngineConfig(n_slots=2, max_len=16))
+        with serving.ServingServer(engine, port=0) as srv:
+            host, port = srv.address
+            engine.begin_drain()
+            code, resp, hdrs = _post(
+                f"http://{host}:{port}",
+                {"tokens": [1, 2], "max_new_tokens": 2})
+            assert code == 503 and resp["type"] == "draining"
+            assert hdrs.get("Retry-After") == "1"
+            try:
+                urllib.request.urlopen(
+                    f"http://{host}:{port}/healthz", timeout=5)
+                assert False, "expected 503"
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                assert e.headers.get("Retry-After") is not None
+                assert isinstance(
+                    json.loads(e.read())["heartbeat_age_s"], float)
+
+
+# ---------------------------------------------------------------------------
+# supervisor unit: crash-loop backoff without JAX subprocess weight
+# ---------------------------------------------------------------------------
+
+
+class TestSupervisorBackoff:
+    def test_crash_loop_respawns_with_backoff(self):
+        import sys
+
+        def cmd(slot, port):
+            return [sys.executable, "-c", "import sys; sys.exit(3)"]
+
+        reg = ReplicaRegistry(poll_interval=10.0)  # polls irrelevant
+        sup = ReplicaSupervisor(cmd, 1, registry=reg,
+                                backoff_initial=0.15, backoff_max=0.6,
+                                backoff_reset_after=999.0,
+                                monitor_interval=0.02)
+        sup.start()
+        try:
+            deadline = time.monotonic() + 8.0
+            while (reg.metrics.replica_restarts.value < 3
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            restarts = reg.metrics.replica_restarts.value
+            assert restarts >= 3, "supervisor stopped respawning"
+            h = sup.handle(0)
+            assert h.gen >= 3 and h.rid == f"r0g{h.gen}"
+        finally:
+            sup.stop(drain=False)
+        # Exponential backoff rate-limited the loop: in ~a second of
+        # 0.15 * 2^n delays there cannot have been tens of respawns.
+        assert reg.metrics.replica_restarts.value < 15
+
+    def test_spec_command_and_fault_parsing(self):
+        spec = ReplicaSpec(seed=7, slots=3, warm=(8, 16),
+                           faults=("decode_tick:hang:5:2.5",))
+        cmd = spec.command(1234)
+        assert "--port" in cmd and "1234" in cmd
+        assert cmd.count("--warm") == 2 and "--fault" in cmd
+        f = parse_fault("decode_tick:hang:5:2.5")
+        assert (f.site, f.kind, f.skip, f.delay) == \
+            ("decode_tick", "hang", 5, 2.5)
+        with pytest.raises(Exception):
+            parse_fault("nonsense")
+
+
+# ---------------------------------------------------------------------------
+# chaos: real replica processes, real kills
+# ---------------------------------------------------------------------------
+
+
+def _ref_greedy(params, cfg, prompt, steps):
+    return np.asarray(T.greedy_decode(
+        params, jnp.asarray([prompt], jnp.int32), steps, cfg))[0].tolist()
+
+
+def _burst(base, prompts, steps, kill_after=None, timeout=60):
+    """Fire one concurrent request per prompt; optionally invoke
+    ``kill_after()`` once half of them are in flight.  Returns
+    ``{i: (code, payload)}`` — an entry for EVERY request (a transport
+    error to the ROUTER itself would be a dropped request and fails
+    the caller's assertions by absence)."""
+    results = {}
+    started = threading.Semaphore(0)
+
+    def client(i):
+        started.release()
+        try:
+            code, resp, _ = _post(base, {"tokens": prompts[i],
+                                         "max_new_tokens": steps},
+                                  timeout=timeout)
+            results[i] = (code, resp)
+        except Exception as e:  # transport failure = a DROP
+            results[i] = (None, repr(e))
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    if kill_after is not None:
+        for _ in range(len(prompts) // 2):
+            started.acquire()
+        kill_after()
+    for t in threads:
+        t.join()
+    return results
+
+
+@pytest.mark.chaos
+class TestFrontTierChaos:
+    """The acceptance invariant (ISSUE 8): with 3 replicas under
+    concurrent load, killing one mid-decode drops ZERO requests; the
+    router evicts it within a poll, the supervisor respawns it, and it
+    rejoins rotation serving oracle-identical greedy output."""
+
+    N_REPLICAS = 3
+
+    def _front_tier(self, spec_or_cmd, **sup_kw):
+        reg = ReplicaRegistry(poll_interval=0.15, poll_timeout=1.0,
+                              heartbeat_stale=3.0)
+        sup_kw.setdefault("unhealthy_grace", 1.5)
+        sup_kw.setdefault("shutdown_grace", 2.0)
+        sup_kw.setdefault("backoff_initial", 0.1)
+        sup = ReplicaSupervisor(spec_or_cmd, self.N_REPLICAS,
+                                registry=reg, **sup_kw)
+        rt = RouterServer(reg, port=0, max_attempts=4,
+                          retry_backoff=0.05, proxy_timeout=8.0)
+        return reg, sup, rt
+
+    def test_sigkill_replica_zero_dropped_requests(self, model):
+        params, cfg = model
+        spec = ReplicaSpec(seed=0, slots=3, warm=(8,),
+                           tick_timeout=30.0, drain_timeout=3.0)
+        reg, sup, rt = self._front_tier(spec)
+        sup.start()
+        rt.start()
+        try:
+            assert sup.wait_ready(timeout=180), "replicas never ready"
+            host, port = rt.address
+            base = f"http://{host}:{port}"
+
+            rng = np.random.default_rng(0)
+            steps = 8
+            prompts = [[int(t) for t in rng.integers(1, 60, 2 + i % 3)]
+                       for i in range(18)]
+            victim = sup.handle(1)
+
+            results = _burst(
+                base, prompts, steps,
+                kill_after=lambda: os.kill(victim.pid, signal.SIGKILL))
+
+            # 1) ZERO drops: every request resolved through the router
+            #    with tokens (typed errors allowed by the invariant,
+            #    but with 2 healthy survivors none should occur).
+            assert len(results) == len(prompts)
+            drops = [i for i, (c, _) in results.items() if c is None]
+            assert not drops, f"transport-dropped requests: {results}"
+            for i, (code, resp) in results.items():
+                assert code == 200, f"req {i}: {code} {resp}"
+                # 2) oracle-identity THROUGH failover: greedy tokens
+                #    equal per-request greedy_decode, whichever replica
+                #    (including a retry target) served them.
+                assert resp["tokens"] == _ref_greedy(
+                    params, cfg, prompts[i], steps), f"req {i}"
+
+            # 3) the dead replica left rotation (within ~a poll; the
+            #    burst's own mark_failed usually beat the poll to it).
+            deadline = time.monotonic() + 5.0
+            while (victim.rid in {s.endpoint.rid
+                                  for s in reg.in_rotation()}
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            assert victim.rid not in {
+                s.endpoint.rid for s in reg.in_rotation()}
+
+            # 4) the supervisor respawns it and it REJOINS rotation …
+            deadline = time.monotonic() + 120.0
+            while (len(reg.in_rotation()) < self.N_REPLICAS
+                   and time.monotonic() < deadline):
+                time.sleep(0.1)
+            assert len(reg.in_rotation()) == self.N_REPLICAS
+            fresh = sup.handle(1)
+            assert fresh.gen == victim.gen + 1
+            assert reg.metrics.replica_restarts.value >= 1
+
+            # 5) … serving oracle-identical output (probe repeatedly:
+            #    JSQ spreads probes over the pool, so the respawned
+            #    replica answers at least one).
+            seen = set()
+            for k in range(12):
+                code, resp, hdrs = _post(
+                    base, {"tokens": prompts[0], "max_new_tokens": steps})
+                assert code == 200
+                assert resp["tokens"] == _ref_greedy(
+                    params, cfg, prompts[0], steps)
+                seen.add(hdrs["X-Router-Replica"])
+                if fresh.rid in seen:
+                    break
+            assert fresh.rid in seen, \
+                f"respawned replica never served: {seen}"
+        finally:
+            rt.stop()
+            sup.stop(drain=False)
+
+    def test_hang_replica_zero_dropped_requests(self, model):
+        """FaultInjector-hang: slot 0's engine wedges mid-decode (hang
+        with the watchdog DISABLED — the worst case: the process is
+        alive, HTTP answers, the engine never ticks again).  In-flight
+        proxied requests ride the proxy timeout onto a survivor; the
+        stale heartbeat evicts it; the supervisor drains (SIGTERM →
+        SIGKILL escalation) and respawns it CLEAN."""
+        params, cfg = model
+        hang = ReplicaSpec(seed=0, slots=3, warm=(8,), tick_timeout=0.0,
+                           drain_timeout=1.0,
+                           faults=("decode_tick:hang:8:600",))
+        clean = ReplicaSpec(seed=0, slots=3, warm=(8,),
+                            tick_timeout=30.0, drain_timeout=3.0)
+        first_spawn = set()
+
+        def cmd(slot, port):
+            # Only slot 0's FIRST generation carries the fault: the
+            # respawn must come back clean.
+            spec = hang if slot == 0 and slot not in first_spawn \
+                else clean
+            first_spawn.add(slot)
+            return spec.command(port)
+
+        reg, sup, rt = self._front_tier(cmd)
+        sup.start()
+        rt.start()
+        try:
+            assert sup.wait_ready(timeout=180), "replicas never ready"
+            victim = sup.handle(0)
+            host, port = rt.address
+            base = f"http://{host}:{port}"
+
+            rng = np.random.default_rng(1)
+            steps = 6
+            prompts = [[int(t) for t in rng.integers(1, 60, 2 + i % 3)]
+                       for i in range(15)]
+            # No kill callback: the fault fires by itself once slot 0
+            # has dispatched 12 decode ticks (warmup spent ~a handful).
+            results = _burst(base, prompts, steps, timeout=90)
+
+            assert len(results) == len(prompts)
+            drops = [i for i, (c, _) in results.items() if c is None]
+            assert not drops, f"transport-dropped requests: {results}"
+            resolved_with_tokens = 0
+            for i, (code, resp) in results.items():
+                assert code in (200, 429, 503, 504), \
+                    f"req {i}: {code} {resp}"
+                if code == 200:
+                    resolved_with_tokens += 1
+                    assert resp["tokens"] == _ref_greedy(
+                        params, cfg, prompts[i], steps), f"req {i}"
+                else:
+                    assert "type" in resp, f"untyped error: {resp}"
+            # The survivors carried the load: the overwhelming majority
+            # completed with tokens despite a wedged replica.
+            assert resolved_with_tokens >= len(prompts) - 3
+
+            # Eviction (stale heartbeat or proxy timeout), then the
+            # supervisor's drain → respawn brings back a clean gen 1.
+            deadline = time.monotonic() + 120.0
+            while time.monotonic() < deadline:
+                h = sup.handle(0)
+                if (h.gen >= victim.gen + 1
+                        and len(reg.in_rotation()) == self.N_REPLICAS):
+                    break
+                time.sleep(0.2)
+            assert sup.handle(0).gen >= victim.gen + 1, \
+                "wedged replica never respawned"
+            assert len(reg.in_rotation()) == self.N_REPLICAS
+            code, resp, _ = _post(base, {"tokens": prompts[0],
+                                         "max_new_tokens": steps})
+            assert code == 200 and resp["tokens"] == _ref_greedy(
+                params, cfg, prompts[0], steps)
+        finally:
+            rt.stop()
+            sup.stop(drain=False)
