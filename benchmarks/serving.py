@@ -33,6 +33,15 @@ and the JSON line reports aggregate tok/s, per-replica request counts
 and mean occupancy, and the router's retry/failover counters:
 
     python benchmarks/serving.py --router 2 [--slots 8] [--arrival-rate 4]
+
+``--chaos`` is the DURABILITY benchmark (docs/serving.md "Durable
+in-flight requests"): the same open-loop workload with deterministic
+engine crashes injected mid-decode and restart-resume on — the JSON
+line reports resumed-vs-restarted counts, the wasted-token ratio
+(tokens re-prefilled by resumes / tokens generated), and per-request
+byte-identity against the no-fault greedy oracle:
+
+    python benchmarks/serving.py --chaos [--slots 8]
 """
 
 from __future__ import annotations
@@ -475,6 +484,109 @@ def _router_mode(args, cfg) -> None:
         sup.stop(drain=False)
 
 
+def _chaos_mode(args, T, cfg, params) -> None:
+    """Durability benchmark (``--chaos``): the open-loop workload with
+    deterministic engine crashes injected mid-decode, restart-resume
+    ON (the default).  Reports resumed-vs-restarted counts, the
+    wasted-token ratio (tokens re-prefilled by resumes / tokens
+    generated), and per-request oracle identity — the honest price
+    and proof of durability under faults."""
+    from horovod_tpu import serving
+
+    rng = np.random.default_rng(0)
+    lengths = rng.integers(max(args.prompt_len // 2, 1),
+                           args.prompt_len + 1, args.n_requests)
+    prompts = [rng.integers(0, cfg.vocab_size, n).tolist()
+               for n in lengths]
+    arrival = np.cumsum(rng.exponential(1.0 / args.arrival_rate,
+                                        args.n_requests))
+
+    inj = serving.FaultInjector(seed=0)
+    engine = serving.InferenceEngine(
+        params, cfg, serving.EngineConfig(
+            n_slots=args.slots, max_len=cfg.max_seq,
+            max_prefills_per_tick=args.max_prefills_per_tick,
+            max_queue_depth=max(args.n_requests, 8),
+            max_restarts=1000, restart_backoff=0.01,
+            restart_backoff_max=0.05, faults=inj))
+    # Warm the prompt buckets AND the resume buckets (prompt + emitted
+    # can reach prompt_len + steps): a resumed re-admission must not
+    # pay XLA compilation mid-benchmark.
+    cap = engine.slots.max_len - 2
+    warm = sorted({min(n, cap) for p in prompts
+                   for n in (len(p), len(p) + args.steps)})
+    engine.warmup(warm)
+    # One crash roughly every ``steps`` decode ticks, spread across the
+    # run — each one forces a restart with in-flight requests to
+    # resume.
+    base = inj.visits("decode_tick")
+    n_faults = 4
+    for i in range(n_faults):
+        inj.add(serving.FaultSpec(
+            site="decode_tick", kind="raise",
+            skip=base + 5 + i * max(args.steps, 8)))
+
+    engine.start()
+    futs = []
+    t0 = time.monotonic()
+    for i in range(args.n_requests):
+        now = time.monotonic() - t0
+        if now < arrival[i]:
+            time.sleep(arrival[i] - now)
+        futs.append(engine.submit(prompts[i], max_new_tokens=args.steps))
+    while not all(f.done() for f in futs):
+        time.sleep(0.005)
+    wall = time.monotonic() - t0
+    engine.stop()
+
+    # Byte-identity against the no-fault greedy oracle, per request.
+    ok = typed = mismatched = 0
+    for p, f in zip(prompts, futs):
+        try:
+            out = f.result(timeout=0)
+        except serving.ServingError:
+            typed += 1
+            continue
+        ref = np.asarray(T.greedy_decode(
+            params, jnp.asarray([p], jnp.int32), args.steps,
+            cfg))[0].tolist()
+        if out == ref:
+            ok += 1
+        else:
+            mismatched += 1
+
+    snap = engine.stats()
+    toks = snap["tokens_generated"]
+    wasted = snap["resume_wasted_tokens"]
+    result = {
+        "metric": f"chaos durability: wasted-token ratio under "
+                  f"{n_faults} injected crashes "
+                  f"(S={args.slots}, {args.n_requests} reqs x "
+                  f"{args.steps} toks, restart-resume on)",
+        "value": round(wasted / toks, 4) if toks else None,
+        "unit": "re-prefilled/generated",
+        "requests_resumed": snap["requests_resumed"],
+        "engine_restarts": snap["engine_restarts"],
+        "engine_failures": snap["engine_failures"],
+        "requests_oracle_identical": ok,
+        "requests_typed_error": typed,
+        "requests_mismatched": mismatched,
+        "resume_wasted_tokens": wasted,
+        "tokens_generated": toks,
+        "wall_s": round(wall, 3),
+        "faults_fired": [list(f) for f in inj.fired],
+        "journal_inflight": snap["journal_inflight"],
+        "decode_compilations": snap["decode_compilations"],
+        "chip": jax.devices()[0].device_kind,
+    }
+    print(f"chaos    {snap['requests_resumed']:.0f} resumed across "
+          f"{snap['engine_restarts']:.0f} restarts | "
+          f"{ok}/{len(futs)} oracle-identical ({typed} typed, "
+          f"{mismatched} mismatched) | wasted-token ratio "
+          f"{result['value']}")
+    print(json.dumps(result))
+
+
 def _engine_mode(args, T, cfg, params) -> None:
     """Open-loop continuous-batching benchmark: Poisson arrivals at
     ``--arrival-rate`` req/s with prompt lengths mixed over
@@ -640,6 +752,12 @@ def main() -> None:
                          "front tier: N replica processes behind the "
                          "join-shortest-queue router "
                          "(docs/serving.md 'Front tier')")
+    ap.add_argument("--chaos", action="store_true",
+                    help="durability benchmark: the open-loop workload "
+                         "with deterministic engine crashes injected "
+                         "mid-decode (restart-resume on); reports "
+                         "resumed-vs-restarted counts, wasted-token "
+                         "ratio, and per-request oracle identity")
     ap.add_argument("--slots", type=int, default=8,
                     help="engine mode: cache slots S")
     ap.add_argument("--max-prefills-per-tick", type=int, default=2,
@@ -675,7 +793,8 @@ def main() -> None:
         for k, v in clamped.items():
             setattr(args, k, v)
         args.batches = [b for b in args.batches if b <= 8] or [1]
-        if (args.engine or args.router) and args.arrival_rate < 64.0:
+        if (args.engine or args.router or args.chaos) \
+                and args.arrival_rate < 64.0:
             # Saturate arrivals on the smoke config: at TPU-shaped
             # arrival rates the CPU run is dominated by waiting for the
             # Poisson clock and the overlap A/B would measure sleep().
@@ -700,7 +819,7 @@ def main() -> None:
         _router_mode(args, cfg)
         return
 
-    if args.engine:
+    if args.engine or args.chaos:
         kv = args.kv_heads[-1] if args.kv_heads else 0
         cfg = T.TransformerConfig(
             vocab_size=args.vocab, d_model=args.d_model,
@@ -709,7 +828,10 @@ def main() -> None:
             n_kv_heads=kv, attention_impl="reference", dtype=dtype,
         )
         params = T.init_params(jax.random.PRNGKey(0), cfg)
-        _engine_mode(args, T, cfg, params)
+        if args.chaos:
+            _chaos_mode(args, T, cfg, params)
+        else:
+            _engine_mode(args, T, cfg, params)
         return
 
     for kv in args.kv_heads:

@@ -113,11 +113,14 @@ def replicated_demo(args, params, cfg) -> None:
                   lambda signum, frame: stop_requested.set())
 
     registry = ReplicaRegistry(poll_interval=0.2, heartbeat_stale=15.0)
+    journal_dir = tempfile.mkdtemp(prefix="serve_journal_")
     sup = ReplicaSupervisor(
         ReplicaSpec(params_path=params_path, slots=args.slots,
                     warm=[8], tick_timeout=30.0, drain_timeout=10.0),
-        args.replicas, registry=registry, unhealthy_grace=3.0)
-    rt = RouterServer(registry, port=args.port)
+        args.replicas, registry=registry, unhealthy_grace=3.0,
+        journal_dir=journal_dir)
+    rt = RouterServer(registry, port=args.port,
+                      resume_lookup=sup.resume_lookup)
     try:
         sup.start()
         rt.start()
@@ -183,7 +186,8 @@ def replicated_demo(args, params, cfg) -> None:
         print(f"per-replica: "
               f"{ {k: len(v) for k, v in by_rep.items()} }  "
               f"retries={stats['retries']:.0f} "
-              f"failovers={stats['failovers']:.0f}")
+              f"failovers={stats['failovers']:.0f} "
+              f"resumed={stats['resume_failovers']:.0f}")
 
         deadline = time.monotonic() + 60
         while (len(registry.in_rotation()) < args.replicas

@@ -8,7 +8,12 @@ and a threaded stdlib-HTTP front — wrapped in a fault-tolerance layer
 (supervised tick restarts, a watchdog against hung ticks, typed
 failure propagation, cancellation, graceful drain) whose invariant is
 that every submitted request resolves in bounded time with tokens or
-a typed error.  The decode hot loop is a device/host pipeline
+a typed error.  In-flight requests are DURABLE (docs/serving.md
+"Operations"): their decode state is journaled
+(:mod:`horovod_tpu.serving.journal`), restarts RESUME them
+token-identically instead of failing them, and the front tier
+continues a dead replica's partially decoded requests on a survivor
+from the journal's resume descriptor.  The decode hot loop is a device/host pipeline
 (``EngineConfig.overlap``, default on): device-resident tokens feed
 tick N's output straight into tick N+1's dispatch while host
 bookkeeping runs one tick behind — token-identical to the synchronous
@@ -43,6 +48,10 @@ from horovod_tpu.serving.faults import (
     FaultSpec,
     InjectedFaultError,
 )
+from horovod_tpu.serving.journal import (
+    JournalEntry,
+    RequestJournal,
+)
 from horovod_tpu.serving.metrics import (
     Counter,
     Gauge,
@@ -73,6 +82,7 @@ __all__ = [
     "EngineConfig", "GenerationFuture", "InferenceEngine",
     "HEALTHY", "DEGRADED", "DRAINING", "FAILED",
     "FaultInjector", "FaultSpec", "InjectedFaultError",
+    "JournalEntry", "RequestJournal",
     "Counter", "Gauge", "Histogram", "ServingMetrics",
     "CacheOutOfPagesError", "DeadlineExceededError", "DrainingError",
     "EngineFailedError", "EngineStalledError", "QueueFullError",

@@ -46,20 +46,33 @@ Fault tolerance (docs/serving.md "Operations"; the runtime analogue of
 the training side's typed rank-failure surfacing + ``Join`` + elastic
 supervision):
 
-* **Supervised tick loop** — any exception out of :meth:`step` fails
-  every in-flight future with a typed
-  :class:`~horovod_tpu.serving.scheduler.EngineFailedError`, then the
-  engine restarts itself: fresh :class:`SlotCache` (the device cache
-  is suspect after a failure), bounded consecutive attempts with
-  exponential backoff, ``engine_restarts`` counter.  Queued requests
-  survive a restart; only when the restart budget is exhausted does
-  the engine go terminally ``failed`` and resolve the queue too.
+* **Supervised tick loop with DURABLE requests** — any exception out
+  of :meth:`step` triggers a supervised restart: fresh
+  :class:`SlotCache` (the device cache is suspect after a failure),
+  bounded consecutive attempts with exponential backoff,
+  ``engine_restarts`` counter.  With ``EngineConfig.resume`` (the
+  default) in-flight requests SURVIVE the restart: their decode state
+  is journaled (:class:`~horovod_tpu.serving.journal.RequestJournal`
+  — original prompt, params, tokens emitted so far), and ``_restart``
+  re-admits each by prefilling ``prompt + emitted`` and continuing
+  decode with the ORIGINAL future still live — concatenated output
+  token-identical to an uninterrupted run, wasted work bounded by one
+  tick plus one re-prefill.  ``resume=False`` restores the old
+  fail-typed behavior
+  (:class:`~horovod_tpu.serving.scheduler.EngineFailedError` on every
+  in-flight future).  Queued requests survive either way; only when
+  the restart budget is exhausted does the engine go terminally
+  ``failed`` and resolve everything typed.
 * **Watchdog** — :meth:`start` also runs a watchdog thread against a
   per-tick heartbeat; a tick exceeding ``tick_timeout`` is declared
-  *stalled* (hung device call): in-flight AND queued futures resolve
-  with :class:`~horovod_tpu.serving.scheduler.EngineStalledError`
-  immediately (a hung tick may never return), and if it does return,
-  the loop restarts through the same supervised path.
+  *stalled* (hung device call).  With ``resume``, in-flight futures
+  are HELD through ``stall_grace`` — a tick that returns inside it
+  resumes them token-exact — and only past budget + grace does the
+  watchdog resolve everything with
+  :class:`~horovod_tpu.serving.scheduler.EngineStalledError` (the
+  bounded-resolution backstop).  Without ``resume``, in-flight AND
+  queued futures resolve immediately at the stall, as before; either
+  way a tick that does return restarts through the supervised path.
 * **Lifecycle states** — ``healthy`` / ``degraded`` (just restarted) /
   ``draining`` (shutdown in progress, new submits rejected) /
   ``failed`` (restart budget exhausted or stalled), surfaced through
@@ -96,6 +109,7 @@ from horovod_tpu.serving.cache import (  # noqa: F401
     init_slot_cache,
 )
 from horovod_tpu.serving.faults import FaultInjector
+from horovod_tpu.serving.journal import RequestJournal
 from horovod_tpu.serving.metrics import ServingMetrics
 from horovod_tpu.serving.scheduler import (
     CacheOutOfPagesError,
@@ -151,6 +165,10 @@ class GenerationFuture:
         # (engine, watchdog, or HTTP handler).
         self.trace: Optional["obs_tracing.RequestTrace"] = None
         self._tracer: Optional["obs_tracing.Tracer"] = None
+        # Resolution hook (the engine wires the request's journal
+        # purge here): fires exactly once, from whichever thread
+        # resolves the future, AFTER the resolution is visible.
+        self._on_resolve: Optional[Callable[[], None]] = None
 
     # engine-side ----------------------------------------------------------
     # Resolution is serialized by _resolve_lock: the watchdog may fail
@@ -159,10 +177,13 @@ class GenerationFuture:
     # future, the loser is a no-op (a bare done-check would let both
     # pass the guard and leave finish_reason AND an exception set).
 
-    def _add_token(self, tok: int) -> None:
+    def _add_token(self, tok: int) -> bool:
+        """Append one emitted token; returns False if the future was
+        already resolved (the caller must not journal a token the
+        caller-visible result will never contain)."""
         with self._resolve_lock:
             if self._done.is_set():
-                return
+                return False
             self._tokens.append(tok)
             piece = None
             if self._detokenize is not None:
@@ -170,6 +191,7 @@ class GenerationFuture:
                 self._text.append(piece)
         if self._on_token is not None:
             self._on_token(tok, piece)
+        return True
 
     def _finish(self, reason: str) -> None:
         with self._resolve_lock:
@@ -182,6 +204,7 @@ class GenerationFuture:
                 self.trace.tokens = len(self._tokens)
             self._done.set()
         self._emit_trace()
+        self._fire_resolve()
 
     def set_exception(self, exc: BaseException) -> None:
         with self._resolve_lock:
@@ -194,6 +217,17 @@ class GenerationFuture:
                 self.trace.tokens = len(self._tokens)
             self._done.set()
         self._emit_trace()
+        self._fire_resolve()
+
+    def _fire_resolve(self) -> None:
+        # Same once-only guarantee as _emit_trace: only the resolving
+        # thread gets past the done-check inside the lock.
+        cb = self._on_resolve
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # pragma: no cover - cleanup must not fail work
+                pass
 
     def _emit_trace(self) -> None:
         # Outside _resolve_lock (file/queue IO must not serialize
@@ -302,7 +336,27 @@ class EngineConfig:
     period; ``faults`` threads a deterministic
     :class:`~horovod_tpu.serving.faults.FaultInjector` through the
     engine's failure-prone sites (tests only — leave None in
-    production)."""
+    production).
+
+    Durability (``resume``, default on — docs/serving.md "Operations"):
+    in-flight requests survive supervised restarts.  Every live
+    request is journaled (:class:`~horovod_tpu.serving.journal.
+    RequestJournal`: original prompt, params, trace id, tokens emitted
+    so far); a restart re-admits each one by prefilling ``prompt +
+    emitted`` and continuing decode, with the original future staying
+    live — concatenated output token-identical to an uninterrupted
+    run, wasted work bounded by one tick plus one re-prefill.
+    ``resume=False`` restores the PR 3 behavior (in-flight futures
+    fail typed on any restart).  ``journal_path`` additionally writes
+    the journal as an append-only JSONL file that survives SIGKILL —
+    the router reads a dead replica's file to fail partially-decoded
+    requests over to a surviving replica (docs/serving.md "Front
+    tier").  ``stall_grace`` is how long past ``tick_timeout`` a
+    STALLED tick may still return and have its requests resumed;
+    beyond it the watchdog hard-fails everything typed, restoring the
+    bounded-resolution guarantee (None = one extra ``tick_timeout``;
+    ignored when ``resume=False`` — stalls then fail futures
+    immediately, as before)."""
 
     n_slots: int = 4
     max_len: int = 0
@@ -320,6 +374,9 @@ class EngineConfig:
     restart_backoff_max: float = 2.0
     tick_timeout: float = 60.0
     watchdog_interval: float = 0.05
+    resume: bool = True
+    journal_path: Optional[str] = None
+    stall_grace: Optional[float] = None
     faults: Optional[FaultInjector] = None
     # Model FLOPs per generated token (e.g.
     # obs.xprof.transformer_flops_per_token(params)): turns the token
@@ -391,6 +448,7 @@ class InferenceEngine:
         self._last_tick_done: Optional[float] = None  # /healthz heartbeat age
         self._epoch = 0          # bumped on every restart
         self._stalled = False    # set by the watchdog, cleared on recovery
+        self._stall_hard_failed = False  # grace spent: futures resolved typed
         self._health = HEALTHY
         self._health_lock = threading.Lock()
         self._transitions: List[str] = [HEALTHY]
@@ -402,6 +460,19 @@ class InferenceEngine:
         # restart may undo (budget exhausted / terminate()).
         self._draining = False
         self._terminal = False
+        # Requests suspended for resume mid-_recover: in neither the
+        # queue nor a slot until the requeue lands, but their futures
+        # are live — drain() must not read that window as "idle".
+        self._resuming = 0
+
+        # Durability: the journal records every live request's original
+        # prompt, params, and emitted-so-far tokens — what a restart
+        # re-admits (resume) and what the router reads post-mortem from
+        # a SIGKILL'd replica's journal file (journal_path).  Created
+        # whenever either consumer exists.
+        self.journal: Optional[RequestJournal] = None
+        if engine_cfg.resume or engine_cfg.journal_path:
+            self.journal = RequestJournal(engine_cfg.journal_path)
 
         # Compile-count hook: the traced-function body runs ONLY when jax
         # (re)traces, so this counter IS the number of decode
@@ -630,7 +701,21 @@ class InferenceEngine:
         fut._tracer = obs_tracing.get()
         req = Request(prompt=prompt, max_new_tokens=n_new, future=fut,
                       eos_id=eos_id, deadline=deadline, trace=fut.trace)
-        self.scheduler.submit(req)  # QueueFullError counts via on_reject
+        if self.journal is not None:
+            # Journal BEFORE the enqueue, purge-on-resolve wired first:
+            # every resolution path (retire, typed error, cancel,
+            # terminate, the post-enqueue race checks below) funnels
+            # through the future, so an entry can never outlive its
+            # request — no ghost re-admission after a later restart.
+            journal, rid = self.journal, req.id
+            fut._on_resolve = lambda: journal.end(rid)
+            journal.begin(req)
+        try:
+            self.scheduler.submit(req)  # QueueFullError counts, on_reject
+        except QueueFullError:
+            if self.journal is not None:
+                self.journal.end(req.id)  # never enqueued: nothing to resume
+            raise
         # Post-enqueue re-checks close the submit-vs-shutdown races:
         # the pre-checks above can pass just before a terminal failure
         # drains the queue, or just before begin_drain() + drain()
@@ -1045,8 +1130,11 @@ class InferenceEngine:
             faults.probe("prefill")
         t_adm = time.monotonic()
         for req in reqs:
-            if req.trace is not None:
-                req.trace.admitted_at = t_adm  # queue-wait ends here
+            if req.trace is not None and req.trace.admitted_at is None:
+                # queue-wait ends here; a RESUMED re-admission keeps
+                # its first life's stamps (prefill_s would otherwise
+                # go negative against the original first_token_at)
+                req.trace.admitted_at = t_adm
         if self.engine_cfg.paged:
             slots, reqs, firsts, synced = self._admit_paged(reqs)
             if not reqs:
@@ -1060,12 +1148,17 @@ class InferenceEngine:
             self.metrics.host_syncs.inc()
         now = time.monotonic()
         for slot, req, first in zip(slots, reqs, firsts):
-            ttft = now - req.submitted_at
-            req.future.ttft = ttft
+            if req.future.ttft is None:
+                # A RESUMED request already served its first token in a
+                # previous life — its TTFT was honest then and must not
+                # be rewritten by the re-admission.
+                ttft = now - req.submitted_at
+                req.future.ttft = ttft
+                self.metrics.ttft.observe(ttft)
             if req.trace is not None:
                 req.trace.slot = slot
-                req.trace.first_token_at = now
-            self.metrics.ttft.observe(ttft)
+                if req.trace.first_token_at is None:
+                    req.trace.first_token_at = now
             self.metrics.admitted.inc()
             self._states[slot] = _SlotState(request=req,
                                             last_token=int(first),
@@ -1220,7 +1313,13 @@ class InferenceEngine:
             self._states[slot] = None
             self.slots.free(slot)
             return
-        st.request.future._add_token(tok)
+        if st.request.future._add_token(tok) and self.journal is not None:
+            # The journal mirrors the future EXACTLY: a token is
+            # recorded iff the caller will see it, so a resume's
+            # re-prefill (prompt + emitted) reproduces precisely the
+            # oracle's state — never a token from a stale or
+            # already-resolved row.
+            self.journal.append(st.request.id, tok)
         st.last_token = tok
         st.n_generated += 1
         self.metrics.tokens_generated.inc()
@@ -1401,15 +1500,81 @@ class InferenceEngine:
 
     def _fail_inflight(self, exc: BaseException) -> None:
         """Resolve every in-flight future (slots + taken-but-unlanded)
-        with ``exc`` and reset slot bookkeeping — including the slot
-        allocator, so terminal states (no _restart to rebuild it) don't
-        report phantom occupancy forever.  Idempotent per future
-        (set_exception no-ops once done)."""
+        with ``exc`` and reset slot bookkeeping — the TERMINAL path
+        (and :meth:`terminate`): nothing will resume, so every future
+        fails typed (which also purges its journal entry).  Idempotent
+        per future (set_exception no-ops once done)."""
         for st in self._states:
             if st is not None:
                 st.request.future.set_exception(exc)
         for req in self._taken:
             req.future.set_exception(exc)
+        self._clear_inflight_state()
+
+    def _suspend_inflight(self, exc: BaseException) -> List[Request]:
+        """The NON-terminal restart path: collect every in-flight
+        request (slots + taken-but-unlanded) as a RESUME request —
+        original prompt + journaled emitted tokens as the new prompt,
+        the remaining decode budget, the original deadline, trace, and
+        (crucially) the original live future — then reset slot
+        bookkeeping exactly like :meth:`_fail_inflight`.  Requests
+        that cannot resume (future already resolved, cancellation
+        pending, no journal entry, or ``resume=False``) are resolved
+        in place.  Returned in original FCFS order (by request id),
+        ready for :meth:`Scheduler.requeue_front`."""
+        resumed: List[Request] = []
+        pending = [st.request for st in self._states if st is not None]
+        pending += list(self._taken)
+        for req in pending:
+            r = self._resume_or_fail(req, exc)
+            if r is not None:
+                resumed.append(r)
+        self._clear_inflight_state()
+        resumed.sort(key=lambda r: r.id)
+        self._resuming = len(resumed)
+        return resumed
+
+    def _resume_or_fail(self, req: Request,
+                        exc: BaseException) -> Optional[Request]:
+        fut = req.future
+        if fut.done():
+            return None  # resolved elsewhere (drain race, hard fail)
+        if fut.cancel_requested:
+            fut._finish("cancelled")
+            self.metrics.cancelled.inc()
+            return None
+        entry = self.journal.get(req.id) if self.journal is not None \
+            else None
+        if entry is None or not self.engine_cfg.resume:
+            fut.set_exception(exc)
+            return None
+        if entry.remaining < 1:  # fully emitted: only the retirement
+            fut._finish("length")  # bookkeeping was lost — finish now
+            self.metrics.completed.inc()
+            return None
+        # Greedy decode is a pure function of the token sequence, so
+        # prefilling prompt + emitted and continuing yields output
+        # token-identical to an uninterrupted run.  The ORIGINAL id is
+        # kept: it is the journal key, and it preserves the request's
+        # FCFS age (preemption picks victims by id — surviving a crash
+        # must not mark old work as young).
+        new = Request(prompt=list(entry.prompt) + list(entry.emitted),
+                      max_new_tokens=entry.remaining, future=fut,
+                      eos_id=entry.eos_id, deadline=req.deadline,
+                      trace=req.trace)
+        new.id = req.id
+        new.submitted_at = req.submitted_at
+        # Wasted work = tokens RE-prefilled that were already computed
+        # once.  A taken-but-never-landed request (no emitted tokens,
+        # its first prefill never ran) re-queues for free — counting
+        # its prompt would inflate the chaos benchmark's ratio.
+        new._resume_wasted = len(new.prompt) if entry.emitted else 0
+        return new
+
+    def _clear_inflight_state(self) -> None:
+        """Reset slot bookkeeping after a failure — including the slot
+        allocator, so terminal states (no _restart to rebuild it) don't
+        report phantom occupancy forever."""
         self._taken = []
         self._states = [None] * self.engine_cfg.n_slots
         self.slots.release_all()
@@ -1439,10 +1604,15 @@ class InferenceEngine:
             req.future.set_exception(exc)
 
     def _recover(self, exc: BaseException, *, counted: bool = False) -> None:
-        """The supervised-restart path: fail in-flight futures with a
-        typed error, then either restart (fresh SlotCache, exponential
-        backoff) or go terminally ``failed`` when ``max_restarts``
-        consecutive attempts are spent."""
+        """The supervised-restart path.  With ``resume`` (default),
+        in-flight requests are SUSPENDED — journaled state, live
+        futures — and re-admitted at the queue head after the restart,
+        so a crash costs one tick plus one re-prefill instead of the
+        request; without it (or at a terminal failure) they fail with
+        the typed error, as before.  Either way the engine restarts
+        (fresh SlotCache, exponential backoff) or goes terminally
+        ``failed`` when ``max_restarts`` consecutive attempts are
+        spent."""
         if not isinstance(exc, EngineFailedError):
             wrapped = EngineFailedError(f"engine tick failed: {exc!r}")
             wrapped.__cause__ = exc
@@ -1452,12 +1622,12 @@ class InferenceEngine:
         if not counted:
             self.metrics.engine_failures.inc()
         with self._lock:
-            self._fail_inflight(exc)
             self._consec_failures += 1
             attempt = self._consec_failures
             if (self._terminal
                     or attempt > self.engine_cfg.max_restarts):
                 self._terminal = True
+                self._fail_inflight(exc)
                 self._set_health(FAILED)
                 obs_tracing.instant("engine_failed", {
                     "consecutive_failures": attempt,
@@ -1466,18 +1636,57 @@ class InferenceEngine:
                 self.metrics.queue_depth.set(0)
                 self.metrics.slot_occupancy.set(0.0)
                 return
+            resume_ok = True
+            faults = self.engine_cfg.faults
+            if faults is not None:
+                try:
+                    faults.probe("restart_resume")
+                except Exception:
+                    # The resume machinery itself failed (chaos site:
+                    # unreadable journal, corrupted state): degrade to
+                    # the legacy fail-typed restart — never replay
+                    # from state the engine cannot trust.
+                    resume_ok = False
+            if resume_ok:
+                resumed = self._suspend_inflight(exc)
+            else:
+                resumed = []
+                self._fail_inflight(exc)
         backoff = min(
             self.engine_cfg.restart_backoff * (2.0 ** (attempt - 1)),
             self.engine_cfg.restart_backoff_max)
         time.sleep(backoff)
         with self._lock:
             # terminate() may have landed during the backoff sleep — a
-            # terminal declaration is never undone by a restart.
+            # terminal declaration is never undone by a restart, and
+            # the suspended requests must not dangle on it.
             if self._terminal:
+                for req in resumed:
+                    req.future.set_exception(exc)
+                self._resuming = 0
                 self._set_health(FAILED)
                 self._fail_queue(exc)
                 return
             self._restart()
+            self._resuming = 0
+            if resumed:
+                # Back to the HEAD of the queue in original FCFS order:
+                # the next tick re-prefills prompt + emitted through the
+                # ordinary bucketed batch admission (pages re-granted,
+                # prefix sharing re-applied) and decode continues where
+                # it left off.
+                self.scheduler.requeue_front(resumed)
+                for req in resumed:
+                    self.metrics.resumed.inc()
+                    wasted = getattr(req, "_resume_wasted",
+                                     len(req.prompt))
+                    if wasted:
+                        self.metrics.resume_wasted_tokens.inc(wasted)
+                    if self.journal is not None:
+                        self.journal.note_resume(req.id)
+                obs_tracing.instant("requests_resumed", {
+                    "count": len(resumed), "epoch": self._epoch})
+                self.metrics.queue_depth.set(self.scheduler.depth)
 
     def _restart(self) -> None:
         """Fresh SlotCache + slot bookkeeping (the old device cache is
@@ -1502,6 +1711,7 @@ class InferenceEngine:
         with self._hb_lock:
             self._epoch += 1
             self._stalled = False
+            self._stall_hard_failed = False
         self.metrics.engine_restarts.inc()
         obs_tracing.instant("engine_restart", {
             "epoch": self._epoch,
@@ -1509,6 +1719,10 @@ class InferenceEngine:
         self._set_health(DRAINING if self._draining else DEGRADED)
 
     # -- watchdog ----------------------------------------------------------
+
+    def _stall_grace_s(self) -> float:
+        g = self.engine_cfg.stall_grace
+        return g if g is not None else self.engine_cfg.tick_timeout
 
     def _watchdog_loop(self) -> None:
         budget = self.engine_cfg.tick_timeout
@@ -1518,10 +1732,19 @@ class InferenceEngine:
                 started = self._tick_started
                 epoch = self._epoch
                 stalled = self._stalled
-            if started is None or stalled:
+                hard = self._stall_hard_failed
+            if started is None:
                 continue
-            if time.monotonic() - started > budget:
-                self._declare_stalled(epoch, started)
+            age = time.monotonic() - started
+            if not stalled:
+                if age > budget:
+                    self._declare_stalled(epoch, started)
+            elif (self.engine_cfg.resume and not hard
+                    and age > budget + self._stall_grace_s()):
+                # The stall outlived its resume grace: presume the tick
+                # never returns and restore the bounded-resolution
+                # guarantee.
+                self._stall_hard_fail(epoch, started)
 
     def _declare_stalled(self, epoch: int, started: float) -> None:
         """The tick has been running past its budget — a hung device
@@ -1530,24 +1753,57 @@ class InferenceEngine:
         futures (thread-safe, idempotent) and flips flags.  Slot
         bookkeeping is rebuilt by the engine thread if/when the hung
         tick returns; if it never returns, the engine stays ``failed``
-        and nothing is left waiting on it."""
+        and nothing is left waiting on it.
+
+        With ``resume`` the in-flight futures are NOT resolved here:
+        their decode state is journaled, and a tick that returns
+        within ``stall_grace`` resumes them token-exact through the
+        supervised restart.  Only past budget + grace does
+        :meth:`_stall_hard_fail` resolve everything typed."""
         with self._hb_lock:
             if (self._stalled or self._epoch != epoch
                     or self._tick_started != started):
                 return  # the tick finished or recovery already ran
             self._stalled = True
-        exc = EngineStalledError(
-            f"engine stalled: tick exceeded the "
-            f"{self.engine_cfg.tick_timeout}s watchdog budget")
         self.metrics.engine_failures.inc()
         obs_tracing.instant("watchdog_stall", {
             "epoch": epoch,
             "budget_s": self.engine_cfg.tick_timeout,
             "tick_age_s": round(time.monotonic() - started, 3)})
         self._set_health(FAILED)
+        if self.engine_cfg.resume:
+            return  # futures held for resume; hard fail at budget+grace
+        exc = EngineStalledError(
+            f"engine stalled: tick exceeded the "
+            f"{self.engine_cfg.tick_timeout}s watchdog budget")
         # The engine thread is hung inside _lock, so _states is frozen —
         # snapshot-read it without the lock and resolve every future a
         # hung tick would otherwise strand (in-flight AND queued).
+        for st in list(self._states):
+            if st is not None:
+                st.request.future.set_exception(exc)
+        for req in list(self._taken):
+            req.future.set_exception(exc)
+        self._fail_queue(exc)
+
+    def _stall_hard_fail(self, epoch: int, started: float) -> None:
+        """Resume-mode backstop, still on the watchdog thread: the
+        stalled tick spent its grace too.  Resolve every future typed
+        — resolution purges each journal entry, so a zombie tick that
+        returns even later finds nothing to resume and the restart
+        comes up empty rather than replaying ghosts."""
+        with self._hb_lock:
+            if (self._stall_hard_failed or not self._stalled
+                    or self._epoch != epoch
+                    or self._tick_started != started):
+                return
+            self._stall_hard_failed = True
+        exc = EngineStalledError(
+            f"engine stalled: tick exceeded the "
+            f"{self.engine_cfg.tick_timeout}s watchdog budget plus the "
+            f"{self._stall_grace_s()}s resume grace")
+        obs_tracing.instant("stall_hard_fail", {
+            "epoch": epoch, "grace_s": self._stall_grace_s()})
         for st in list(self._states):
             if st is not None:
                 st.request.future.set_exception(exc)
@@ -1628,7 +1884,16 @@ class InferenceEngine:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if self._health == FAILED:
-                return True  # recovery already resolved everything
+                with self._hb_lock:
+                    hard = self._stall_hard_failed
+                if (self._terminal or hard
+                        or not self.engine_cfg.resume):
+                    return True  # recovery already resolved everything
+                # (a non-terminal FAILED with resume on is a stall
+                # window: journaled requests may still resume — keep
+                # waiting; the caller's terminate() bounds the worst
+                # case.  After a hard fail everything IS resolved, so
+                # waiting out the hung tick would be pure delay.)
             # Sample under the step lock: between scheduler.take() and
             # slots.alloc() a request is in neither counter, and an
             # unlocked read could report "drained" mid-admission.  A
@@ -1640,7 +1905,10 @@ class InferenceEngine:
                 try:
                     idle = (self.scheduler.depth == 0
                             and self.slots.active_count == 0
-                            and not self._taken)
+                            and not self._taken
+                            # suspended-for-resume requests are in
+                            # neither counter until the requeue lands
+                            and self._resuming == 0)
                 finally:
                     self._lock.release()
                 if idle:
@@ -1736,6 +2004,9 @@ class InferenceEngine:
             "slots_active": self.slots.active_count,
             "max_len": self.slots.max_len,
             "overlap": self.engine_cfg.overlap,
+            "resume": self.engine_cfg.resume,
+            "journal_inflight":
+                len(self.journal) if self.journal is not None else 0,
             "decode_compilations": self._decode_traces,
             "prefill_compilations": self._prefill_traces,
             "prefill_calls": self._prefill_calls,

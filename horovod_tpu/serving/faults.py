@@ -30,6 +30,14 @@ Sites (``FaultInjector.SITES``):
 * ``"watchdog"`` — probed at the top of ``InferenceEngine.step``; a
   ``"hang"`` here stalls the whole tick outside any device call,
   which is exactly what the watchdog thread exists to catch.
+* ``"restart_resume"`` — probed in ``InferenceEngine._recover`` at
+  the point where a non-terminal restart would SUSPEND in-flight
+  requests for resume (the ISSUE 9 durability path).  A ``"raise"``
+  models the resume machinery itself failing (unreadable journal,
+  corrupted state): the engine degrades to the legacy fail-typed
+  restart — in-flight futures resolve with ``EngineFailedError``
+  instead of resuming, and nothing is ever replayed from state it
+  cannot trust.
 
 Kinds:
 
@@ -99,7 +107,8 @@ class FaultInjector:
     raises, the tenth hangs 0.5 s, everything else runs clean.
     """
 
-    SITES = ("prefill", "decode_tick", "decode_fetch", "watchdog")
+    SITES = ("prefill", "decode_tick", "decode_fetch", "watchdog",
+             "restart_resume")
     KINDS = ("raise", "hang", "nonfinite")
 
     def __init__(self, specs: Sequence[FaultSpec] = (), seed: int = 0):

@@ -49,6 +49,14 @@ class ServingMetrics:
     * ``engine_failures`` / ``engine_restarts`` — fault-tolerance
       counters: every tick failure or watchdog stall, and every
       successful supervised restart (fresh slot cache).
+    * ``resumed`` / ``resume_wasted_tokens`` — durability counters
+      (docs/serving.md "Operations"): in-flight requests re-admitted
+      across a supervised restart with their futures still live, and
+      the tokens those re-admissions re-prefilled (original prompt +
+      previously emitted) — the bounded price of not re-executing
+      from scratch.  ``resume_wasted_tokens / tokens_generated`` is
+      the wasted-token ratio ``benchmarks/serving.py --chaos``
+      reports.
     * ``tick_dispatch`` / ``tick_device_wait`` / ``tick_host`` — the
       pipeline phase timers: time to BUILD AND DISPATCH a decode tick
       (async — returns before the device finishes), time BLOCKED
@@ -100,6 +108,14 @@ class ServingMetrics:
             "Requests cancelled caller-side (incl. 504 slot reclamation)")
         self.tokens_generated = r.counter(
             "serving_tokens_generated_total", "Tokens emitted to futures")
+        self.resumed = r.counter(
+            "serving_requests_resumed_total",
+            "In-flight requests re-admitted after an engine restart "
+            "(journaled decode state; the original future stays live)")
+        self.resume_wasted_tokens = r.counter(
+            "serving_resume_wasted_tokens",
+            "Tokens re-prefilled by resume admissions (prompt + "
+            "previously emitted) — the bounded re-work durability costs")
         self.engine_failures = r.counter(
             "serving_engine_failures_total",
             "Tick failures and watchdog stalls")
@@ -158,6 +174,8 @@ class ServingMetrics:
             "requests_rejected": self.rejected.value,
             "requests_completed": self.completed.value,
             "requests_cancelled": self.cancelled.value,
+            "requests_resumed": self.resumed.value,
+            "resume_wasted_tokens": self.resume_wasted_tokens.value,
             "tokens_generated": self.tokens_generated.value,
             "engine_failures": self.engine_failures.value,
             "engine_restarts": self.engine_restarts.value,

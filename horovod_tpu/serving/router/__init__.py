@@ -24,7 +24,11 @@ front door:
   ``X-Trace-Id``, and on replica failure mid-request retries on
   another replica (capped attempts + backoff) — a SIGKILL'd replica
   under load drops zero requests, because a failed replica resolved
-  nothing.
+  nothing.  Failover RESUMES partially decoded requests when a resume
+  descriptor is available (the replica's typed failure response, or
+  its journal file read post-mortem after SIGKILL): the surviving
+  replica continues from the emitted-token frontier under the
+  REMAINING deadline budget, instead of re-executing from scratch.
 
     from horovod_tpu.serving.router import (
         ReplicaRegistry, ReplicaSpec, ReplicaSupervisor, RouterServer)

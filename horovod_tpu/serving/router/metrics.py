@@ -32,6 +32,11 @@ class RouterMetrics:
       replica failed mid-request, and requests that ultimately
       SUCCEEDED only because of a retry (each one is a request a
       single-replica deployment would have dropped).
+    * ``resume_failovers`` — failovers that CONTINUED a partially
+      decoded request from its resume descriptor (a replica's typed
+      engine-failure response, or a SIGKILL'd replica's journal file)
+      instead of re-executing from scratch — each one is paid-for
+      prefill/decode work the failover preserved.
     * ``replicas_total`` / ``replicas_in_rotation`` — supervised
       replicas vs. replicas the balancer will actually route to;
       ``total - in_rotation`` is the capacity currently draining,
@@ -63,6 +68,10 @@ class RouterMetrics:
         self.failovers = r.counter(
             "router_failovers_total",
             "Requests that succeeded only via retry on another replica")
+        self.resume_failovers = r.counter(
+            "router_resume_failovers_total",
+            "Failovers that resumed a partially decoded request from "
+            "its resume descriptor instead of re-executing from scratch")
         self.replicas_total = r.gauge(
             "router_replicas_total", "Replicas under supervision")
         self.replicas_in_rotation = r.gauge(
@@ -88,6 +97,7 @@ class RouterMetrics:
             "requests_failed": self.requests_failed.value,
             "retries": self.retries.value,
             "failovers": self.failovers.value,
+            "resume_failovers": self.resume_failovers.value,
             "replicas_total": self.replicas_total.value,
             "replicas_in_rotation": self.replicas_in_rotation.value,
             "replica_evictions": self.replica_evictions.value,

@@ -54,11 +54,18 @@ ROUTABLE_STATES = ("healthy", "degraded")
 class ReplicaEndpoint:
     """Where one replica listens.  ``rid`` is unique per PROCESS
     generation (``r<slot>g<gen>`` from the supervisor) so a respawn is
-    a new endpoint with fresh poll state, never a stale carryover."""
+    a new endpoint with fresh poll state, never a stale carryover.
+
+    ``journal_path`` is the replica's request-journal file when the
+    supervisor armed one (``--journal``): the router reads it
+    POST-MORTEM after a connection-level death to resume the dead
+    replica's in-flight requests elsewhere — part of the routing
+    contract, like the four ``/stats`` keys."""
 
     rid: str
     host: str
     port: int
+    journal_path: Optional[str] = None
 
     @property
     def base_url(self) -> str:

@@ -18,6 +18,12 @@ Lifecycle contract with the supervisor:
   exit :data:`~horovod_tpu.serving.router.supervisor.
   EXIT_CODE_REPLICA_FAILED` so the exit watcher respawns without
   waiting for a registry poll;
+* ``--journal PATH`` arms the engine's request journal as an
+  append-only JSONL file (the supervisor passes a per-generation path
+  from its ``journal_dir``): it survives SIGKILL, and the router reads
+  it post-mortem to RESUME this replica's in-flight requests on a
+  survivor (docs/serving.md "Front tier").  ``--no-resume`` restores
+  the pre-journal fail-typed restart behavior;
 * ``--fault site:kind[:skip[:delay]]`` threads a deterministic
   FaultInjector through the engine for chaos tests (a ``hang`` with a
   long delay and ``--tick-timeout 0`` wedges the replica for real —
@@ -122,6 +128,14 @@ def main(argv=None) -> int:
                     help="engine watchdog budget (0 disables)")
     ap.add_argument("--request-timeout", type=float, default=120.0)
     ap.add_argument("--drain-timeout", type=float, default=10.0)
+    ap.add_argument("--journal", default="",
+                    help="request-journal JSONL path (survives SIGKILL; "
+                         "the router reads it post-mortem to resume "
+                         "this replica's in-flight requests elsewhere)")
+    ap.add_argument("--no-resume", action="store_true",
+                    help="disable in-engine restart-resume (in-flight "
+                         "requests fail typed on a supervised restart, "
+                         "the pre-journal behavior)")
     ap.add_argument("--warm", type=int, action="append", default=[],
                     help="prompt lengths to pre-compile before "
                          "accepting traffic (repeatable)")
@@ -152,7 +166,9 @@ def main(argv=None) -> int:
             n_slots=args.slots, max_len=cfg.max_seq,
             max_queue_depth=args.max_queue_depth,
             max_prefills_per_tick=args.max_prefills_per_tick,
-            tick_timeout=args.tick_timeout, faults=inj))
+            tick_timeout=args.tick_timeout,
+            resume=not args.no_resume,
+            journal_path=args.journal or None, faults=inj))
     if args.warm:
         # Pre-compile BEFORE the listener exists: the registry's first
         # successful poll means "routable", and a routable replica must

@@ -16,11 +16,24 @@ connection level resolved nothing — the client saw no bytes — and
 generation is repeatable, so re-running it elsewhere changes nothing
 the caller can observe.  A replica that ANSWERS, even with a typed
 error, resolved the request; 503 (draining / engine failed — the
-replica is leaving rotation and produced no tokens) and 429 (queue
-full / out of pages — another replica may have room) are relayed only
-after a retry elsewhere also fails.  Responses the replica produced
-tokens for (200, 400, 413, 504) are relayed verbatim, trace id and
-all.
+replica is leaving rotation and produced no tokens the CLIENT saw) and
+429 (queue full / out of pages — another replica may have room) are
+relayed only after a retry elsewhere also fails.  Responses the
+replica produced tokens for (200, 400, 413, 504) are relayed verbatim,
+trace id and all.
+
+Failover RESUMES rather than re-executes whenever a resume descriptor
+is available (docs/serving.md "Front tier"): a replica whose engine
+failed terminally answers 503 with ``{"resume": {"emitted_tokens":
+[...], "deadline_remaining_ms": ...}}``, and a SIGKILL'd replica
+leaves a request journal file (``--journal``, read post-mortem via
+``resume_lookup``).  The router then re-dispatches ``prompt + emitted``
+with the REMAINING decode and deadline budgets — the surviving replica
+re-prefills once and decode continues token-identically — and prepends
+the carried tokens to the final response (``"resumed": true``).  A
+deadline that expires mid-failover resolves as the same typed 504 the
+replicas use.  Only the paid-for work moves; nothing is generated
+twice, nothing is dropped.
 
 Endpoints:
 
@@ -47,6 +60,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
 from horovod_tpu.obs import tracing as obs_tracing
+from horovod_tpu.serving.journal import RequestJournal
 from horovod_tpu.serving.router.registry import ReplicaRegistry
 
 __all__ = ["RouterServer"]
@@ -158,6 +172,95 @@ class _RouterHandler(BaseHTTPRequestHandler):
             else obs_tracing.mint_trace_id()
         metrics.requests.inc()
 
+        # Resume-aware failover state (docs/serving.md "Front tier").
+        # A failed attempt may yield a RESUME DESCRIPTOR — from the
+        # replica's typed engine-failure response, or post-mortem from
+        # a SIGKILL'd replica's journal file — carrying the tokens it
+        # already emitted and the REMAINING deadline budget.  The next
+        # attempt then dispatches prompt + carried tokens with the
+        # reduced decode budget and the remaining timeout: decode
+        # continues where it left off (greedy output is a pure function
+        # of the token sequence), and the final relay prepends the
+        # carried tokens so the client sees one seamless result.
+        try:
+            body_obj = json.loads(body or b"{}")
+        except json.JSONDecodeError:
+            body_obj = None
+        resumable = (isinstance(body_obj, dict)
+                     and isinstance(body_obj.get("tokens"), list)
+                     and isinstance(body_obj.get("max_new_tokens"), int))
+        carried: list = []
+        remaining_ms: Optional[float] = None
+        absorbed_at: float = 0.0
+
+        def current_remaining_ms() -> Optional[float]:
+            # Time the ROUTER spends between attempts (backoff, further
+            # failures) counts against the budget too — the journal
+            # path gets this for free (remaining computed at read
+            # time); the inline-descriptor path must age it here, or
+            # every crash-hop would extend the request's wall budget.
+            if remaining_ms is None:
+                return None
+            return remaining_ms - (time.monotonic() - absorbed_at) * 1e3
+
+        def dispatch_body() -> bytes:
+            rem = current_remaining_ms()
+            if not carried and rem is None:
+                return body
+            obj = dict(body_obj)
+            obj["tokens"] = list(body_obj["tokens"]) + carried
+            obj["max_new_tokens"] = \
+                body_obj["max_new_tokens"] - len(carried)
+            if rem is not None:
+                # The REMAINING budget, never a fresh one: a request
+                # must not live longer because it crash-hopped.
+                obj["timeout_ms"] = max(1.0, rem)
+            return json.dumps(obj).encode()
+
+        def absorb(desc) -> None:
+            """Fold one attempt's resume descriptor into the carry."""
+            nonlocal remaining_ms, absorbed_at
+            if not resumable or not isinstance(desc, dict):
+                return
+            toks = desc.get("emitted_tokens")
+            if isinstance(toks, list):
+                carried.extend(int(t) for t in toks)
+            rem = desc.get("deadline_remaining_ms")
+            if rem is not None:
+                remaining_ms = float(rem)
+                absorbed_at = time.monotonic()
+
+        def deadline_expired() -> bool:
+            rem = current_remaining_ms()
+            return rem is not None and rem <= 0.0
+
+        def carry_complete() -> Optional[str]:
+            """The carried tokens may already BE the full result — the
+            dead replica emitted its last token but never answered
+            (killed before the end-of-journal line, or the budget was
+            spent across hops).  Re-dispatching would send
+            ``max_new_tokens <= 0`` (a 400) or decode past EOS; finish
+            the request here instead."""
+            if not resumable or not carried:
+                return None
+            eos = body_obj.get("eos_id")
+            if eos is not None and carried[-1] == eos:
+                return "eos"
+            if len(carried) >= body_obj["max_new_tokens"]:
+                return "length"
+            return None
+
+        def finish_from_carry(reason: str, attempts: int) -> None:
+            metrics.resume_failovers.inc()
+            self._json(200, {
+                "tokens": list(carried),
+                "finish_reason": reason,
+                "resumed": True,
+                "resume_carried_tokens": len(carried),
+                "trace_id": trace_id,
+            }, headers={obs_tracing.TRACE_ID_HEADER: trace_id,
+                        "X-Router-Attempts": str(attempts)})
+
         tried = set()
         attempts = 0
         last: Optional[Tuple[int, bytes, Dict[str, str]]] = None
@@ -180,26 +283,65 @@ class _RouterHandler(BaseHTTPRequestHandler):
             t0 = time.monotonic()
             try:
                 status, payload, hdrs = self._proxy_once(
-                    rep, body, trace_id, router.proxy_timeout)
+                    rep, dispatch_body(), trace_id, router.proxy_timeout)
             except _ProxyError:
                 metrics.proxy_latency.observe(time.monotonic() - t0)
                 # Connection-level death: evict NOW (the poll thread
                 # would take up to one interval to notice) and retry —
-                # the replica resolved nothing, so the retry is safe.
+                # the replica resolved nothing CLIENT-VISIBLE, so the
+                # retry is safe; its journal file (when the supervisor
+                # armed one) tells us how far decode got, so the retry
+                # RESUMES rather than re-executing.
                 registry.mark_failed(rep.endpoint.rid)
+                absorb(router.lookup_resume(rep.endpoint, trace_id))
+                reason = carry_complete()
+                if reason is not None:
+                    finish_from_carry(reason, attempts)
+                    return
+                if deadline_expired():
+                    break  # typed 504 below — the budget died with it
                 continue
             metrics.proxy_latency.observe(time.monotonic() - t0)
             if status in RETRYABLE_STATUS:
                 last = (status, payload, hdrs)
+                # A typed engine-failure response carries the resume
+                # descriptor inline — absorb it before trying elsewhere.
+                try:
+                    absorb(json.loads(payload).get("resume"))
+                except (json.JSONDecodeError, AttributeError):
+                    pass
+                reason = carry_complete()
+                if reason is not None:
+                    finish_from_carry(reason, attempts)
+                    return
+                if deadline_expired():
+                    break
                 continue
             if attempts > 1 and status == 200:
                 # Only a SUCCESS bought by a retry counts as a
                 # failover save (the documented meaning of the family).
                 metrics.failovers.inc()
+            if status == 200 and carried:
+                payload = self._merge_resumed(payload, carried, metrics)
             hdrs.setdefault(obs_tracing.TRACE_ID_HEADER, trace_id)
             hdrs["X-Router-Replica"] = rep.endpoint.rid
             hdrs["X-Router-Attempts"] = str(attempts)
             self._relay(status, payload, hdrs)
+            return
+
+        if deadline_expired():
+            # The deadline lapsed MID-FAILOVER: same typed 504 the
+            # replicas use for a queued-deadline lapse, with whatever
+            # was decoded before the crash (token ids are authoritative
+            # — a client that cares can keep them).
+            self._json(504, {
+                "error": "deadline expired during failover",
+                "type": "deadline_exceeded",
+                "trace_id": trace_id,
+                "attempts": attempts,
+                "tokens_so_far": carried,
+            }, headers={obs_tracing.TRACE_ID_HEADER: trace_id,
+                        "X-Router-Attempts": str(attempts)})
             return
 
         metrics.requests_failed.inc()
@@ -209,6 +351,19 @@ class _RouterHandler(BaseHTTPRequestHandler):
             # replica's own reason and trace id) rather than masking
             # it behind a generic router error.
             status, payload, hdrs = last
+            if carried:
+                # Rewrite the relayed descriptor to the FULL carry, so
+                # a client that resumes upstream continues from the
+                # true frontier, not just the last replica's share.
+                try:
+                    obj = json.loads(payload)
+                    obj["resume"] = {
+                        "emitted_tokens": list(carried),
+                        "deadline_remaining_ms": current_remaining_ms(),
+                    }
+                    payload = json.dumps(obj).encode()
+                except (json.JSONDecodeError, AttributeError):
+                    pass
             hdrs.setdefault(obs_tracing.TRACE_ID_HEADER, trace_id)
             hdrs.setdefault("Retry-After", str(router.retry_after))
             hdrs["X-Router-Attempts"] = str(attempts)
@@ -223,6 +378,24 @@ class _RouterHandler(BaseHTTPRequestHandler):
             "attempts": attempts,
         }, headers={"Retry-After": str(router.retry_after),
                     obs_tracing.TRACE_ID_HEADER: trace_id})
+
+    @staticmethod
+    def _merge_resumed(payload: bytes, carried: list, metrics) -> bytes:
+        """Prepend the carried tokens to a successful continuation's
+        payload: the client sees ONE result, byte-identical to an
+        uninterrupted run.  ``text`` is dropped — the continuation
+        replica detokenized only its own share, and token ids are the
+        authoritative cross-replica representation."""
+        try:
+            obj = json.loads(payload)
+            obj["tokens"] = list(carried) + list(obj.get("tokens") or [])
+            obj.pop("text", None)
+            obj["resumed"] = True
+            obj["resume_carried_tokens"] = len(carried)
+            metrics.resume_failovers.inc()
+            return json.dumps(obj).encode()
+        except (json.JSONDecodeError, AttributeError, TypeError):
+            return payload  # pragma: no cover - malformed replica reply
 
     def _relay(self, status: int, payload: bytes,
                headers: Dict[str, str]) -> None:
@@ -250,6 +423,13 @@ class RouterServer:
     replica is never double-generated, and the timeout only fires for
     replicas that genuinely wedged.  ``retry_after`` is the seconds
     hint on 503s (load shedding guidance for well-behaved clients).
+
+    ``resume_lookup`` is the post-mortem resume source for
+    connection-level deaths: ``(rid, trace_id) -> resume descriptor or
+    None`` (``ReplicaSupervisor.resume_lookup`` reads the dead
+    replica's journal file, surviving the reap).  When None, the
+    router falls back to the endpoint's advertised ``journal_path``
+    (still registered until the supervisor reaps it).
     """
 
     def __init__(self, registry: ReplicaRegistry, *,
@@ -259,9 +439,11 @@ class RouterServer:
                  retry_backoff_max: float = 1.0,
                  proxy_timeout: float = 150.0,
                  retry_after: int = 1,
+                 resume_lookup=None,
                  own_registry_thread: bool = True) -> None:
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
+        self.resume_lookup = resume_lookup
         self.registry = registry
         self.host = host
         self.port = port
@@ -280,6 +462,21 @@ class RouterServer:
         if self._httpd is None:
             return (self.host, self.port)
         return self._httpd.server_address[:2]
+
+    def lookup_resume(self, endpoint, trace_id: str) -> Optional[Dict]:
+        """Resume descriptor for ``trace_id`` on a replica that died at
+        the connection level, or None (→ re-execute from scratch, the
+        pre-journal behavior).  Never raises: resume is an
+        optimization, failover correctness does not depend on it."""
+        try:
+            if self.resume_lookup is not None:
+                return self.resume_lookup(endpoint.rid, trace_id)
+            if endpoint.journal_path:
+                return RequestJournal.read_live(
+                    endpoint.journal_path).get(trace_id)
+        except Exception:  # pragma: no cover - post-mortem best effort
+            return None
+        return None
 
     def stats(self) -> Dict:
         return {

@@ -166,7 +166,8 @@ class ReplicaSupervisor:
                  unhealthy_grace: float = 5.0,
                  startup_timeout: float = 300.0,
                  monitor_interval: float = 0.1,
-                 log_dir: Optional[str] = None) -> None:
+                 log_dir: Optional[str] = None,
+                 journal_dir: Optional[str] = None) -> None:
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self._spec = spec
@@ -183,6 +184,14 @@ class ReplicaSupervisor:
         self._startup_timeout = startup_timeout
         self._monitor_interval = monitor_interval
         self._log_dir = log_dir
+        # Request-journal files (docs/serving.md "Front tier"): each
+        # replica journals its in-flight decode state to
+        # journal_dir/<rid>.journal.jsonl; the mapping OUTLIVES the
+        # process (kept after reap) so the router can read a SIGKILL'd
+        # replica's journal post-mortem and resume its requests
+        # elsewhere (RouterServer(resume_lookup=sup.resume_lookup)).
+        self._journal_dir = journal_dir
+        self._journal_paths: Dict[str, str] = {}
         self._lock = threading.Lock()
         self._handles: Dict[int, ReplicaHandle] = {}   # slot -> handle
         self._respawn_at: Dict[int, float] = {}        # slot -> monotonic
@@ -253,12 +262,34 @@ class ReplicaSupervisor:
 
     # -- spawn / reap ------------------------------------------------------
 
-    def _command(self, slot: int, port: int) -> List[str]:
+    def _command(self, slot: int, port: int,
+                 journal_path: Optional[str] = None) -> List[str]:
         if callable(self._spec):
             # Custom commands own their bind address; the registry
             # still polls self._host, so the callable must agree.
+            # (Journaling is replica_main plumbing — custom programs
+            # arm their own.)
             return list(self._spec(slot, port))
-        return self._spec.command(port, self._host)
+        cmd = self._spec.command(port, self._host)
+        if journal_path:
+            cmd += ["--journal", journal_path]
+        return cmd
+
+    def resume_lookup(self, rid: str, trace_id: str) -> Optional[Dict]:
+        """Post-mortem resume descriptor for ``trace_id`` on replica
+        ``rid`` — reads the (possibly dead) replica's journal file.
+        Wire this into ``RouterServer(resume_lookup=...)``; it keeps
+        working after the reap removed the endpoint from the
+        registry."""
+        path = self._journal_paths.get(rid)
+        if not path:
+            return None
+        try:
+            from horovod_tpu.serving.journal import RequestJournal
+
+            return RequestJournal.read_live(path).get(trace_id)
+        except Exception:  # pragma: no cover - post-mortem best effort
+            return None
 
     def _spawn(self, slot: int) -> None:
         gen = self._gen.get(slot, -1) + 1
@@ -276,13 +307,30 @@ class ReplicaSupervisor:
                              if env.get("PYTHONPATH") else pkg_root)
         prev = self._handles.get(slot)
         restarts = prev.restarts + 1 if prev is not None else 0
+        journal_path = None
+        if self._journal_dir and not callable(self._spec):
+            os.makedirs(self._journal_dir, exist_ok=True)
+            journal_path = os.path.join(self._journal_dir,
+                                        f"r{slot}g{gen}.journal.jsonl")
+            self._journal_paths[f"r{slot}g{gen}"] = journal_path
+            # Prune this slot's older generations (keep gen-1: the
+            # router may still be failing its requests over right
+            # now) — a crash-looping replica must not grow the dict
+            # and the directory without bound.
+            for g in range(gen - 1):
+                old = self._journal_paths.pop(f"r{slot}g{g}", None)
+                if old:
+                    try:
+                        os.remove(old)
+                    except OSError:
+                        pass
         out = subprocess.DEVNULL
         if self._log_dir:
             os.makedirs(self._log_dir, exist_ok=True)
             out = open(os.path.join(self._log_dir,
                                     f"r{slot}g{gen}.log"), "wb")
         proc = subprocess.Popen(
-            self._command(slot, port), env=env,
+            self._command(slot, port, journal_path), env=env,
             stdout=out, stderr=subprocess.STDOUT if self._log_dir
             else subprocess.DEVNULL,
             start_new_session=True)
@@ -293,7 +341,8 @@ class ReplicaSupervisor:
         with self._lock:
             self._handles[slot] = h
             self._respawn_at.pop(slot, None)
-        self.registry.add(ReplicaEndpoint(h.rid, self._host, port))
+        self.registry.add(ReplicaEndpoint(h.rid, self._host, port,
+                                          journal_path=journal_path))
         self._instant("replica_spawn" if gen == 0 else "replica_respawn",
                       {"rid": h.rid, "pid": proc.pid, "port": port})
         if gen:

@@ -237,6 +237,17 @@ class Scheduler:
             budget -= 1
         return out
 
+    def requeue_front(self, reqs: Sequence[Request]) -> None:
+        """Put RESUMED requests back at the head of the queue, in the
+        given order (``reqs[0]`` becomes the next head) — the engine's
+        restart-resume path.  Deliberately exempt from
+        ``max_queue_depth``: these requests were already admitted once
+        and their callers are still waiting on live futures; bouncing
+        them as :class:`QueueFullError` after surviving a crash would
+        make durability depend on queue pressure."""
+        with self._lock:
+            self._q.extendleft(reversed(list(reqs)))
+
     def drain_pending(self) -> List[Request]:
         """Atomically remove and return every queued request — the
         terminal-failure / forced-shutdown path, where the caller must

@@ -174,12 +174,31 @@ class _Handler(BaseHTTPRequestHandler):
             return
 
         def fut_err(code: int, e: BaseException, etype: str,
-                    headers: Optional[dict] = None) -> None:
+                    headers: Optional[dict] = None,
+                    resume: Optional[dict] = None) -> None:
             payload = {"error": str(e), "type": etype}
             b = fut.breakdown() if fut is not None else None
             if b is not None:
                 payload["breakdown"] = b
+            if resume is not None:
+                payload["resume"] = resume
             self._json(code, payload, trace_id=trace_id, headers=headers)
+
+        def resume_descriptor(deadline: float) -> Optional[dict]:
+            """The RESUME DESCRIPTOR (docs/serving.md "Front tier"):
+            on an engine-failure response for a request that was IN
+            FLIGHT, tell the caller (the router) exactly what a
+            re-dispatch needs — the tokens this engine already emitted
+            (append them to the prompt elsewhere and decode continues
+            token-identically) and the REMAINING deadline budget (a
+            failover inherits what is left, never a fresh timeout)."""
+            if fut is None:
+                return None  # submit-time rejection: nothing ran
+            return {
+                "emitted_tokens": fut.tokens_so_far(),
+                "deadline_remaining_ms": max(0.0, round(
+                    (deadline - time.monotonic()) * 1e3, 3)),
+            }
 
         timeout_ms = req.get("timeout_ms")
         fut = None
@@ -226,8 +245,12 @@ class _Handler(BaseHTTPRequestHandler):
             return
         except EngineFailedError as e:
             # Submit-time (terminally failed) or result-time (this
-            # request was in flight when the engine failed/stalled).
-            fut_err(503, e, "engine_failed")
+            # request was in flight when the engine failed/stalled
+            # beyond its resume grace).  In-flight failures carry the
+            # resume descriptor so a front tier can continue the
+            # request on another replica from where it left off.
+            fut_err(503, e, "engine_failed",
+                    resume=resume_descriptor(deadline))
             return
         except (ServingError, ValueError, TypeError) as e:
             # TypeError covers non-numeric JSON fields (timeout_ms,

@@ -222,6 +222,7 @@ class TestRingAttention:
         assert np.isfinite(np.asarray(out)).all()
 
 
+@pytest.mark.slow
 class TestZigzagRingAttention:
     """Causal ring with the zigzag chunk layout (device i holds global
     chunks (i, 2P-1-i)): must equal full causal attention after
@@ -441,6 +442,7 @@ class TestGroupedQueryAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_ring_gqa_gradients(self):
         """The diff's central gradient claim: the repeat VJP (group-sum)
         composed with the transposed ppermute ring must deliver exact

@@ -584,6 +584,7 @@ class TestTFFunctionAllreduce:
         np.testing.assert_allclose(g.numpy(), [[1.0, 1.0]])
 
 
+@pytest.mark.slow
 class TestTFMultiProcess:
     def _spawn(self, tmp_path, scenario, nproc):
         import socket

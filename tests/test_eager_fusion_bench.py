@@ -11,7 +11,8 @@ import pytest
 
 from horovod_tpu import native
 
-pytestmark = pytest.mark.perf  # bench-shaped: drives a benchmarks/ script
+pytestmark = [pytest.mark.perf,  # bench-shaped: drives a benchmarks/ script
+              pytest.mark.slow]  # tier-1 budget: see tests/DURATIONS.md
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 

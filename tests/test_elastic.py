@@ -63,6 +63,7 @@ def _launch(tmp_path, phase, nproc, crash_rank=None):
 
 @pytest.mark.skipif(not native.native_built(), reason="native lib unavailable")
 class TestElasticRecovery:
+    @pytest.mark.slow
     def test_crash_relaunch_resume(self, tmp_path):
         # Phase 1: 3 ranks, rank 2 dies at step 7 (after the step-5
         # commit).  The launcher must kill the survivors — nonzero exit,
@@ -182,6 +183,7 @@ class TestElasticDriverUnit:
 
 
 class TestElasticDriverHeartbeat:
+    @pytest.mark.slow
     def test_stale_heartbeat_triggers_restart(self, tmp_path):
         """A hung (not dead) rank stops heartbeating: the driver must
         stale-detect it over the rendezvous KV, terminate the epoch, and
@@ -235,6 +237,7 @@ class TestElasticDriverFaultInjection:
             output_filename=str(tmp_path / "out"), **driver_kw)
         return d, results
 
+    @pytest.mark.slow
     def test_crash_triggers_rerendezvous_and_resume(self, tmp_path):
         d, results = self._drive(tmp_path, nhosts=3, crash_rank=2)
         rc = d.run()

@@ -12,6 +12,8 @@ from horovod_tpu.estimator import (
     shard_arrays,
 )
 
+pytestmark = pytest.mark.slow  # tier-1 budget: see tests/DURATIONS.md
+
 
 class TestStore:
     def test_create_picks_local(self, tmp_path):

@@ -694,6 +694,7 @@ class TestFleetEndToEnd:
     clean) and flags the artificially slowed rank — report-only, the
     job still succeeds."""
 
+    @pytest.mark.slow
     def test_fleet_scrape_and_straggler_flagging(self, tmp_path):
         env = {
             "PATH": os.environ.get("PATH", ""),

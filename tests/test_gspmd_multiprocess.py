@@ -21,6 +21,10 @@ import re
 import socket
 import sys
 
+import pytest
+
+pytestmark = pytest.mark.slow  # tier-1 budget: see tests/DURATIONS.md
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "gspmd_worker.py")
 

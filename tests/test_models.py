@@ -84,6 +84,7 @@ class TestTransformer:
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_remat_matches_no_remat(self):
         """jax.checkpoint must change memory, not math: loss AND
         gradients identical with and without layer rematerialization."""
@@ -142,6 +143,7 @@ class TestDecode:
                 atol=2e-4, rtol=2e-4)
         assert int(cache["pos"]) == 10
 
+    @pytest.mark.slow
     def test_decode_moe(self):
         # capacity_factor >= n_experts makes switch dispatch dropless, so
         # forward (switch) vs decode (forced dense) teacher-forcing
@@ -223,6 +225,7 @@ class TestDecode:
         out = fn(params_tp, prompt)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
+    @pytest.mark.slow
     def test_checkpoint_to_tp_serving_roundtrip(self, tmp_path):
         """The full big-model lifecycle: train under a tp-sharded GSPMD
         step, checkpoint, restore from disk, and serve BOTH single-chip
@@ -327,6 +330,7 @@ class TestDecode:
 
 
 class TestInception:
+    @pytest.mark.slow
     def test_forward_and_grad(self):
         """InceptionV3 at a reduced-but-valid resolution: output shape,
         finite loss, gradients flow to every parameter."""
@@ -353,6 +357,7 @@ class TestInception:
         assert any(float(jnp.abs(l).max()) > 0 for l in leaves)
 
 
+@pytest.mark.slow
 class TestGSPMDShardedStep:
     def test_dp_tp_sp_step(self):
         """Full train step over a (dp=2, sp=2, tp=2) mesh with real
@@ -497,6 +502,7 @@ class TestGraftEntry:
         out = jax.jit(fn)(*args)
         assert np.isfinite(np.asarray(out)).all()
 
+    @pytest.mark.slow
     def test_dryrun_multichip(self, capsys):
         import importlib.util, pathlib
 

@@ -268,6 +268,7 @@ class TestSwitchDispatchExpertParallel:
         return jax.value_and_grad(loss, argnums=(0, 1, 2, 3))(
             p["w_gate"], p["w_up"], p["w_down"], p["router"])
 
+    @pytest.mark.slow
     def test_ep2_matches_local_dispatch(self):
         """ep=2 all_to_all dispatch == per-shard local dispatch (drops
         depend only on the shard-local token order), outputs and grads."""
@@ -436,6 +437,7 @@ class TestModelAuxLoss:
         g0 = np.asarray(g["layers"]["router"])[:, :, 0]
         assert np.abs(g0).max() > 0, "aux must reach the router"
 
+    @pytest.mark.slow
     def test_training_with_aux_keeps_load_uniform(self):
         """Train a small switch model under TIGHT capacity (cf=1.0, where
         every point of imbalance costs dropped tokens): with the aux term

@@ -142,6 +142,7 @@ def _spawn_workers(tmp_path, scenario, extra_env=None, nproc=2):
 
 @pytest.mark.skipif(not native.native_built(), reason="native lib unavailable")
 class TestMultiProcess:
+    @pytest.mark.slow
     def test_two_process_full_protocol(self, tmp_path):
         rc, out = _spawn_workers(tmp_path, "full")
         r0 = (out / "rank.0.stdout").read_text()
@@ -162,6 +163,7 @@ class TestMultiProcess:
         for r in (0, 1):
             assert "NATIVE-WORKER-OK" in (out / f"rank.{r}.stdout").read_text()
 
+    @pytest.mark.slow
     def test_wrong_secret_key_rejected(self, tmp_path):
         """The control-plane sockets perform a mutual HMAC challenge keyed
         by the job's HOROVOD_SECRET_KEY (the trust model the rendezvous KV

@@ -80,6 +80,7 @@ _CASES = [
 
 
 class TestOverlapOracle:
+    @pytest.mark.slow
     def test_ab_token_identity_staggered(self, model):
         """ACCEPTANCE: the same staggered workload through overlap=True
         and overlap=False produces identical token streams, both equal
@@ -118,6 +119,7 @@ class TestOverlapOracle:
                 assert toks == ref[:ref.index(eos) + 1]
                 assert reason == "eos"
 
+    @pytest.mark.slow
     def test_ab_with_cancellation(self, model):
         """Mid-stream cancellation at the same emission point in both
         modes: the cancelled future resolves with the same partial
@@ -146,16 +148,42 @@ class TestOverlapOracle:
             params, cfg, [5, 6, 7, 8], 6)
 
     def test_ab_across_restart(self, model):
-        """A mid-decode device fault in each mode: the in-flight batch
-        fails typed, the engine restarts, and post-restart output is
-        oracle-exact in both modes — the pipeline state (device tokens,
-        in-flight tick) is rebuilt from scratch."""
+        """A mid-decode device fault in each mode: the in-flight
+        request RESUMES across the restart (journaled decode state,
+        same future) and its output is oracle-exact in both modes —
+        the pipeline state (device tokens, in-flight tick) is rebuilt
+        from scratch, and the one-tick-lag identity snapshot keeps the
+        overlapped path's journal identical to the sync path's."""
         params, cfg = model
         for overlap in (True, False):
             inj = serving.FaultInjector([
                 serving.FaultSpec(site="decode_tick", kind="raise",
                                   skip=2)])
             engine = _engine(model, overlap, faults=inj)
+            survivor = engine.submit([1, 2, 3], max_new_tokens=10)
+            _run_until_done(engine, [survivor])
+            assert survivor.result(timeout=0) == _ref_greedy(
+                params, cfg, [1, 2, 3], 10)
+            fut = engine.submit([1, 2, 3], max_new_tokens=10)
+            _run_until_done(engine, [fut])
+            assert fut.result(timeout=0) == _ref_greedy(
+                params, cfg, [1, 2, 3], 10)
+            s = engine.stats()
+            assert s["engine_restarts"] == 1
+            assert s["requests_resumed"] == 1
+            # restarts swap the cache, never the compiled tick
+            assert engine.decode_compilations == 1
+
+    def test_ab_across_restart_legacy_fail_typed(self, model):
+        """resume=False (the pre-journal contract): the in-flight
+        batch fails typed in both modes, and post-restart output is
+        oracle-exact."""
+        params, cfg = model
+        for overlap in (True, False):
+            inj = serving.FaultInjector([
+                serving.FaultSpec(site="decode_tick", kind="raise",
+                                  skip=2)])
+            engine = _engine(model, overlap, faults=inj, resume=False)
             doomed = engine.submit([1, 2, 3], max_new_tokens=10)
             _run_until_done(engine, [doomed])
             with pytest.raises(serving.EngineFailedError):
@@ -165,7 +193,6 @@ class TestOverlapOracle:
             assert fut.result(timeout=0) == _ref_greedy(
                 params, cfg, [1, 2, 3], 10)
             assert engine.stats()["engine_restarts"] == 1
-            # restarts swap the cache, never the compiled tick
             assert engine.decode_compilations == 1
 
     def test_prefill_compile_set_bounded(self, model):

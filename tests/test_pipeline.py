@@ -411,6 +411,7 @@ class TestPipelinedTransformerAPI:
                                    atol=1e-4, rtol=1e-4)
 
     @pytest.mark.parametrize("schedule", ["gpipe", "1f1b", "interleaved"])
+    @pytest.mark.slow
     def test_value_and_grad_exact(self, schedule):
         """The pipelined loss AND every parameter gradient — embedding,
         per-layer, final norm, head — must equal jax.grad(loss_fn), for
@@ -444,6 +445,7 @@ class TestPipelinedTransformerAPI:
         return dataclasses, T, cfg, params, batch
 
     @pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+    @pytest.mark.slow
     def test_moe_aux_value_and_grad_exact_m1(self, schedule):
         """With ONE microbatch the pipelined dispatch group equals the
         full batch, so the aux-bearing pipelined loss and every gradient
@@ -463,6 +465,7 @@ class TestPipelinedTransformerAPI:
         np.testing.assert_allclose(float(l_pipe), float(l_ref), atol=1e-5)
         _assert_grad_trees_match(g_pipe, g_ref)
 
+    @pytest.mark.slow
     def test_moe_aux_schedules_agree_and_reach_router(self):
         """For M>1 the aux is per dispatch group (mean over groups): the
         two schedules must agree with each other exactly, and the aux
@@ -522,27 +525,32 @@ class TestPipelineCompositions:
     gradient-exact vs the unsharded single-device reference model (see
     composition_worker.py for the mesh arrangements)."""
 
+    @pytest.mark.slow
     def test_1f1b_ring_attention_pp_x_sp_exact(self):
         """(pp, sp): ring K/V shards ppermute over sp within each
         pipeline stage while microbatch activations ppermute over pp."""
         _run_composition_worker("sp")
 
+    @pytest.mark.slow
     def test_1f1b_switch_moe_pp_x_ep_exact(self):
         """(pp, ep): ep shards BOTH the batch (dp-style) and the experts
         — each device dispatches ITS tokens to resident experts via the
         all_to_all inside every stage."""
         _run_composition_worker("ep")
 
+    @pytest.mark.slow
     def test_interleaved_ring_pp_x_sp_exact(self):
         """INTERLEAVED schedule (v=2 virtual stages) composed with ring
         attention over sp — the bubble-divided schedule is as composable
         as 1F1B."""
         _run_composition_worker("sp_interleaved")
 
+    @pytest.mark.slow
     def test_1f1b_zigzag_ring_pp_x_sp_exact(self):
         """1F1B composed with the ZIGZAG (causal load-balanced) ring."""
         _run_composition_worker("sp_zigzag")
 
+    @pytest.mark.slow
     def test_1f1b_ring_moe_pp_x_sp_x_ep_exact(self):
         """(pp, sp, ep): all three in one shard_map."""
         _run_composition_worker("triple")

@@ -20,6 +20,7 @@ import json
 import os
 import signal
 import socket
+import tempfile
 import threading
 import time
 import urllib.error
@@ -63,7 +64,10 @@ class _FakeReplica:
         self.mode = "ok"
         self.hang_s = 10.0
         self.seen_trace_ids = []
+        self.seen_bodies = []
         self.generate_hits = 0
+        self.reply_tokens = [1, 2, 3]
+        self.resume_desc = None  # payload for mode="503resume"
         fake = self
 
         class H(BaseHTTPRequestHandler):
@@ -90,7 +94,11 @@ class _FakeReplica:
 
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
-                self.rfile.read(n)
+                raw = self.rfile.read(n)
+                try:
+                    fake.seen_bodies.append(json.loads(raw or b"{}"))
+                except json.JSONDecodeError:
+                    fake.seen_bodies.append(None)
                 fake.generate_hits += 1
                 fake.seen_trace_ids.append(
                     self.headers.get("X-Trace-Id"))
@@ -111,7 +119,15 @@ class _FakeReplica:
                     self._json(429, {"error": "queue full",
                                      "type": "queue_full"})
                     return
-                self._json(200, {"tokens": [1, 2, 3],
+                if fake.mode == "503resume":
+                    # A terminal engine failure mid-request: the typed
+                    # 503 carries the resume descriptor, exactly like
+                    # serving/server.py's engine_failed path.
+                    self._json(503, {"error": "engine failed",
+                                     "type": "engine_failed",
+                                     "resume": fake.resume_desc})
+                    return
+                self._json(200, {"tokens": list(fake.reply_tokens),
                                  "finish_reason": "length",
                                  "served_by": fake.rid})
 
@@ -375,6 +391,199 @@ class TestRouterProxy:
         assert "router_replicas_in_rotation" in text
 
 
+class TestResumeFailover:
+    """Resume-aware failover (ISSUE 9): the router re-dispatches a
+    failed replica's partially decoded request WITH its resume state —
+    prompt + emitted tokens, reduced decode budget, REMAINING deadline
+    — and prepends the carried tokens to the final response."""
+
+    def _front(self, a_kw=None, b_kw=None, **rt_kw):
+        # a is the JSQ choice (empty queue); b is the failover target.
+        fakes = {"a": _FakeReplica("a", queue_depth=0),
+                 "b": _FakeReplica("b", queue_depth=5)}
+        reg = _registry(*fakes.values())
+        rt_kw.setdefault("max_attempts", 3)
+        rt_kw.setdefault("retry_backoff", 0.01)
+        rt_kw.setdefault("proxy_timeout", 2.0)
+        rt = RouterServer(reg, port=0, own_registry_thread=False,
+                          **rt_kw).start()
+        host, port = rt.address
+        return f"http://{host}:{port}", fakes, reg, rt
+
+    def _teardown(self, fakes, rt):
+        rt.stop()
+        for f in fakes.values():
+            f.stop()
+
+    def test_503_descriptor_redispatches_with_resume_state(self):
+        base, fakes, reg, rt = self._front()
+        try:
+            fakes["a"].mode = "503resume"
+            fakes["a"].resume_desc = {"emitted_tokens": [7, 8],
+                                      "deadline_remaining_ms": 5000.0}
+            fakes["b"].reply_tokens = [9, 11]
+            code, resp, hdrs = _post(
+                base, {"tokens": [1, 2], "max_new_tokens": 4,
+                       "timeout_ms": 60000})
+            assert code == 200
+            # carried + continuation, one seamless result
+            assert resp["tokens"] == [7, 8, 9, 11]
+            assert resp["resumed"] is True
+            assert resp["resume_carried_tokens"] == 2
+            assert hdrs["X-Router-Replica"] == "b"
+            # b received the RESUME dispatch: frontier prompt, reduced
+            # budget, remaining (not fresh) deadline
+            body = fakes["b"].seen_bodies[-1]
+            assert body["tokens"] == [1, 2, 7, 8]
+            assert body["max_new_tokens"] == 2
+            # the REMAINING budget, aged by the router's own dwell
+            # time (backoff + bookkeeping) — never a fresh 60000
+            assert 3000.0 < body["timeout_ms"] <= 5000.0
+            m = reg.metrics
+            assert m.resume_failovers.value == 1
+            assert m.failovers.value == 1
+        finally:
+            self._teardown(fakes, rt)
+
+    def test_deadline_expired_mid_failover_maps_to_504(self):
+        """SATELLITE: the resumed budget is what is LEFT — a
+        descriptor whose deadline already lapsed resolves as the
+        existing typed 504, without burning another replica."""
+        base, fakes, reg, rt = self._front()
+        try:
+            fakes["a"].mode = "503resume"
+            fakes["a"].resume_desc = {"emitted_tokens": [7, 8],
+                                      "deadline_remaining_ms": 0.0}
+            code, resp, hdrs = _post(
+                base, {"tokens": [1, 2], "max_new_tokens": 4,
+                       "timeout_ms": 60000})
+            assert code == 504
+            assert resp["type"] == "deadline_exceeded"
+            assert resp["tokens_so_far"] == [7, 8]
+            assert fakes["b"].generate_hits == 0  # never dispatched
+        finally:
+            self._teardown(fakes, rt)
+
+    def test_connection_drop_resumes_via_journal_lookup(self):
+        """The SIGKILL signature: a dead connection yields no
+        descriptor, so the router consults resume_lookup (the
+        supervisor's post-mortem journal reader) and resumes from
+        whatever the dead replica journaled."""
+        looked_up = []
+
+        def lookup(rid, trace_id):
+            looked_up.append((rid, trace_id))
+            if rid == "a":
+                return {"emitted_tokens": [21, 22, 23],
+                        "deadline_remaining_ms": 8000.0}
+            return None
+
+        base, fakes, reg, rt = self._front(resume_lookup=lookup)
+        try:
+            fakes["a"].mode = "drop"
+            fakes["b"].reply_tokens = [30]
+            code, resp, hdrs = _post(
+                base, {"tokens": [5, 6], "max_new_tokens": 6},
+                headers=[("X-Trace-Id", "tid-sigkill")])
+            assert code == 200
+            assert resp["tokens"] == [21, 22, 23, 30]
+            assert resp["resumed"] is True
+            assert looked_up == [("a", "tid-sigkill")]
+            body = fakes["b"].seen_bodies[-1]
+            assert body["tokens"] == [5, 6, 21, 22, 23]
+            assert body["max_new_tokens"] == 3
+            assert 6000.0 < body["timeout_ms"] <= 8000.0  # aged, not fresh
+            assert not reg.is_routable("a")  # still evicted on the spot
+            assert reg.metrics.resume_failovers.value == 1
+        finally:
+            self._teardown(fakes, rt)
+
+    def test_drop_without_descriptor_reexecutes_from_scratch(self):
+        """No journal, no descriptor: the pre-journal contract holds —
+        plain retry of the ORIGINAL request elsewhere."""
+        base, fakes, reg, rt = self._front()
+        try:
+            fakes["a"].mode = "drop"
+            code, resp, hdrs = _post(
+                base, {"tokens": [5, 6], "max_new_tokens": 6})
+            assert code == 200
+            assert resp["tokens"] == [1, 2, 3]
+            assert "resumed" not in resp
+            body = fakes["b"].seen_bodies[-1]
+            assert body["tokens"] == [5, 6]
+            assert body["max_new_tokens"] == 6
+            assert reg.metrics.resume_failovers.value == 0
+        finally:
+            self._teardown(fakes, rt)
+
+    def test_carry_exhausting_budget_completes_without_redispatch(self):
+        """A descriptor whose emitted tokens already spend the whole
+        decode budget (the replica died after its last token, before
+        answering): the router finishes the request from the carry —
+        re-dispatching would send max_new_tokens=0 and bounce as a
+        400."""
+        base, fakes, reg, rt = self._front()
+        try:
+            fakes["a"].mode = "503resume"
+            fakes["a"].resume_desc = {"emitted_tokens": [7, 8, 9],
+                                      "deadline_remaining_ms": 5000.0}
+            code, resp, hdrs = _post(
+                base, {"tokens": [1, 2], "max_new_tokens": 3,
+                       "timeout_ms": 60000})
+            assert code == 200
+            assert resp["tokens"] == [7, 8, 9]
+            assert resp["finish_reason"] == "length"
+            assert resp["resumed"] is True
+            assert fakes["b"].generate_hits == 0  # nothing re-dispatched
+            assert reg.metrics.resume_failovers.value == 1
+        finally:
+            self._teardown(fakes, rt)
+
+    def test_carry_ending_in_eos_completes_without_redispatch(self):
+        """A carried tail ending in eos_id is a FINISHED generation —
+        continuing it elsewhere would decode past EOS, emitting tokens
+        an uninterrupted run never would."""
+        base, fakes, reg, rt = self._front()
+        try:
+            fakes["a"].mode = "503resume"
+            fakes["a"].resume_desc = {"emitted_tokens": [7, 42],
+                                      "deadline_remaining_ms": 5000.0}
+            code, resp, hdrs = _post(
+                base, {"tokens": [1, 2], "max_new_tokens": 9,
+                       "eos_id": 42, "timeout_ms": 60000})
+            assert code == 200
+            assert resp["tokens"] == [7, 42]
+            assert resp["finish_reason"] == "eos"
+            assert resp["resumed"] is True
+            assert fakes["b"].generate_hits == 0
+        finally:
+            self._teardown(fakes, rt)
+
+    def test_exhausted_attempts_relay_carries_full_resume_state(self):
+        """Every replica failed typed: the relayed 503's descriptor is
+        rewritten to the FULL accumulated frontier, so an upstream
+        caller can itself resume from the true position."""
+        base, fakes, reg, rt = self._front(max_attempts=2)
+        try:
+            for f in fakes.values():
+                f.mode = "503resume"
+            fakes["a"].resume_desc = {"emitted_tokens": [7, 8],
+                                      "deadline_remaining_ms": 9000.0}
+            fakes["b"].resume_desc = {"emitted_tokens": [9],
+                                      "deadline_remaining_ms": 7000.0}
+            code, resp, hdrs = _post(
+                base, {"tokens": [1, 2], "max_new_tokens": 6,
+                       "timeout_ms": 60000})
+            assert code == 503 and resp["type"] == "engine_failed"
+            assert resp["resume"]["emitted_tokens"] == [7, 8, 9]
+            # b's dispatch already carried a's tokens
+            body = fakes["b"].seen_bodies[-1]
+            assert body["tokens"] == [1, 2, 7, 8]
+            assert body["max_new_tokens"] == 4
+        finally:
+            self._teardown(fakes, rt)
+
+
 # ---------------------------------------------------------------------------
 # the /stats routing contract + Retry-After on a REAL engine
 # ---------------------------------------------------------------------------
@@ -541,6 +750,7 @@ def _burst(base, prompts, steps, kill_after=None, timeout=60):
 
 
 @pytest.mark.chaos
+@pytest.mark.slow
 class TestFrontTierChaos:
     """The acceptance invariant (ISSUE 8): with 3 replicas under
     concurrent load, killing one mid-decode drops ZERO requests; the
@@ -560,6 +770,86 @@ class TestFrontTierChaos:
         rt = RouterServer(reg, port=0, max_attempts=4,
                           retry_backoff=0.05, proxy_timeout=8.0)
         return reg, sup, rt
+
+    def test_sigkill_mid_decode_resumes_on_survivor(self, model):
+        """ACCEPTANCE (ISSUE 9): SIGKILL a replica mid-decode under
+        concurrent load, with request journaling armed.  The router
+        reads the dead replica's journal post-mortem and CONTINUES its
+        partially decoded requests on the survivor — every request
+        resolves 200 with output byte-identical to the no-fault greedy
+        oracle, at least one of them via a genuine resume (carried
+        tokens > 0), and the wasted work is one re-prefill, not a
+        re-execution."""
+        params, cfg = model
+        spec = ReplicaSpec(seed=0, slots=4, warm=(8, 30),
+                           tick_timeout=30.0, drain_timeout=3.0,
+                           request_timeout=90.0)
+        reg = ReplicaRegistry(poll_interval=0.15, poll_timeout=1.0,
+                              heartbeat_stale=5.0)
+        journal_dir = tempfile.mkdtemp(prefix="router_journal_")
+        sup = ReplicaSupervisor(spec, 2, registry=reg,
+                                unhealthy_grace=1.5, shutdown_grace=2.0,
+                                backoff_initial=0.1,
+                                journal_dir=journal_dir)
+        rt = RouterServer(reg, port=0, max_attempts=4,
+                          retry_backoff=0.05, proxy_timeout=120.0,
+                          resume_lookup=sup.resume_lookup)
+        sup.start()
+        rt.start()
+        try:
+            assert sup.wait_ready(timeout=240), "replicas never ready"
+            host, port = rt.address
+            base = f"http://{host}:{port}"
+            steps = 24
+            rng = np.random.default_rng(3)
+            prompts = [[int(t) for t in rng.integers(1, 60, 2 + i % 3)]
+                       for i in range(6)]
+
+            def kill_busy_replica():
+                """SIGKILL a replica whose JOURNAL shows a request
+                genuinely mid-decode (enough emitted to prove a real
+                carry, enough remaining that it cannot retire between
+                this check and the kill) — /stats counters are
+                cumulative and could pick a victim whose work just
+                finished, leaving nothing to resume."""
+                deadline = time.monotonic() + 60.0
+                while time.monotonic() < deadline:
+                    for h in sup.replicas():
+                        try:
+                            live = serving.RequestJournal.read_live(
+                                sup._journal_paths[h.rid])
+                        except Exception:
+                            continue
+                        if any(5 <= len(d["emitted_tokens"]) <= steps - 8
+                               for d in live.values()):
+                            os.kill(h.pid, signal.SIGKILL)
+                            return
+                    time.sleep(0.02)
+                raise AssertionError("no replica ever got mid-decode")
+
+            results = _burst(base, prompts, steps, timeout=120,
+                             kill_after=kill_busy_replica)
+
+            assert len(results) == len(prompts)
+            drops = [i for i, (c, _) in results.items() if c is None]
+            assert not drops, f"transport-dropped requests: {results}"
+            resumed_carried = 0
+            for i, (code, resp) in results.items():
+                assert code == 200, f"req {i}: {code} {resp}"
+                # byte-identical to the no-fault oracle, THROUGH the
+                # kill and the resume
+                assert resp["tokens"] == _ref_greedy(
+                    params, cfg, prompts[i], steps), f"req {i}"
+                if resp.get("resumed"):
+                    resumed_carried += resp["resume_carried_tokens"]
+            # at least one request truly CONTINUED mid-decode (the
+            # victim had >= 8 tokens generated when killed)
+            assert resumed_carried >= 1, \
+                f"no request resumed: {results}"
+            assert reg.metrics.resume_failovers.value >= 1
+        finally:
+            rt.stop()
+            sup.stop(drain=False)
 
     def test_sigkill_replica_zero_dropped_requests(self, model):
         params, cfg = model

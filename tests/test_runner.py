@@ -368,6 +368,7 @@ class TestLaunch:
         )
         assert rc == 3
 
+    @pytest.mark.slow
     def test_real_two_process_launch(self, tmp_path):
         """Actually spawn 2 local processes that rendezvous through the KV
         server and verify each other's ranks — real end-to-end launch."""
@@ -407,6 +408,7 @@ class TestLaunch:
         assert "ok" in (out / "rank.1.stdout").read_text()
 
 
+    @pytest.mark.slow
     def test_sigterm_kills_term_swallowing_ranks(self, tmp_path):
         """SIGTERM to the launcher must reap ranks that CATCH SIGTERM
         (JAX installs a preemption notifier that swallows it): the

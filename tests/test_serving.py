@@ -94,6 +94,7 @@ class TestSlotCache:
 
 
 class TestDecodeStepSlots:
+    @pytest.mark.slow
     def test_matches_per_request_decode_step(self, model):
         """Row s of the masked slot decode == batch-1 decode_step at that
         slot's own position, for slots at DIFFERENT depths."""
@@ -137,6 +138,7 @@ class TestDecodeStepSlots:
 
 
 class TestEngineCorrectness:
+    @pytest.mark.slow
     def test_token_identity_staggered_admission(self, model):
         """ACCEPTANCE: >= 3 concurrently admitted requests with unequal
         prompt lengths, admitted at different ticks, each token-identical
@@ -169,6 +171,7 @@ class TestEngineCorrectness:
         assert engine.decode_compilations == warm == 1
         assert engine.stats()["requests_completed"] == 4
 
+    @pytest.mark.slow
     def test_slot_reuse_no_contamination(self, model):
         """More requests than slots: retirement frees slots that later
         requests reuse; every output must still match per-request
@@ -297,6 +300,30 @@ class TestAdmissionControl:
         with pytest.raises(serving.DeadlineExceededError):
             fut.result(timeout=1.0)
         assert engine.stats()["requests_rejected"] == 2  # + take-time
+
+    def test_requeue_front_restores_fcfs_and_ignores_depth_bound(self):
+        """The resume path's re-admission hook: requeued requests go
+        back to the HEAD in the given order, ahead of everything
+        queued, and are exempt from max_queue_depth (their callers
+        already hold live futures)."""
+        class _F:
+            def done(self):
+                return False
+            cancel_requested = False
+
+        sched = serving.Scheduler(max_queue_depth=2)
+        queued = serving.Request(prompt=[9], max_new_tokens=1, future=_F())
+        sched.submit(queued)
+        r1 = serving.Request(prompt=[1], max_new_tokens=1, future=_F())
+        r2 = serving.Request(prompt=[2], max_new_tokens=1, future=_F())
+        r3 = serving.Request(prompt=[3], max_new_tokens=1, future=_F())
+        sched.requeue_front([r1, r2, r3])  # depth 4 > bound 2: allowed
+        assert sched.depth == 4
+        out = sched.take(free_slots=4)
+        # resumed head first, in given order; the old head after
+        assert [r.prompt for r in out[:2]] == [[1], [2]]
+        out += sched.take(free_slots=4)
+        assert [r.prompt for r in out] == [[1], [2], [3], [9]]
 
     def test_request_too_long_typed_rejection(self, model):
         params, cfg = model

@@ -225,6 +225,7 @@ class TestStateBroadcast:
         assert all("exp_avg" in s for s in st.values())
 
 
+@pytest.mark.slow
 class TestTorchMultiProcess:
     def _spawn(self, tmp_path, scenario, nproc):
         import socket
