@@ -841,6 +841,220 @@ def decode_step_paged(params: Dict, tokens_t, pool: Dict, table,
     return logits[:, 0], out
 
 
+# --- speculative decoding (draft / verify multi-token ticks) ------------------
+#
+# Leviathan et al., "Fast Inference from Transformers via Speculative
+# Decoding": draft K cheap tokens, verify them in ONE batched target
+# forward, accept the agreeing prefix plus the target's correction token.
+# Under GREEDY decoding the emitted tokens are ALWAYS the target's own
+# argmax continuations — draft quality moves only the acceptance rate
+# (tokens per tick), never the output — so byte-identity to the
+# non-speculative path is a property of the verify kernel alone.
+
+
+def draft_propose_paged(params: Dict, tokens_t, pool: Dict, table,
+                        cfg: TransformerConfig, active, k: int):
+    """``k`` greedy draft tokens per slot from a (shallow) draft model:
+    ``k + 1`` sequential :func:`decode_step_paged` steps in one trace —
+    step ``i`` feeds the previous step's argmax, so the scan writes the
+    draft's OWN K/V for every token it proposes (plus one extra step so
+    the last draft's K/V lands too; its logits are discarded).  The
+    draft pool's ``pos`` advances by ``k + 1`` — the caller rolls it
+    back to the verified position, and write-before-attend makes the
+    rejected tail's stale K/V inert (the next tick's draft overwrites
+    position ``p`` before attending it, exactly the slot-reuse
+    argument).  Returns ``(drafts (S, k) int32, updated draft pool)``."""
+
+    def step(carry, _):
+        tok, pl = carry
+        logits, pl = decode_step_paged(params, tok, pl, table, cfg, active)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, pl), nxt
+
+    (_, pool), ds = lax.scan(step, (tokens_t, pool), None, length=k + 1)
+    return jnp.moveaxis(ds, 0, 1)[:, :k], pool
+
+
+def ngram_propose(hist, pos, k: int):
+    """Draft ``k`` tokens per slot by PROMPT LOOKUP (n-gram
+    self-speculation — no second model): find the most recent earlier
+    occurrence of the slot's final bigram in its committed token
+    history and propose the ``k`` tokens that followed it.
+
+    ``hist``: (S, T) committed tokens, position ``pos[s]`` holding slot
+    ``s``'s last committed token; ``pos``: (S,) int32.  Slots with no
+    earlier match (or fewer than two committed tokens) fall back to
+    repeating the last token.  Entirely data-dependent gathers — one
+    executable for every history.  Draft quality only moves the
+    acceptance rate; the verify kernel owns correctness."""
+    S, T = hist.shape
+    rows = jnp.arange(S)
+    last = hist[rows, jnp.clip(pos, 0, T - 1)]
+    prev = hist[rows, jnp.clip(pos - 1, 0, T - 1)]
+    iota = lax.broadcasted_iota(jnp.int32, (S, T), 1)
+    nxt = jnp.concatenate([hist[:, 1:], jnp.zeros((S, 1), hist.dtype)],
+                          axis=1)
+    match = ((hist == prev[:, None]) & (nxt == last[:, None])
+             & (iota + 1 < pos[:, None]))
+    idx = jnp.max(jnp.where(match, iota, -1), axis=1)  # most recent
+    found = idx >= 0
+    gidx = (idx + 2)[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+    drafts = jnp.take_along_axis(hist, jnp.clip(gidx, 0, T - 1), axis=1)
+    # Gate the copy window to COMMITTED positions (<= pos): a match
+    # near the end of history would otherwise draft uncommitted zeros
+    # — on a pure repeat ("a a a a", where the most recent match ends
+    # one short of the final bigram) that would cap acceptance at 1/k.
+    # Past the committed region, fall back to repeating the last token
+    # (exactly right for period-1 repeats, harmlessly wrong otherwise).
+    ok = found[:, None] & (gidx <= pos[:, None])
+    return jnp.where(ok, drafts, last[:, None])
+
+
+def decode_verify_paged(params: Dict, window, pool: Dict, table,
+                        cfg: TransformerConfig, active, spec_on=None):
+    """One batched W-position VERIFY forward over a paged cache — the
+    speculative tick's target-model half.
+
+    ``window``: (S, W) int32 — column 0 is each slot's last COMMITTED
+    token, columns 1..W-1 its drafts.  The window runs as a
+    prefill-style multi-position forward: query offset ``j`` (logical
+    position ``pos[s] + j``) attends the slot's committed pages
+    (positions ``< pos[s]``, gathered through the table exactly like
+    :func:`decode_step_paged`) plus window offsets ``<= j``, with the
+    window K/V attended AFTER a storage-dtype round trip (int8
+    quantize-dequantize for quantized pools) so every position's logits
+    are bit-identical to the sequential one-token path, which always
+    reads its own K/V back from the pool.
+
+    Acceptance is computed IN-KERNEL and is DATA: ``t = argmax`` per
+    position is the target's greedy continuation, and ``acc[s]`` is the
+    length of the agreeing draft prefix (``window[s, 1 + i] ==
+    t[s, i]``), so a slot emits tokens ``t[s, 0..acc[s]]`` — the
+    accepted drafts (identical to the target's own picks) plus the
+    correction/bonus token.  Varying acceptance never recompiles.
+
+    K/V is then scattered for ACCEPTED window offsets only (offset 0,
+    the committed token, always writes): the rejected tail — and any
+    position past the table's capacity — is routed to physical page 0,
+    the reserved NULL/trash page, so a draft the target disagreed with
+    can never contaminate a page another slot (or a COW prefix sharer)
+    may come to own.  ``spec_on`` (optional (S,) bool) forces
+    ``acc = 0`` for opted-out slots — they emit exactly the one greedy
+    token per tick through the same executable.
+
+    Returns ``(target_tokens (S, W) int32, max_logits (S, W) f32,
+    accepted (S,) int32, updated pool)`` with ``pos`` advanced by
+    ``acc + 1`` per active slot."""
+    pos = pool["pos"]
+    S, W = window.shape
+    max_pages = table.shape[1]
+    ps = pool["k"].shape[3]
+    T_cap = max_pages * ps
+    quantized = "k_scale" in pool
+    storage = pool["k"].dtype
+    H, Hkv, Dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    G = H // Hkv
+
+    x = params["embed"].astype(cfg.dtype)[window]  # (S, W, D)
+    x = jnp.where(active[:, None, None], x, jnp.zeros_like(x))
+    positions = pos[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    # (S, 1, 1, W, T + W) mask: committed cache strictly below pos[s]
+    # (page-tail junk and ungranted NULL-page garbage are >= pos, so
+    # they are never attended), window causal within itself.
+    cache_vis = (lax.broadcasted_iota(jnp.int32, (T_cap,), 0)[None, :]
+                 < pos[:, None])
+    cache_vis = jnp.broadcast_to(cache_vis[:, None, :], (S, W, T_cap))
+    win_vis = (lax.broadcasted_iota(jnp.int32, (W, W), 1)
+               <= lax.broadcasted_iota(jnp.int32, (W, W), 0))
+    win_vis = jnp.broadcast_to(win_vis[None], (S, W, W))
+    mask = jnp.concatenate([cache_vis, win_vis], axis=2)[:, None, None]
+
+    def layer(x, inp):
+        if quantized:
+            p, k_c, v_c, ks_c, vs_c = inp
+        else:
+            (p, k_c, v_c), ks_c, vs_c = inp, None, None
+        h = _rmsnorm(x, p["ln1"])
+        qh, kh, vh = _qkv_proj(h, p, cfg, positions=positions)
+        if quantized:
+            qk, sk = kv_quantize(kh)
+            qv, sv = kv_quantize(vh)
+            kh_a = kv_dequantize(qk, sk, cfg.dtype)
+            vh_a = kv_dequantize(qv, sv, cfg.dtype)
+            kg = kv_dequantize(_gather_pages(k_c, table),
+                               _gather_scales(ks_c, table), cfg.dtype)
+            vg = kv_dequantize(_gather_pages(v_c, table),
+                               _gather_scales(vs_c, table), cfg.dtype)
+            ys = (qk, sk, qv, sv)
+        else:
+            kh_a = kh.astype(storage)
+            vh_a = vh.astype(storage)
+            kg = _gather_pages(k_c, table)
+            vg = _gather_pages(v_c, table)
+            ys = (kh_a, vh_a)
+        k_full = jnp.concatenate([kg, kh_a], axis=2)  # (S,Hkv,T+W,Dh)
+        v_full = jnp.concatenate([vg, vh_a], axis=2)
+        # Grouped-query attention, W queries wide — _cache_attend's
+        # bandwidth discipline (stored dtype, f32 MXU accumulation).
+        qg = qh.reshape(S, Hkv, G, W, Dh)
+        sc = jnp.einsum("bkgsd,bktd->bkgst", qg.astype(k_full.dtype),
+                        k_full, preferred_element_type=jnp.float32
+                        ) / np.sqrt(Dh)
+        sc = jnp.where(mask, sc, -1e30)
+        w = jax.nn.softmax(sc, axis=-1)
+        o = jnp.einsum("bkgst,bktd->bkgsd", w.astype(v_full.dtype),
+                       v_full, preferred_element_type=jnp.float32)
+        out = _out_proj(o.reshape(S, H, W, Dh).astype(cfg.dtype), p, cfg)
+        return _mlp_block(x + out, p, cfg, moe_impl="dense"), ys
+
+    xs = (params["layers"], pool["k"], pool["v"])
+    if quantized:
+        xs = xs + (pool["k_scale"], pool["v_scale"])
+    x, ys = lax.scan(layer, x, xs)
+    logits = _lm_head(x, params["ln_f"], params["head"], cfg)  # (S,W,V)
+    t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    mx = jnp.max(logits, axis=-1)
+    match = (window[:, 1:] == t[:, :-1]).astype(jnp.int32)
+    acc = jnp.cumprod(match, axis=1).sum(axis=1)  # agreeing prefix len
+    if spec_on is not None:
+        acc = jnp.where(spec_on, acc, 0)
+    acc = jnp.where(active, acc, 0)
+
+    # Accepted-only scatter: window offset j lands at logical position
+    # pos[s] + j through the table iff accepted (j <= acc) and within
+    # capacity; everything else — rejected drafts, inactive rows,
+    # out-of-capacity positions — routes to the NULL page (physical 0).
+    j = jnp.arange(W, dtype=jnp.int32)[None, :]
+    wpos = pos[:, None] + j
+    ok = active[:, None] & (j <= acc[:, None]) & (wpos < T_cap)
+    idxp = jnp.clip(wpos // ps, 0, max_pages - 1)
+    phys = jnp.where(ok, jnp.take_along_axis(table, idxp, axis=1), 0)
+    off = wpos % ps
+
+    def scatter(pool_l, vals_l):
+        # pool_l (P, Hkv, ps, Dh); vals_l (S, Hkv, W, Dh) -> indexed
+        # result dims (S, W) lead, giving (S, W, Hkv, Dh) values.
+        return pool_l.at[phys, :, off, :].set(jnp.moveaxis(vals_l, 2, 1))
+
+    def scatter_scale(scale_l, vals_l):
+        return scale_l.at[phys, :, off].set(jnp.moveaxis(vals_l, 2, 1))
+
+    if quantized:
+        qk, sk, qv, sv = ys
+        out = {
+            "k": jax.vmap(scatter)(pool["k"], qk),
+            "v": jax.vmap(scatter)(pool["v"], qv),
+            "k_scale": jax.vmap(scatter_scale)(pool["k_scale"], sk),
+            "v_scale": jax.vmap(scatter_scale)(pool["v_scale"], sv),
+        }
+    else:
+        kh_all, vh_all = ys
+        out = {"k": jax.vmap(scatter)(pool["k"], kh_all),
+               "v": jax.vmap(scatter)(pool["v"], vh_all)}
+    out["pos"] = pos + jnp.where(active, acc + 1, 0)
+    return t, mx, acc, out
+
+
 def prefill_with_prefix(params: Dict, suffix, prefix_k, prefix_v,
                         prefix_len, cfg: TransformerConfig, *,
                         true_len, moe_impl: str = "dropless"):

@@ -212,31 +212,36 @@ def pipeline_value_and_grad(stage_fn: Callable, stage_params, microbatches,
                 return pipeline_apply(stage_fn, params, mbs,
                                       axis_name=axis_name, **akw)
 
-        def total_loss(params, lp, mbs):
+        def local_loss(params, lp, mbs):
             if aux_weight is not None:
                 outs, aux_local = _apply(params, mbs, stage_aux=True)
             else:
                 outs = _apply(params, mbs)
             losses = jax.vmap(lambda y, t: _apply_loss(lp, y, t))(
                 outs, targets)
-            # Gate the (replicated) loss to the last stage and psum: the
-            # value is unchanged, but the backward cotangent is nonzero
-            # only there — so loss_param grads land on the last stage and
+            # Gate the loss to the last stage and return THIS DEVICE's
+            # contribution WITHOUT a psum: a psum's transpose is a psum,
+            # so combining the loss inside the differentiated function
+            # would broadcast every stage's seed cotangent back to every
+            # other stage and multiply the gradients by the axis size
+            # (the model-level _varying_value_and_grad documents the
+            # same trap).  Gating keeps the backward cotangent nonzero
+            # only on the last stage — loss_param grads land there and
             # input grads on stage 0, zero elsewhere: the SAME ownership
-            # contract the 1f1b schedule produces (and the construction
-            # the model-level pipelined_value_and_grad documents).
-            raw = jnp.sum(losses)
-            total = lax.psum(jnp.where(s == P - 1, raw, 0.0), axis_name)
+            # contract the 1f1b schedule produces.  The psum that
+            # combines the VALUE happens outside the grad, below.
+            local = jnp.where(s == P - 1, jnp.sum(losses), 0.0)
             if aux_weight is not None:
-                # Each stage's aux is LOCAL (gradient ownership); the
-                # psum collects the value across stages.
-                total = total + aux_weight * lax.psum(aux_local, axis_name)
-            return total
+                # Each stage's aux is LOCAL too (gradient ownership);
+                # the value-psum outside collects it across stages.
+                local = local + aux_weight * aux_local
+            return local
 
         argnums = [0] + ([1] if has_lp else []) + (
             [2] if return_input_grads else [])
-        loss, grads = jax.value_and_grad(total_loss, argnums=tuple(argnums))(
+        local, grads = jax.value_and_grad(local_loss, argnums=tuple(argnums))(
             stage_params, loss_params, microbatches)
+        loss = lax.psum(local, axis_name)
         if not has_lp and not return_input_grads:
             return loss, grads[0]
         extras = {}

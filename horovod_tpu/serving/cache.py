@@ -371,6 +371,18 @@ class PagedSlotCache:
         self._active[slot] = True
         return slot
 
+    def acquire(self, slot: int) -> None:
+        """Mark a SPECIFIC slot active — the paired-pool primitive: a
+        draft model's page pool mirrors the target pool slot-for-slot
+        (same slot ids, same retirement), so its allocator follows the
+        target's choices instead of making its own.  Refcount/COW rules
+        are unchanged; :meth:`free` releases as usual."""
+        if self._active[slot]:
+            raise ValueError(f"slot {slot} is already active")
+        self._free.remove(slot)
+        heapq.heapify(self._free)
+        self._active[slot] = True
+
     def free(self, slot: int) -> None:
         """Retire a slot: every page its table references is
         dereferenced (a page reaching refcount 0 returns to the free

@@ -366,6 +366,37 @@ class EngineConfig:
     page_size: int = 16
     n_pages: int = 0
     kv_dtype: Optional[str] = None
+    # Speculative decoding (docs/serving.md "Speculative decoding"):
+    # draft spec_k tokens per active slot inside the compiled tick,
+    # verify them all in ONE batched target forward, emit the agreeing
+    # prefix plus the target's correction token — 1..spec_k+1 tokens
+    # per slot per tick, byte-identical to plain greedy decode (the
+    # emitted tokens are always the target's own argmax picks; draft
+    # quality moves only the acceptance rate).  Requires paged=True.
+    # spec_draft: "model" (a shallower TransformerConfig sharing the
+    # tokenizer, passed as InferenceEngine(draft_params=, draft_cfg=),
+    # with its own slot-aligned paged KV pool), "ngram" (prompt-lookup
+    # self-speculation over a device-resident token history — no
+    # second model), or "auto" (model when draft params are given,
+    # ngram otherwise).  draft_n_pages sizes the draft pool (0 =
+    # capacity parity, like n_pages).  Off by default until the A/B
+    # (benchmarks/serving.py --spec-ab) proves it for the workload.
+    # spec_adaptive bounds the LOSING case: per-slot recent acceptance
+    # is tracked over windows of spec_window speculative ticks, a slot
+    # under spec_min_acceptance has speculation auto-disabled (its
+    # mask is data), and a tick where NO slot speculates dispatches
+    # the plain one-token executable instead — so an adversarial
+    # workload decays to plain-engine throughput minus occasional
+    # probes (every spec_probe_period ticks a disabled slot re-enables
+    # to re-measure).  Output never depends on any of this.
+    speculative: bool = False
+    spec_k: int = 4
+    spec_draft: str = "auto"
+    draft_n_pages: int = 0
+    spec_adaptive: bool = True
+    spec_min_acceptance: float = 0.25
+    spec_window: int = 2
+    spec_probe_period: int = 256
     max_queue_depth: int = 64
     default_max_new_tokens: int = 64
     min_prefill_bucket: int = 8
@@ -417,11 +448,46 @@ class InferenceEngine:
 
     def __init__(self, params: Dict, cfg: "T.TransformerConfig",
                  engine_cfg: EngineConfig = EngineConfig(), *,
-                 detokenize: Optional[Callable[[int], str]] = None):
+                 detokenize: Optional[Callable[[int], str]] = None,
+                 draft_params: Optional[Dict] = None,
+                 draft_cfg: Optional["T.TransformerConfig"] = None):
         self.params = params
         self.cfg = cfg
         self.engine_cfg = engine_cfg
         self.detokenize = detokenize
+        # Speculative decoding: resolve the draft source up front so
+        # every cache/executable below is built for the right mode.
+        self._spec = engine_cfg.speculative
+        self._spec_model = False
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        if self._spec:
+            if not engine_cfg.paged:
+                raise ValueError(
+                    "EngineConfig.speculative requires paged=True (the "
+                    "verify kernel resolves page tables inside the "
+                    "compiled tick)")
+            if engine_cfg.spec_k < 1:
+                raise ValueError(
+                    f"spec_k must be >= 1, got {engine_cfg.spec_k}")
+            mode = engine_cfg.spec_draft
+            if mode == "auto":
+                mode = "model" if draft_params is not None else "ngram"
+            if mode not in ("model", "ngram"):
+                raise ValueError(
+                    f"unknown spec_draft {engine_cfg.spec_draft!r}; "
+                    "expected 'model', 'ngram', or 'auto'")
+            if mode == "model":
+                if draft_params is None or draft_cfg is None:
+                    raise ValueError(
+                        "spec_draft='model' needs draft_params and "
+                        "draft_cfg (a shallower TransformerConfig "
+                        "sharing the tokenizer)")
+                if draft_cfg.vocab_size != cfg.vocab_size:
+                    raise ValueError(
+                        f"draft model must share the tokenizer: vocab "
+                        f"{draft_cfg.vocab_size} != {cfg.vocab_size}")
+            self._spec_model = mode == "model"
         self.slots = self._make_slots()
         self.metrics = ServingMetrics()
         self.scheduler = Scheduler(
@@ -480,7 +546,97 @@ class InferenceEngine:
         # after warmup.
         self._decode_traces = 0
 
-        if engine_cfg.paged:
+        if engine_cfg.paged and self._spec:
+            # The SPECULATIVE tick: draft -> one batched W-position
+            # verify -> accepted-prefix select, all device-resident.
+            # Shapes are static in S and W = spec_k + 1; the per-slot
+            # accepted length is DATA, so varying acceptance never
+            # recompiles.  The device-side next-token is the bonus/
+            # correction token t[s, acc[s]] — the overlap pipeline's
+            # tick N+1 input, no host round-trip.
+            K = engine_cfg.spec_k
+            if self._spec_model:
+                dcfg = draft_cfg
+
+                def _tick(params, dparams, tokens, active, spec_on,
+                          table, dtable, pool, dpool):
+                    self._decode_traces += 1
+                    obs_tracing.record_compile("serving_decode")
+                    # Draft pos follows the TARGET pos at tick entry
+                    # too (not just exit): a probe-time rebuild from
+                    # host state can lag the device by an in-flight
+                    # tick, and drafting from a skewed position would
+                    # misplace the window's K/V for the whole tenancy.
+                    dpool = {**dpool, "pos": pool["pos"]}
+                    drafts, dpool = T.draft_propose_paged(
+                        dparams, tokens, dpool, dtable, dcfg, active, K)
+                    window = jnp.concatenate([tokens[:, None], drafts],
+                                             axis=1)
+                    t, mx, acc, pool = T.decode_verify_paged(
+                        params, window, pool, table, self.cfg, active,
+                        spec_on)
+                    # Draft rollback on rejection = reset pos to the
+                    # committed depth; the rejected tail's stale draft
+                    # K/V is overwritten before it is ever attended
+                    # (write-before-attend, per draft page).
+                    dpool = {**dpool, "pos": pool["pos"]}
+                    nxt = t[jnp.arange(t.shape[0]), acc]
+                    return (jnp.where(active, nxt, 0), t, mx, acc,
+                            pool, dpool)
+
+                self._tick_fn = jax.jit(_tick, donate_argnums=(7, 8))
+            else:
+                def _tick(params, tokens, active, spec_on, table, pool,
+                          hist):
+                    self._decode_traces += 1
+                    obs_tracing.record_compile("serving_decode")
+                    pos = pool["pos"]
+                    Th = hist.shape[1]
+                    rows = jnp.arange(hist.shape[0])
+                    # The last committed token joins the history first
+                    # (it IS committed); mode="drop" discards inactive
+                    # rows and out-of-range positions.
+                    hidx = jnp.where(active & (pos < Th), pos, Th)
+                    hist = hist.at[rows, hidx].set(tokens, mode="drop")
+                    drafts = T.ngram_propose(hist, pos, K)
+                    window = jnp.concatenate([tokens[:, None], drafts],
+                                             axis=1)
+                    t, mx, acc, pool = T.decode_verify_paged(
+                        params, window, pool, table, self.cfg, active,
+                        spec_on)
+                    # Accepted drafts are now committed history too.
+                    j = jnp.arange(1, K + 1, dtype=jnp.int32)[None, :]
+                    wp = pos[:, None] + j
+                    ok = (active[:, None] & (j <= acc[:, None])
+                          & (wp < Th))
+                    hist = hist.at[rows[:, None],
+                                   jnp.where(ok, wp, Th)].set(
+                        drafts, mode="drop")
+                    nxt = t[rows, acc]
+                    return (jnp.where(active, nxt, 0), t, mx, acc,
+                            pool, hist)
+
+                self._tick_fn = jax.jit(_tick, donate_argnums=(5, 6))
+
+            # The PLAIN one-token executable rides alongside: a tick
+            # where no slot speculates (every request opted out, or
+            # spec_adaptive disabled them all) dispatches this instead
+            # — the losing case pays plain-engine cost, not a W-wide
+            # verify for nothing.  Both executables are warmed by
+            # warmup(); per-slot acceptance and the mask are data, so
+            # the compile count stays constant at two.
+            def _ptick(params, tokens, active, table, pool):
+                self._decode_traces += 1
+                obs_tracing.record_compile("serving_decode")
+                logits, pool = T.decode_step_paged(
+                    params, tokens, pool, table, self.cfg, active)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                mx = jnp.max(logits, axis=-1)
+                return jnp.where(active, nxt, 0), mx, pool
+
+            self._plain_tick_fn = jax.jit(_ptick, donate_argnums=(4,))
+            donate = None
+        elif engine_cfg.paged:
             def _tick(params, tokens, active, table, pool):
                 self._decode_traces += 1
                 obs_tracing.record_compile("serving_decode")
@@ -513,8 +669,10 @@ class InferenceEngine:
         # alive across the tick (2x the KV HBM — half the servable
         # slots) and copies the whole cache every token.  (The page
         # TABLE is not donated — it is host-owned tick data, like the
-        # active mask.)
-        self._tick_fn = jax.jit(_tick, donate_argnums=(donate,))
+        # active mask.)  The speculative variants jit themselves above
+        # (their pool/draft-pool/history argnums differ).
+        if donate is not None:
+            self._tick_fn = jax.jit(_tick, donate_argnums=(donate,))
         self._prefill_fns: Dict[tuple, Callable] = {}
         self._prefill_traces = 0
         self._prefill_calls = 0  # prefill FORWARD PASSES (sharing hook)
@@ -548,6 +706,40 @@ class InferenceEngine:
             self.metrics.kv_pages_total.set(self.slots.n_pages)
             self.metrics.kv_pages_free.set(self.slots.free_pages)
             self.metrics.kv_bytes_per_token.set(self.slots.bytes_per_token)
+
+        # Speculative host state: the per-slot enablement mask (the
+        # per-request opt-out, uploaded as DATA like the active mask),
+        # the draft model's PAIRED paged pool (slot-aligned with the
+        # target pool; same refcount/COW machinery) or the n-gram
+        # draft's device-resident token history, and the draft model's
+        # own prefill compile cache.
+        self._spec_host = np.ones(engine_cfg.n_slots, bool)
+        self._dev_spec = None
+        self._dev_spec_host: Optional[np.ndarray] = None
+        # Adaptive speculation state (spec_adaptive): _spec_live is the
+        # auto-disable mask (False = acceptance fell below the floor),
+        # _spec_win accumulates (drafted, accepted) per slot over the
+        # evaluation window, _spec_idle counts ticks since disable (a
+        # probe re-enables at spec_probe_period), and _spec_stale marks
+        # slots whose draft state (n-gram history / draft-pool K/V)
+        # missed plain ticks and must be rebuilt before re-enabling.
+        self._spec_live = np.ones(engine_cfg.n_slots, bool)
+        self._spec_win = np.zeros((engine_cfg.n_slots, 2), np.int64)
+        self._spec_idle = np.zeros(engine_cfg.n_slots, np.int64)
+        self._spec_stale = np.zeros(engine_cfg.n_slots, bool)
+        self.draft_slots = self._make_draft_slots()
+        self._dev_dtable = None
+        self._dtable_uploaded = -1
+        self._dev_history = None
+        self._draft_prefill_fns: Dict[tuple, Callable] = {}
+        if self._spec and not self._spec_model:
+            # One scatter lands an admission group's prompt rows in the
+            # history (jit caches per (k, bucket) shape).
+            self._hist_land = jax.jit(
+                lambda hist, slots, padded: hist.at[
+                    slots[:, None],
+                    jnp.arange(padded.shape[1])[None, :]].set(padded),
+                donate_argnums=(0,))
 
         # Overlapped-pipeline state (engine_cfg.overlap).  _pending is
         # the ONE in-flight decode tick: its un-fetched device outputs
@@ -641,8 +833,15 @@ class InferenceEngine:
                eos_id: Optional[int] = None,
                deadline: Optional[float] = None,
                on_token: Optional[Callable] = None,
-               trace_id: Optional[str] = None) -> GenerationFuture:
+               trace_id: Optional[str] = None,
+               speculative: Optional[bool] = None) -> GenerationFuture:
         """Queue a generation request; returns its future.
+
+        ``speculative`` is the per-request opt-out on a speculative
+        engine (None = engine default): ``False`` pins the request to
+        one-token-per-tick greedy decode AS DATA — identical output,
+        predictable per-tick pacing, no recompile.  Ignored on a
+        non-speculative engine.
 
         ``trace_id`` propagates a caller-supplied id (the server passes
         the ``X-Trace-Id`` header) into the request's
@@ -700,7 +899,8 @@ class InferenceEngine:
         fut.trace = obs_tracing.RequestTrace(trace_id)
         fut._tracer = obs_tracing.get()
         req = Request(prompt=prompt, max_new_tokens=n_new, future=fut,
-                      eos_id=eos_id, deadline=deadline, trace=fut.trace)
+                      eos_id=eos_id, deadline=deadline, trace=fut.trace,
+                      speculative=speculative)
         if self.journal is not None:
             # Journal BEFORE the enqueue, purge-on-resolve wired first:
             # every resolution path (retire, typed error, cancel,
@@ -746,6 +946,37 @@ class InferenceEngine:
                                   page_size=ec.page_size,
                                   n_pages=ec.n_pages, kv_dtype=ec.kv_dtype)
         return SlotCache(self.cfg, ec.n_slots, ec.max_len)
+
+    def _make_draft_slots(self) -> Optional[PagedSlotCache]:
+        """The draft model's page pool: slot-aligned with the target
+        pool (same slot ids, same max_len) so retirement and admission
+        pair one-to-one.  Model dtype storage — draft quality only
+        moves the acceptance rate, but there is no reason to quantize a
+        pool this shallow."""
+        if not (self._spec and self._spec_model):
+            return None
+        ec = self.engine_cfg
+        return PagedSlotCache(self.draft_cfg, ec.n_slots,
+                              self.slots.max_len,
+                              page_size=ec.page_size,
+                              n_pages=ec.draft_n_pages)
+
+    def _release_slot(self, slot: int) -> None:
+        """Free a slot in the target pool AND its speculative
+        companions: the draft pool's paired slot (its pages return to
+        the draft free heap) and the opt-out mask (reset to the engine
+        default for the next tenant)."""
+        self.slots.free(slot)
+        self._spec_host[slot] = True
+        # The adaptive live/idle state deliberately SURVIVES the
+        # tenancy: acceptance is a property of the workload, and on
+        # homogeneous hostile traffic a slot that just proved drafts
+        # useless should not re-pay the evaluation window for every
+        # new request — probes still re-enable it periodically.
+        self._spec_win[slot] = 0
+        if (self.draft_slots is not None
+                and self.draft_slots._active[slot]):
+            self.draft_slots.free(slot)
 
     def register_prefix(self, tokens: Sequence[int]) -> None:
         """Register a SHARED PREFIX (e.g. the system prompt): its K/V
@@ -893,46 +1124,17 @@ class InferenceEngine:
             "(older requests keep their pages)"))
         self.metrics.rejected.inc()
         self._states[s] = None
-        self.slots.free(s)
+        self._release_slot(s)
         return True
 
     def _ensure_write_page(self, s: int) -> bool:
         """Grant (or copy-on-write) slot ``s``'s write page for the
-        next dispatch.  On pool exhaustion, evict youngest-first until
-        the grant succeeds; returns False if ``s`` itself was the
-        victim."""
+        next dispatch — the one-token point case of
+        :meth:`_ensure_write_range` (ONE copy of the grant/COW/evict
+        protocol).  Returns False if ``s`` itself was evicted paying
+        for its page."""
         wp = int(self._page_pos[s])
-        if wp >= self.slots.max_len:
-            # Capacity retirement is imminent (at most one stale
-            # pipeline tick); the kernel clamps the write into the
-            # slot's own last page.
-            return True
-        idx = wp // self.slots.page_size
-        st = self._states[s]
-        if (st is not None and self.slots.table[s, idx] == NULL_PAGE
-                and wp >= (len(st.request.prompt)
-                           + st.request.max_new_tokens - 1)):
-            # Past the request's last real write: only the overlapped
-            # pipeline's one-tick-lag junk dispatch (the tick after the
-            # final token, dropped by _retire_pending) can target this
-            # position.  With no page mapped the kernel routes the
-            # write to the NULL page — granting here could evict a LIVE
-            # request to buy a page for a token nobody keeps.
-            return True
-        while True:
-            try:
-                if self.slots.table[s, idx] == NULL_PAGE:
-                    self.slots.grant(s, idx)
-                else:
-                    # Present but possibly shared (a prompt that IS the
-                    # prefix grows into the shared partial page): COW
-                    # makes it private before the write targets it.
-                    self.slots.cow(s, idx)
-                return True
-            except CacheOutOfPagesError:
-                self._evict_for_pages()
-                if self._states[s] is None:
-                    return False  # s was the youngest — it paid
+        return self._ensure_write_range(s, wp, wp)
 
     def _prepare_paged_tick(self) -> None:
         """Tick-boundary page maintenance: every active slot gets a
@@ -948,14 +1150,352 @@ class InferenceEngine:
             self._dev_table = jnp.asarray(self.slots.table)
             self._table_uploaded = self.slots.table_version
 
+    def _ensure_write_range(self, s: int, lo: int, hi: int) -> bool:
+        """Grant/COW PRIVATE pages under every write position in
+        ``[lo, hi]`` — the speculative tick writes a WINDOW, not a
+        point.  Positions past the request's last real write (or the
+        table's capacity) are left unmapped: the kernel routes those
+        writes to the NULL page, so no page is ever bought for a token
+        nobody keeps.  Evicts youngest-first on exhaustion; returns
+        False if slot ``s`` itself was the victim."""
+        st = self._states[s]
+        if st is None:
+            return False
+        last_real = (len(st.request.prompt)
+                     + st.request.max_new_tokens - 2)
+        hi = min(hi, last_real, self.slots.max_len - 1)
+        if hi < lo:
+            return True
+        ps = self.slots.page_size
+        for idx in range(max(lo, 0) // ps, hi // ps + 1):
+            while True:
+                try:
+                    if self.slots.table[s, idx] == NULL_PAGE:
+                        self.slots.grant(s, idx)
+                    else:
+                        # Present but possibly shared (COW prefix):
+                        # make it private before any window write can
+                        # target it.  No-op when already private.
+                        self.slots.cow(s, idx)
+                    break
+                except CacheOutOfPagesError:
+                    self._evict_for_pages()
+                    if self._states[s] is None:
+                        return False  # s was the youngest — it paid
+        return True
+
+    def _ensure_draft_range(self, s: int, lo: int, hi: int) -> None:
+        """Draft-pool companion of :meth:`_ensure_write_range`.  Draft
+        pages never evict anyone: on exhaustion the slot's speculation
+        is simply DISABLED (acceptance forced to 0 as data — the plain
+        greedy path through the same executable) and its draft pages
+        return to the heap; correctness never depends on the draft."""
+        draft = self.draft_slots
+        st = self._states[s]
+        if (st is None or not self._spec_host[s]
+                or not self._spec_live[s] or not draft._active[s]):
+            return
+        last_real = (len(st.request.prompt)
+                     + st.request.max_new_tokens - 2)
+        hi = min(hi, last_real, draft.max_len - 1)
+        if hi < lo:
+            return
+        ps = draft.page_size
+        try:
+            for idx in range(max(lo, 0) // ps, hi // ps + 1):
+                if draft.table[s, idx] == NULL_PAGE:
+                    draft.grant(s, idx)
+        except CacheOutOfPagesError:
+            draft.free(s)
+            self._spec_host[s] = False
+
+    def _prepare_spec_tick(self) -> None:
+        """Tick-boundary maintenance for the SPECULATIVE tick.  The
+        window writes positions ``[pos, pos + K]``; with the overlap
+        pipeline, one dispatched-but-unfetched tick may have advanced
+        the device pos by up to ``K + 1`` already — the host learns the
+        accepted length one tick late — so grants cover the worst case
+        (``_page_pos`` is the FETCH-time mirror here, unlike the
+        non-speculative dispatch-time advance).  Over-granted pages are
+        not waste: pos only grows, so they are used within a few ticks
+        or freed at retirement."""
+        W = self.engine_cfg.spec_k + 1
+        pend = self._pending
+        for s in range(self.engine_cfg.n_slots):
+            st = self._states[s]
+            if st is None:
+                continue
+            base = int(self._page_pos[s])
+            inflight = (pend is not None and bool(pend["active"][s])
+                        and pend["reqs"][s] is st.request)
+            hi = base + (2 if inflight else 1) * W - 1
+            if (self._ensure_write_range(s, base, hi)
+                    and self._spec_model):
+                self._ensure_draft_range(s, base, hi)
+        if (self._dev_table is None
+                or self._table_uploaded != self.slots.table_version):
+            self._dev_table = jnp.asarray(self.slots.table)
+            self._table_uploaded = self.slots.table_version
+        if self._spec_model:
+            d = self.draft_slots
+            if (self._dev_dtable is None
+                    or self._dtable_uploaded != d.table_version):
+                self._dev_dtable = jnp.asarray(d.table)
+                self._dtable_uploaded = d.table_version
+        spec = (self._spec_host & self._spec_live
+                & self.slots.active_mask())
+        if (self._dev_spec_host is None
+                or not np.array_equal(spec, self._dev_spec_host)):
+            self._dev_spec = jnp.asarray(spec)
+            self._dev_spec_host = spec
+
+    def _draft_prefill_fn(self, bucket: int, k: int) -> Callable:
+        fn = self._draft_prefill_fns.get((bucket, k))
+        if fn is None:
+            dcfg = self.draft_cfg
+
+            def _prefill(params, padded, true_lens):
+                self._prefill_traces += 1
+                obs_tracing.record_compile("serving_draft_prefill")
+                cache = T.init_cache(dcfg, k, bucket)
+                return T.prefill(params, padded, cache, dcfg,
+                                 true_len=true_lens)
+
+            fn = jax.jit(_prefill)
+            self._draft_prefill_fns[(bucket, k)] = fn
+        return fn
+
+    def _spec_admit(self, slots: List[int], reqs: List[Request]) -> None:
+        """Per-admission speculative bookkeeping.  The per-request
+        opt-out lands in the slot mask; the n-gram draft gets the
+        prompt row scattered into the device history; the model draft
+        prefills its own paged pool with the FULL prompt (the draft
+        has no prefix registry — one extra shallow forward per
+        admission group, never fetched, so no host sync).  A draft
+        pool that cannot hold the prompt disables speculation for the
+        slot, never the request."""
+        if not self._spec:
+            return
+        for slot, req in zip(slots, reqs):
+            self._spec_host[slot] = req.speculative is not False
+        if not self._spec_model:
+            # FULL-WIDTH rows: zero the whole row, not just the prompt
+            # bucket — a previous tenant's committed tokens beyond the
+            # bucket would otherwise survive in the history and could
+            # be gathered into this request's drafts once its pos
+            # grows past them (wasted verify width, and no request's
+            # tokens should transit another's draft path).  Compile
+            # set: one (k, max_len) shape per admission size k.
+            k = len(slots)
+            padded = np.zeros((k, self.slots.max_len), np.int32)
+            for i, r in enumerate(reqs):
+                padded[i, :len(r.prompt)] = r.prompt
+            self._dev_history = self._hist_land(
+                self._history(), np.asarray(slots, np.int32), padded)
+            for slot in slots:
+                self._spec_stale[slot] = False
+            return
+        draft = self.draft_slots
+        for slot, req in zip(slots, reqs):
+            if not self._spec_host[slot]:
+                continue
+            if not self._spec_live[slot]:
+                # Adaptively disabled: skip the draft prefill now; a
+                # probe rebuilds from prompt + emitted if it re-enables.
+                self._spec_stale[slot] = True
+                continue
+            draft.acquire(slot)
+            try:
+                for idx in range(
+                        (len(req.prompt) - 1) // draft.page_size + 1):
+                    draft.grant(slot, idx)
+            except CacheOutOfPagesError:
+                draft.free(slot)
+                self._spec_host[slot] = False
+        live = [(s, r) for s, r in zip(slots, reqs)
+                if self._spec_host[s] and draft._active[s]]
+        if not live:
+            return
+        k = len(live)
+        bucket = self._bucket(max(len(r.prompt) for _, r in live))
+        padded = np.zeros((k, bucket), np.int32)
+        lens = np.zeros((k,), np.int32)
+        for i, (_, r) in enumerate(live):
+            padded[i, :len(r.prompt)] = r.prompt
+            lens[i] = len(r.prompt)
+        _, pre = self._draft_prefill_fn(bucket, k)(
+            self.draft_params, jnp.asarray(padded), jnp.asarray(lens))
+        self._prefill_calls += 1
+        draft.land([s for s, _ in live], pre, lens, start=0)
+        for s, _ in live:
+            self._spec_stale[s] = False
+
+    def _reset_spec_state(self) -> None:
+        """Reset ALL per-slot speculative state (opt-out mask, adaptive
+        live/idle/window, staleness) — the ONE copy the restart,
+        terminal, and post-warmup paths share."""
+        self._spec_host[:] = True
+        self._spec_live[:] = True
+        self._spec_win[:] = 0
+        self._spec_idle[:] = 0
+        self._spec_stale[:] = False
+
+    def _history(self):
+        """The n-gram draft's device-resident committed-token buffer,
+        created on first use (ONE definition of its shape)."""
+        if self._dev_history is None:
+            self._dev_history = jnp.zeros(
+                (self.engine_cfg.n_slots, self.slots.max_len), jnp.int32)
+        return self._dev_history
+
+    def _spec_adapt(self, s: int, accepted: int) -> None:
+        """Window the slot's acceptance; auto-disable speculation when
+        it falls under the floor (spec_adaptive).  Disabling is pure
+        data — output is identical either way — it just stops paying
+        draft+verify for a stream the draft cannot predict."""
+        if not self.engine_cfg.spec_adaptive:
+            return
+        self._spec_win[s, 0] += self.engine_cfg.spec_k
+        self._spec_win[s, 1] += accepted
+        if (self._spec_win[s, 0]
+                >= self.engine_cfg.spec_window * self.engine_cfg.spec_k):
+            rate = self._spec_win[s, 1] / self._spec_win[s, 0]
+            if rate < self.engine_cfg.spec_min_acceptance:
+                self._spec_live[s] = False
+                self._spec_idle[s] = 0
+                if self._spec_model:
+                    # A disabled slot's draft POOL decays even during
+                    # spec ticks (no pages are granted for it, so its
+                    # writes route to the NULL page) — the probe must
+                    # rebuild it or re-enabling would draft against a
+                    # garbage gap and re-disable forever.  The n-gram
+                    # HISTORY stays current through spec ticks (the
+                    # kernel commits every active row's tokens), so it
+                    # only goes stale on all-plain fallback ticks.
+                    self._spec_stale[s] = True
+            self._spec_win[s] = 0
+
+    def _spec_probe_clock(self, s: int) -> None:
+        """Tick the disabled slot's probe clock; at spec_probe_period
+        re-enable speculation for one evaluation window (rebuilding
+        any draft state plain ticks staled) so a stream that BECOMES
+        predictable gets speculation back."""
+        if not self._spec_live[s] and self._spec_host[s]:
+            self._spec_idle[s] += 1
+            if self._spec_idle[s] >= self.engine_cfg.spec_probe_period:
+                if self._spec_stale[s] and not self._respec_slot(s):
+                    self._spec_idle[s] = 0  # rebuild failed: try later
+                    return
+                self._spec_stale[s] = False
+                self._spec_live[s] = True
+                self._spec_idle[s] = 0
+                self._spec_win[s] = 0
+
+    def _respec_slot(self, s: int) -> bool:
+        """Rebuild slot ``s``'s draft state after plain ticks staled it
+        — the committed stream is ``prompt + tokens emitted this
+        tenancy``: re-land the n-gram history row, or re-prefill the
+        draft pool up to (but excluding) the pending input token, just
+        like admission does."""
+        st = self._states[s]
+        if st is None:
+            return False
+        fut = st.request.future
+        toks = fut.tokens_so_far()
+        gen = toks[len(toks) - st.n_generated:] if st.n_generated else []
+        committed = list(st.request.prompt) + [int(t) for t in gen]
+        if not self._spec_model:
+            # FULL-WIDTH row (not the prompt's bucket): committed
+            # length grows with every probe, and a bucketed landing
+            # here would JIT-compile a new shape mid-serving for each
+            # new length class — one (1, max_len) shape serves every
+            # probe forever.
+            padded = np.zeros((1, self.slots.max_len), np.int32)
+            padded[0, :len(committed)] = committed
+            self._dev_history = self._hist_land(
+                self._history(), np.asarray([s], np.int32), padded)
+            return True
+        draft = self.draft_slots
+        # The probe fires at FETCH time, after _page_pos advanced for
+        # the tick being retired but before its token is emitted — at
+        # that instant the cache-committed set is exactly prompt + all
+        # tokens emitted so far (the incoming token, this tick's, is
+        # the next pending input and is NOT in `committed` yet).  So
+        # the FULL list re-prefills, landing draft pos = len(committed)
+        # = the device pos; the in-kernel entry sync covers any
+        # overlap-pipeline skew beyond that.
+        body = committed
+        if not body:
+            return False
+        if not draft._active[s]:
+            draft.acquire(s)
+        try:
+            for idx in range((len(body) - 1) // draft.page_size + 1):
+                if draft.table[s, idx] == NULL_PAGE:
+                    draft.grant(s, idx)
+        except CacheOutOfPagesError:
+            draft.free(s)
+            return False
+        # FIXED full-width prefill shape (max_len, 1), like the n-gram
+        # branch: the committed length grows past every warmed prompt
+        # bucket, and a bucketed call here would JIT-compile inside a
+        # serving step (and inside the watchdog budget) at probe time.
+        # warmup() pre-compiles this one shape.
+        width = self.slots.max_len
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :len(body)] = body
+        lens = np.asarray([len(body)], np.int32)
+        _, pre = self._draft_prefill_fn(width, 1)(
+            self.draft_params, jnp.asarray(padded), jnp.asarray(lens))
+        self._prefill_calls += 1
+        draft.land([s], pre, lens, start=0)
+        return True
+
     def _run_tick(self, tokens_dev, active_dev):
-        """Dispatch ONE compiled decode tick (paged or slot-contiguous
-        — same contract: ``(next_tokens, max_logits, new cache)``)."""
+        """Dispatch ONE compiled decode tick.  Returns ``(next-token
+        device vector, pending extras)`` — the extras are what
+        :meth:`_retire_pending` fetches: plain ticks carry ``nxt``
+        ``(S,)`` / ``mx`` ``(S,)``; speculative ticks carry the full
+        target-token window ``nxt`` ``(S, W)``, ``mx`` ``(S, W)``, the
+        per-slot accepted length ``acc`` ``(S,)``, and the dispatch-
+        time speculation mask."""
+        if self._spec:
+            if not self._dev_spec_host.any():
+                # Nobody speculating this tick: the plain one-token
+                # executable earns the same greedy token at plain cost.
+                # Draft state (history / draft cache) goes stale for
+                # the slots it skips — marked for rebuild at re-probe.
+                self._spec_stale |= self.slots.active_mask()
+                nxt, mx, cache = self._plain_tick_fn(
+                    self.params, tokens_dev, active_dev,
+                    self._dev_table, self.slots.cache)
+                self.slots.cache = cache
+                return nxt, {"nxt": nxt, "mx": mx}
+            if self._spec_model:
+                nxt, t, mx, acc, pool, dpool = self._tick_fn(
+                    self.params, self.draft_params, tokens_dev,
+                    active_dev, self._dev_spec, self._dev_table,
+                    self._dev_dtable, self.slots.cache,
+                    self.draft_slots.cache)
+                self.draft_slots.cache = dpool
+            else:
+                nxt, t, mx, acc, pool, hist = self._tick_fn(
+                    self.params, tokens_dev, active_dev, self._dev_spec,
+                    self._dev_table, self.slots.cache,
+                    self._history())
+                self._dev_history = hist
+            self.slots.cache = pool
+            return nxt, {"nxt": t, "mx": mx, "acc": acc,
+                         "spec": self._dev_spec_host.copy()}
         if self.engine_cfg.paged:
-            return self._tick_fn(self.params, tokens_dev, active_dev,
-                                 self._dev_table, self.slots.cache)
-        return self._tick_fn(self.params, tokens_dev, active_dev,
-                             self.slots.cache)
+            nxt, mx, cache = self._tick_fn(
+                self.params, tokens_dev, active_dev, self._dev_table,
+                self.slots.cache)
+        else:
+            nxt, mx, cache = self._tick_fn(
+                self.params, tokens_dev, active_dev, self.slots.cache)
+        self.slots.cache = cache
+        return nxt, {"nxt": nxt, "mx": mx}
 
     def _update_page_gauges(self) -> None:
         if not self.engine_cfg.paged:
@@ -1038,14 +1578,14 @@ class InferenceEngine:
             fut = st.request.future
             if fut.done():
                 self._states[s] = None
-                self.slots.free(s)
+                self._release_slot(s)
                 worked = True
                 continue
             if fut.cancel_requested:
                 fut._finish("cancelled")
                 self.metrics.cancelled.inc()
                 self._states[s] = None
-                self.slots.free(s)
+                self._release_slot(s)
                 worked = True
         return worked
 
@@ -1251,7 +1791,7 @@ class InferenceEngine:
             try:
                 self._map_pages(slot, req, entry)
             except CacheOutOfPagesError as e:
-                self.slots.free(slot)  # releases whatever got mapped
+                self._release_slot(slot)  # releases whatever got mapped
                 req.future.set_exception(e)
                 self.metrics.rejected.inc()
                 self._taken.remove(req)
@@ -1297,6 +1837,7 @@ class InferenceEngine:
             firsts = np.asarray(jnp.argmax(logits, axis=-1))
         for slot, req in zip(slots, live):
             self._page_pos[slot] = len(req.prompt)
+        self._spec_admit(slots, live)
         return slots, live, firsts, synced
 
     def _emit(self, slot: int, tok: int) -> None:
@@ -1311,7 +1852,7 @@ class InferenceEngine:
             # anyway) or by a submit that raced a drain.  Reclaim the
             # slot here so it cannot leak and pin drain() forever.
             self._states[slot] = None
-            self.slots.free(slot)
+            self._release_slot(slot)
             return
         if st.request.future._add_token(tok) and self.journal is not None:
             # The journal mirrors the future EXACTLY: a token is
@@ -1344,7 +1885,7 @@ class InferenceEngine:
             st.request.future._finish(reason)
             self.metrics.completed.inc()
             self._states[slot] = None
-            self.slots.free(slot)
+            self._release_slot(slot)
 
     def _decode_tick(self) -> bool:
         """The SYNCHRONOUS decode tick (``overlap=False``, the A/B
@@ -1352,7 +1893,10 @@ class InferenceEngine:
         bookkeeping all in the same step — the device idles through the
         host half, which is exactly what the pipeline hides."""
         if self.engine_cfg.paged and self.slots.active_count:
-            self._prepare_paged_tick()  # grants/COWs; may preempt
+            if self._spec:
+                self._prepare_spec_tick()  # window grants; may preempt
+            else:
+                self._prepare_paged_tick()  # grants/COWs; may preempt
         active = self.slots.active_mask()
         if not active.any():
             return False
@@ -1363,9 +1907,12 @@ class InferenceEngine:
             if st is not None:
                 tokens[s] = st.last_token
         t0 = time.monotonic()
-        nxt, mx, self.slots.cache = self._run_tick(
+        nxt, extra = self._run_tick(
             jnp.asarray(tokens), jnp.asarray(active))
-        self._page_pos += active
+        if not self._spec:
+            # Speculative ticks advance the mirror at FETCH (the
+            # accepted length is data the host learns there).
+            self._page_pos += active
         self.metrics.decode_ticks.inc()
         dt = time.monotonic() - t0
         self.metrics.tick_dispatch.observe(dt)
@@ -1374,7 +1921,7 @@ class InferenceEngine:
             tp.tick_phase("tick_dispatch", t0, dt)
         # Same fetch-and-apply tail as the pipeline, just not deferred.
         self._retire_pending({
-            "nxt": nxt, "mx": mx, "active": active,
+            **extra, "active": active,
             "reqs": [st.request if st is not None else None
                      for st in self._states],
             "kind": kind, "dispatched_at": t0,
@@ -1395,7 +1942,10 @@ class InferenceEngine:
             # Page maintenance BEFORE the mask snapshot: a preemption
             # here must not be dispatched, and a grant/COW is host
             # bookkeeping + async uploads — nothing blocks on device.
-            self._prepare_paged_tick()
+            if self._spec:
+                self._prepare_spec_tick()
+            else:
+                self._prepare_paged_tick()
         active = self.slots.active_mask()
         new_pending: Optional[Dict] = None
         if active.any():
@@ -1415,9 +1965,10 @@ class InferenceEngine:
                     or not np.array_equal(active, self._dev_active_host)):
                 self._dev_active = jnp.asarray(active)
                 self._dev_active_host = active
-            nxt, mx, self.slots.cache = self._run_tick(
-                self._dev_tokens, self._dev_active)
-            self._page_pos += active
+            nxt, extra = self._run_tick(self._dev_tokens,
+                                        self._dev_active)
+            if not self._spec:
+                self._page_pos += active  # spec: advanced at fetch
             self._dev_tokens = nxt  # tick N+2's input — never fetched
             self.metrics.decode_ticks.inc()
             dt = time.monotonic() - t0
@@ -1426,7 +1977,7 @@ class InferenceEngine:
             if tp is not None:
                 tp.tick_phase("tick_dispatch", t0, dt)
             new_pending = {
-                "nxt": nxt, "mx": mx, "active": active,
+                **extra, "active": active,
                 "reqs": [st.request if st is not None else None
                          for st in self._states],
                 "kind": kind, "dispatched_at": t0,
@@ -1462,19 +2013,22 @@ class InferenceEngine:
         if faults is not None:
             faults.probe("decode_fetch")
         t0 = time.monotonic()
-        nxt = np.asarray(p["nxt"])
+        nxt = np.asarray(p["nxt"])           # (S,) — or (S, W) spec
         mx = np.asarray(p["mx"])
+        acc = np.asarray(p["acc"]) if "acc" in p else None
         self.metrics.host_syncs.inc()
         t1 = time.monotonic()
         self.metrics.tick_device_wait.observe(t1 - t0)
         active = p["active"]
         if p["kind"] == "nonfinite":  # injected: NaN logits
-            mx = np.where(active, np.nan, mx)
+            mx = np.where(active if mx.ndim == 1 else active[:, None],
+                          np.nan, mx)
         if not np.isfinite(mx[active]).all():
             raise EngineFailedError(
                 "non-finite logits from decode tick (bad params or "
                 "device fault)")
         lat = t1 - p["dispatched_at"]
+        spec_k = self.engine_cfg.spec_k
         for s in np.nonzero(active)[0]:
             s = int(s)
             st = self._states[s]
@@ -1488,7 +2042,47 @@ class InferenceEngine:
                 # this token: with the overlapped pipeline this is the
                 # one-tick lag made visible in the breakdown.
                 tr.host_sync_lag = lat
-            self._emit(s, int(nxt[s]))
+            if acc is None:
+                self.metrics.tokens_per_tick.observe(1)
+                if self._spec:
+                    # A plain tick dispatched by the speculative
+                    # engine (nobody speculating): pos advanced by
+                    # exactly one — mirror it, and let the slot's
+                    # probe clock run toward re-enabling.
+                    self._page_pos[s] += 1
+                    self._spec_probe_clock(s)
+                self._emit(s, int(nxt[s]))
+                continue
+            # Speculative: the device committed acc+1 positions for
+            # this slot whatever the host emits below (EOS/length may
+            # truncate the run) — mirror the advance before emission
+            # can retire the slot.
+            n = int(acc[s]) + 1
+            self._page_pos[s] += n
+            if p["spec"][s]:
+                self.metrics.spec_drafted.inc(spec_k)
+                self.metrics.spec_accepted.inc(int(acc[s]))
+                self.metrics.spec_wasted.inc(spec_k - int(acc[s]))
+                self.metrics.spec_acceptance.observe(
+                    int(acc[s]) / spec_k)
+                self._spec_adapt(s, int(acc[s]))
+            elif self._spec_host[s] and not self._spec_live[s]:
+                # Speculating for OTHERS this tick while this slot sat
+                # disabled: the n-gram history stays current (the
+                # kernel commits every active row's tokens) and the
+                # model draft was already marked stale at disable —
+                # only the probe clock moves here.
+                self._spec_probe_clock(s)
+            emitted = 0
+            for jt in range(n):
+                if self._states[s] is not st:
+                    # EOS / length / deadline retired the slot inside
+                    # the accepted run: the greedy oracle would never
+                    # emit the tail — drop it.
+                    break
+                self._emit(s, int(nxt[s, jt]))
+                emitted += 1
+            self.metrics.tokens_per_tick.observe(emitted)
         t2 = time.monotonic()
         self.metrics.tick_host.observe(t2 - t1)
         tp = obs_tracing.get()
@@ -1561,7 +2155,7 @@ class InferenceEngine:
         new = Request(prompt=list(entry.prompt) + list(entry.emitted),
                       max_new_tokens=entry.remaining, future=fut,
                       eos_id=entry.eos_id, deadline=req.deadline,
-                      trace=req.trace)
+                      trace=req.trace, speculative=req.speculative)
         new.id = req.id
         new.submitted_at = req.submitted_at
         # Wasted work = tokens RE-prefilled that were already computed
@@ -1578,6 +2172,9 @@ class InferenceEngine:
         self._taken = []
         self._states = [None] * self.engine_cfg.n_slots
         self.slots.release_all()
+        if self.draft_slots is not None:
+            self.draft_slots.release_all()
+        self._reset_spec_state()
         # release_all zeroed every page refcount, including the prefix
         # registry's pins: bump the epoch HERE (not just in _restart)
         # so stale entries can neither attach freed pages to a new
@@ -1598,6 +2195,11 @@ class InferenceEngine:
         self._dev_table = None
         self._table_uploaded = -1
         self._page_pos[:] = 0
+        self._dev_spec = None
+        self._dev_spec_host = None
+        self._dev_dtable = None
+        self._dtable_uploaded = -1
+        self._dev_history = None
 
     def _fail_queue(self, exc: BaseException) -> None:
         for req in self.scheduler.drain_pending():
@@ -1699,6 +2301,8 @@ class InferenceEngine:
         DRAINING (still rejecting new work), everything else restarts
         DEGRADED."""
         self.slots = self._make_slots()
+        self.draft_slots = self._make_draft_slots()
+        self._reset_spec_state()
         self._states = [None] * self.engine_cfg.n_slots
         self._reset_pipeline()
         # The page pool is fresh: registered prefixes' pinned pages
@@ -1876,6 +2480,35 @@ class InferenceEngine:
                         for _ in range(k)]
                 while not all(f.done() for f in futs):
                     self.step()
+        if self._spec:
+            # The speculative engine owns TWO decode executables — the
+            # draft/verify tick and the plain one-token tick it falls
+            # back to when no slot speculates (opt-outs, adaptive
+            # disable).  Warm the plain one too: an adaptive disable
+            # mid-serving must not pay XLA compilation inside the
+            # watchdog budget.
+            futs = [self.submit(prompts[0], max_new_tokens=2,
+                                speculative=False)]
+            while not all(f.done() for f in futs):
+                self.step()
+            # Warm the probe-path executables (both shape-stable at
+            # (1, max_len) by construction): history re-landing for
+            # the n-gram draft, the full-width draft re-prefill for
+            # the model draft.
+            if not self._spec_model:
+                self._dev_history = self._hist_land(
+                    self._history(), np.zeros((1,), np.int32),
+                    np.zeros((1, self.slots.max_len), np.int32))
+            else:
+                width = self.slots.max_len
+                self._draft_prefill_fn(width, 1)(
+                    self.draft_params,
+                    jnp.zeros((1, width), jnp.int32),
+                    jnp.ones((1,), jnp.int32))
+            # Warmup's synthetic zero-token prompts can legitimately
+            # measure poor acceptance — that must not carry a
+            # persistent adaptive disable into real traffic.
+            self._reset_spec_state()
 
     def drain(self, timeout: float = 60.0, poll: float = 0.002) -> bool:
         """Block until queue and slots are empty (True) or timeout.
@@ -2014,6 +2647,15 @@ class InferenceEngine:
             # — bounded by buckets x max_prefills_per_tick.
             "prefill_buckets": sorted(self._prefill_fns),
             "paged": self.engine_cfg.paged,
+            "speculative": self._spec,
+            **({
+                "spec_k": self.engine_cfg.spec_k,
+                "spec_draft": "model" if self._spec_model else "ngram",
+                "spec_slots_live": int(self._spec_live.sum()),
+                "draft_pages_free":
+                    self.draft_slots.free_pages
+                    if self.draft_slots is not None else None,
+            } if self._spec else {}),
             **({
                 "page_size": self.slots.page_size,
                 "kv_dtype": str(jnp.dtype(self.slots._storage_dtype).name),

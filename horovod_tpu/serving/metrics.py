@@ -153,6 +153,33 @@ class ServingMetrics:
             "serving_kv_bytes_per_token",
             "KV cache bytes per stored token (k+v across layers, "
             "incl. int8 scales) — the kv_dtype lever made legible")
+        # Speculative decoding (docs/serving.md "Speculative decoding"):
+        # tokens_per_tick is the multiplier made visible — every active
+        # slot observes how many tokens one tick emitted for it (always
+        # 1 on a non-speculative engine, 1..K+1 under speculation), so
+        # the speculative A/B and the overlap pipeline report on the
+        # same per-tick axis.  Acceptance is drafted-vs-accepted:
+        # wasted = drafted - accepted is the draft compute speculation
+        # burned on disagreement.
+        self.tokens_per_tick = r.histogram(
+            "serving_tokens_per_tick",
+            "Tokens emitted per slot per decode tick (1 without "
+            "speculation; 1..K+1 with it)",
+            buckets=tuple(float(b) for b in range(1, 18)))
+        self.spec_drafted = r.counter(
+            "serving_spec_drafted_tokens_total",
+            "Draft tokens proposed to the verify kernel")
+        self.spec_accepted = r.counter(
+            "serving_spec_accepted_tokens_total",
+            "Draft tokens the target's greedy verify accepted")
+        self.spec_wasted = r.counter(
+            "serving_spec_wasted_tokens_total",
+            "Draft tokens rejected by the verify (drafted - accepted)")
+        self.spec_acceptance = r.histogram(
+            "serving_spec_acceptance_ratio",
+            "Accepted/drafted ratio per slot per speculative tick",
+            buckets=(0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
+                     1.0))
         self.model_flops_per_token = r.gauge(
             "serving_model_flops_per_token",
             "Configured model FLOPs per generated token "
@@ -187,6 +214,13 @@ class ServingMetrics:
             "kv_pages_free": self.kv_pages_free.value,
             "kv_pages_shared": self.kv_pages_shared.value,
             "kv_bytes_per_token": self.kv_bytes_per_token.value,
+            "tokens_per_tick": self.tokens_per_tick.snapshot(),
+            "spec_drafted_tokens": self.spec_drafted.value,
+            "spec_accepted_tokens": self.spec_accepted.value,
+            "spec_wasted_tokens": self.spec_wasted.value,
+            "spec_acceptance_ratio":
+                round(self.spec_accepted.value / self.spec_drafted.value,
+                      4) if self.spec_drafted.value else None,
             "host_syncs": self.host_syncs.value,
             "host_syncs_per_tick":
                 round(self.host_syncs.value / ticks, 4) if ticks else None,

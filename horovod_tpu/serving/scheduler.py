@@ -98,6 +98,12 @@ class Request:
     deadline: Optional[float] = None
     submitted_at: float = 0.0
     trace: Any = None
+    # Per-request speculative-decoding opt-out (None = engine default):
+    # False pins the slot to the plain one-token-per-tick greedy path
+    # inside the same compiled speculative tick (acceptance forced to
+    # zero as data) — output is identical either way, this is a
+    # latency-predictability knob, not a correctness one.
+    speculative: Optional[bool] = None
     id: int = dataclasses.field(default_factory=lambda: next(_req_ids))
 
 

@@ -8,7 +8,8 @@ many-callers-one-controller shape, over HTTP).
 Endpoints:
 
 * ``POST /generate`` — body ``{"tokens": [...], "max_new_tokens": N,
-  "eos_id": E?, "timeout_ms": T?}`` (or ``{"text": ...}`` when the
+  "eos_id": E?, "timeout_ms": T?, "speculative": bool?}`` (or
+  ``{"text": ...}`` when the
   server was built with an ``encode`` callable).  Replies ``{"tokens":
   [...], "finish_reason": ..., "ttft_ms": ...}`` (+ ``"text"`` with a
   detokenizer).  Typed rejections map to HTTP: queue full -> 429,
@@ -215,7 +216,11 @@ class _Handler(BaseHTTPRequestHandler):
                 max_new_tokens=req.get("max_new_tokens"),
                 eos_id=req.get("eos_id"),
                 deadline=deadline,
-                trace_id=trace_id)
+                trace_id=trace_id,
+                # Per-request speculative opt-out ("speculative":
+                # false pins the request to one-token-per-tick greedy
+                # inside the same executable; output is identical).
+                speculative=req.get("speculative"))
             # The engine's deadline retirement (partial result, reason
             # "deadline") should win over this hard HTTP timeout, which
             # only fires when the engine cannot retire (e.g. hung) —
