@@ -453,7 +453,15 @@ def _ab_tracing(args, cfg, params):
     ``tracing_overhead_ratio`` near 1.0 demonstrates the off-by-default
     path is free, and the enabled ratio is the price of a full trace
     (bounds guarded by the perf-marked test in tests/test_obs.py:
-    <=2% disabled, <=5% enabled)."""
+    <=2% disabled, <=5% enabled).
+
+    Extended to the SPAN layer (ISSUE 12): a third leg runs with a
+    :class:`~horovod_tpu.obs.tracing.SpanRecorder` active under the
+    DEFAULT tail-sampling policy — steady-state clean traffic buffers
+    tick tuples and then tail-DROPS them at retirement (start/finish
+    records only hit the stream), which is the deployed configuration
+    — reporting ``span_tracing_overhead_ratio`` plus the
+    retained-vs-dropped trace counts."""
     import tempfile
 
     from horovod_tpu import serving
@@ -471,10 +479,20 @@ def _ab_tracing(args, cfg, params):
         os.close(fd)
         tracer = obs_tracing.start(own_path)
     obs_tracing.deactivate()
+    sfd, span_path = tempfile.mkstemp(prefix="hvd_span_ab_",
+                                      suffix=".jsonl")
+    os.close(sfd)
+    prev_spans = None
+    srec = None
 
     engines = {}
     try:
-        for name in ("notracing", "tracing"):
+        # Inside the try so a constructor failure (unwritable tmp,
+        # disk full) still restores the process's active recorder.
+        prev_spans = obs_tracing.deactivate_spans()
+        srec = obs_tracing.SpanRecorder(span_path, proc="bench",
+                                        role="replica")
+        for name in ("notracing", "tracing", "spans"):
             eng = serving.InferenceEngine(
                 params, cfg, serving.EngineConfig(
                     n_slots=S, max_len=cfg.max_seq,
@@ -488,6 +506,8 @@ def _ab_tracing(args, cfg, params):
         for _ in range(max(args.iters, 4)):
             for name, (eng, dts) in engines.items():
                 obs_tracing.activate(tracer if name == "tracing" else None)
+                obs_tracing.activate_spans(srec if name == "spans"
+                                           else None)
                 futs = [eng.submit(prompt, max_new_tokens=steps)
                         for _ in range(S)]
                 while not all(f.done() for f in futs):
@@ -498,8 +518,13 @@ def _ab_tracing(args, cfg, params):
                     if full and eng.slots.active_count == S:
                         dts.append(dt)
                 obs_tracing.deactivate()
+                obs_tracing.deactivate_spans()
     finally:
         obs_tracing.activate(tracer)
+        obs_tracing.activate_spans(prev_spans)
+        if srec is not None:
+            srec.close()
+        os.unlink(span_path)
         if own_path is not None:
             obs_tracing.stop()
             os.unlink(own_path)
@@ -509,7 +534,12 @@ def _ab_tracing(args, cfg, params):
     return {
         "decode_tok_s_tracing": round(S / q["tracing"], 2),
         "decode_tok_s_notracing": round(S / q["notracing"], 2),
+        "decode_tok_s_spans": round(S / q["spans"], 2),
         "tracing_overhead_ratio": round(q["tracing"] / q["notracing"], 4),
+        "span_tracing_overhead_ratio": round(
+            q["spans"] / q["notracing"], 4),
+        "span_traces_retained": srec.n_retained,
+        "span_traces_dropped": srec.n_dropped,
     }
 
 
@@ -904,6 +934,10 @@ def _engine_mode(args, T, cfg, params) -> None:
         print(f"tracing  {tab['decode_tok_s_tracing']:9.1f} tok/s traced "
               f"vs {tab['decode_tok_s_notracing']:9.1f} untraced -> "
               f"{tab['tracing_overhead_ratio']}x per-tick")
+        print(f"spans    {tab['decode_tok_s_spans']:9.1f} tok/s -> "
+              f"{tab['span_tracing_overhead_ratio']}x per-tick "
+              f"(tail sampling: {tab['span_traces_retained']} retained "
+              f"/ {tab['span_traces_dropped']} dropped)")
     if sab is not None:
         print(f"spec     K={sab['spec_k']} ({sab['spec_draft']}) "
               f"repetitive {sab['spec_decode_tok_s_repetitive']:9.1f} "

@@ -95,6 +95,7 @@ def replicated_demo(args, params, cfg) -> None:
     import signal as _signal
     import tempfile
 
+    from horovod_tpu import obs
     from horovod_tpu.serving.router import (
         ReplicaRegistry,
         ReplicaSpec,
@@ -114,13 +115,21 @@ def replicated_demo(args, params, cfg) -> None:
 
     registry = ReplicaRegistry(poll_interval=0.2, heartbeat_stale=15.0)
     journal_dir = tempfile.mkdtemp(prefix="serve_journal_")
+    # Span streams: every replica + the router append to span_dir, so
+    # GET /trace/<id> can autopsy the SIGKILL'd request afterwards
+    # (docs/observability.md "Distributed tracing").
+    span_dir = args.spans or tempfile.mkdtemp(prefix="serve_spans_")
+    obs.tracing.start_spans(
+        os.path.join(span_dir, "router.spans.jsonl"),
+        proc="router", role="router")
     sup = ReplicaSupervisor(
         ReplicaSpec(params_path=params_path, slots=args.slots,
                     warm=[8], tick_timeout=30.0, drain_timeout=10.0),
         args.replicas, registry=registry, unhealthy_grace=3.0,
-        journal_dir=journal_dir)
+        journal_dir=journal_dir, span_dir=span_dir)
     rt = RouterServer(registry, port=args.port,
-                      resume_lookup=sup.resume_lookup)
+                      resume_lookup=sup.resume_lookup,
+                      span_dir=span_dir)
     try:
         sup.start()
         rt.start()
@@ -189,6 +198,27 @@ def replicated_demo(args, params, cfg) -> None:
               f"failovers={stats['failovers']:.0f} "
               f"resumed={stats['resume_failovers']:.0f}")
 
+        # The autopsy: pick a request that rode the failover (resumed
+        # or multi-attempt) and print its cross-process span tree.
+        from horovod_tpu.obs.trace_store import TraceStore
+
+        autopsy_tid = None
+        for i, (prompt, resp, rep) in sorted(out.items()):
+            if resp.get("resumed"):
+                autopsy_tid = resp.get("trace_id")
+                break
+        if autopsy_tid is None and out:
+            autopsy_tid = next(iter(sorted(out.items())))[1][1] \
+                .get("trace_id")
+        if autopsy_tid:
+            tree = TraceStore.from_dir(span_dir).ascii_tree(autopsy_tid)
+            if tree:
+                print(f"\nautopsy (GET {base}/trace/{autopsy_tid}):")
+                print(tree)
+            print(f"span streams: {span_dir}  (explore with "
+                  f"python -m horovod_tpu.obs.trace --spans "
+                  f"{span_dir} --list)")
+
         deadline = time.monotonic() + 60
         while (len(registry.in_rotation()) < args.replicas
                and time.monotonic() < deadline):
@@ -209,6 +239,7 @@ def replicated_demo(args, params, cfg) -> None:
     finally:
         rt.stop()
         sup.stop(drain=True)
+        obs.tracing.stop_spans()
         os.unlink(params_path)
     print("stopped")
 
@@ -234,6 +265,12 @@ def main() -> None:
                     help="N > 1: serve through the replicated front "
                          "tier (router + supervisor) and SIGKILL one "
                          "replica mid-burst to demo zero-drop failover")
+    ap.add_argument("--spans", default="",
+                    help="(with --replicas) span-stream directory for "
+                         "distributed tracing — the killed request's "
+                         "cross-process autopsy prints after the "
+                         "burst and GET /trace/<id> serves it (a tmp "
+                         "dir is used when omitted)")
     args = ap.parse_args()
 
     import horovod_tpu as hvd

@@ -48,7 +48,26 @@ def _install() -> None:
 
     if not hasattr(jax, "shard_map"):
         try:
+            import inspect
+
             from jax.experimental.shard_map import shard_map
+
+            if "check_vma" not in inspect.signature(shard_map).parameters:
+                # Newer JAX renamed check_rep -> check_vma when
+                # shard_map was promoted to the top level; translate so
+                # callers can use the modern spelling on either.
+                import functools
+
+                _shard_map = shard_map
+
+                @functools.wraps(_shard_map)
+                def shard_map(*args, **kwargs):
+                    # wraps copies __wrapped__, so signature-based
+                    # capability sniffing (inspect.signature) still
+                    # sees the REAL parameter list, not (*args, **kw).
+                    if "check_vma" in kwargs:
+                        kwargs["check_rep"] = kwargs.pop("check_vma")
+                    return _shard_map(*args, **kwargs)
 
             jax.shard_map = shard_map
         except ImportError:  # pragma: no cover - shard_map predates 0.4

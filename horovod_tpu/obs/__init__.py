@@ -31,7 +31,14 @@ from __future__ import annotations
 import contextlib
 import time
 
-from horovod_tpu.obs import aggregate, fleet, registry, tracing, xprof  # noqa: F401
+from horovod_tpu.obs import (  # noqa: F401
+    aggregate,
+    fleet,
+    registry,
+    trace_store,
+    tracing,
+    xprof,
+)
 from horovod_tpu.obs.registry import (  # noqa: F401
     Counter,
     DuplicateMetricError,
@@ -42,20 +49,28 @@ from horovod_tpu.obs.registry import (  # noqa: F401
     elastic_metrics,
     training_metrics,
 )
+from horovod_tpu.obs.trace_store import TraceStore  # noqa: F401
 from horovod_tpu.obs.tracing import (  # noqa: F401
+    PARENT_SPAN_HEADER,
+    SAMPLED_HEADER,
     TRACE_ID_HEADER,
     RequestTrace,
+    SpanRecorder,
+    SpanSampling,
     Tracer,
+    mint_span_id,
     mint_trace_id,
     record_compile,
 )
 
 __all__ = [
-    "aggregate", "fleet", "registry", "tracing", "xprof",
+    "aggregate", "fleet", "registry", "trace_store", "tracing", "xprof",
     "Counter", "Gauge", "Histogram", "MetricsRegistry",
     "DuplicateMetricError", "default_registry",
     "training_metrics", "elastic_metrics",
-    "TRACE_ID_HEADER", "RequestTrace", "Tracer", "mint_trace_id",
+    "TRACE_ID_HEADER", "PARENT_SPAN_HEADER", "SAMPLED_HEADER",
+    "RequestTrace", "Tracer", "SpanRecorder", "SpanSampling",
+    "TraceStore", "mint_trace_id", "mint_span_id",
     "record_compile", "training_step",
 ]
 

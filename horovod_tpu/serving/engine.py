@@ -165,6 +165,7 @@ class GenerationFuture:
         # (engine, watchdog, or HTTP handler).
         self.trace: Optional["obs_tracing.RequestTrace"] = None
         self._tracer: Optional["obs_tracing.Tracer"] = None
+        self._spans: Optional["obs_tracing.SpanRecorder"] = None
         # Resolution hook (the engine wires the request's journal
         # purge here): fires exactly once, from whichever thread
         # resolves the future, AFTER the resolution is visible.
@@ -238,6 +239,14 @@ class GenerationFuture:
             try:
                 tp.request_done(tr)
             except Exception:  # pragma: no cover - tracing must not fail work
+                pass
+        sp = self._spans
+        if sp is not None and tr is not None:
+            # The span stream gets the finish record + the
+            # tail-sampling verdict on the buffered detail spans.
+            try:
+                sp.request_done(tr)
+            except Exception:  # pragma: no cover - spans must not fail work
                 pass
 
     # caller-side ----------------------------------------------------------
@@ -834,6 +843,8 @@ class InferenceEngine:
                deadline: Optional[float] = None,
                on_token: Optional[Callable] = None,
                trace_id: Optional[str] = None,
+               parent_span: Optional[str] = None,
+               sampled: bool = False,
                speculative: Optional[bool] = None) -> GenerationFuture:
         """Queue a generation request; returns its future.
 
@@ -848,6 +859,12 @@ class InferenceEngine:
         :class:`~horovod_tpu.obs.tracing.RequestTrace`; a fresh id is
         minted when absent, so :attr:`GenerationFuture.trace_id` and
         :meth:`GenerationFuture.breakdown` are always available.
+        ``parent_span`` nests this request's span under an upstream
+        caller's span (the router's proxy-attempt span, via
+        ``X-Parent-Span``), and ``sampled`` forces full-detail span
+        retention past tail sampling (``X-Trace-Sampled``) — both
+        no-ops unless a :func:`~horovod_tpu.obs.tracing.spans`
+        recorder is active.
 
         Typed rejections: :class:`RequestTooLongError` (prompt +
         max_new_tokens cannot fit a cache slot — raised immediately),
@@ -896,8 +913,11 @@ class InferenceEngine:
                 f"pages; the pool holds {self.slots.n_pages}")
         fut = GenerationFuture(on_token=on_token,
                                detokenize=self.detokenize)
-        fut.trace = obs_tracing.RequestTrace(trace_id)
+        fut.trace = obs_tracing.RequestTrace(trace_id,
+                                             parent_span_id=parent_span)
+        fut.trace.sampled = bool(sampled)
         fut._tracer = obs_tracing.get()
+        fut._spans = obs_tracing.spans()
         req = Request(prompt=prompt, max_new_tokens=n_new, future=fut,
                       eos_id=eos_id, deadline=deadline, trace=fut.trace,
                       speculative=speculative)
@@ -916,6 +936,19 @@ class InferenceEngine:
             if self.journal is not None:
                 self.journal.end(req.id)  # never enqueued: nothing to resume
             raise
+        if fut._spans is not None:
+            # Span START is written (and flushed) the moment the
+            # request is live: a SIGKILL after this instant leaves the
+            # start record + every typed event in the stream — the
+            # durable half of the autopsy.  (Submit-time rejections
+            # above never ran; they need no span.)
+            try:
+                fut._spans.request_begin(fut.trace, attrs={
+                    "prompt_tokens": len(prompt),
+                    "max_new_tokens": n_new,
+                    "request_id": req.id})
+            except Exception:  # pragma: no cover - spans must not fail work
+                pass
         # Post-enqueue re-checks close the submit-vs-shutdown races:
         # the pre-checks above can pass just before a terminal failure
         # drains the queue, or just before begin_drain() + drain()
@@ -1119,6 +1152,17 @@ class InferenceEngine:
             return False
         _, s = max(victims)
         st = self._states[s]
+        # The SUBMIT-TIME recorder handle (not the global): begin and
+        # finish went through fut._spans, so events must too — a
+        # recorder swapped mid-request (the A/B seam) must not orphan
+        # an event onto a stream that never saw the span start.
+        srec = st.request.future._spans
+        if srec is not None and st.request.trace is not None:
+            try:
+                srec.request_event(st.request.trace, "eviction",
+                                   {"slot": s, "reason": "out_of_pages"})
+            except Exception:  # pragma: no cover - spans must not fail
+                pass
         st.request.future.set_exception(CacheOutOfPagesError(
             "preempted: page pool exhausted mid-decode "
             "(older requests keep their pages)"))
@@ -1363,6 +1407,18 @@ class InferenceEngine:
             if rate < self.engine_cfg.spec_min_acceptance:
                 self._spec_live[s] = False
                 self._spec_idle[s] = 0
+                st = self._states[s]
+                # submit-time handle, same reason as _evict_for_pages
+                srec = st.request.future._spans if st is not None \
+                    else None
+                if (srec is not None and st is not None
+                        and st.request.trace is not None):
+                    try:
+                        srec.request_event(
+                            st.request.trace, "spec_fallback",
+                            {"slot": s, "acceptance": round(rate, 4)})
+                    except Exception:  # pragma: no cover
+                        pass
                 if self._spec_model:
                     # A disabled slot's draft POOL decays even during
                     # spec ticks (no pages are granted for it, so its
@@ -2036,6 +2092,12 @@ class InferenceEngine:
                 continue  # retired / re-admitted since dispatch: stale
             self.metrics.token_latency.observe(lat)
             tr = st.request.trace
+            # Per-request tick DETAIL is buffered only when the
+            # request's SUBMIT-TIME recorder is live (same handle its
+            # begin/finish go through — one attribute read per slot);
+            # whether the tuples ever leave the process is the
+            # tail-sampling verdict at resolution.
+            srec = st.request.future._spans
             if tr is not None:
                 tr.decode_ticks += 1
                 # dispatch-to-fetch latency of the tick that produced
@@ -2044,6 +2106,11 @@ class InferenceEngine:
                 tr.host_sync_lag = lat
             if acc is None:
                 self.metrics.tokens_per_tick.observe(1)
+                if srec is not None and tr is not None:
+                    if len(tr.ticks) < tr.MAX_TICKS:
+                        tr.ticks.append((p["dispatched_at"], t1, 1))
+                    else:
+                        tr.ticks_overflow += 1
                 if self._spec:
                     # A plain tick dispatched by the speculative
                     # engine (nobody speculating): pos advanced by
@@ -2073,6 +2140,20 @@ class InferenceEngine:
                 # model draft was already marked stale at disable —
                 # only the probe clock moves here.
                 self._spec_probe_clock(s)
+            # The tick-detail entry is appended BEFORE the emit loop —
+            # the final _emit may retire the request and synchronously
+            # run request_done, which writes tr.ticks — as a MUTABLE
+            # list whose count is bumped per emission, so it records
+            # the EMITTED count (EOS inside the accepted run truncates
+            # what the caller sees; the autopsy's tick detail must sum
+            # to the response, not to the device-committed acc+1).
+            tick_entry = None
+            if srec is not None and tr is not None:
+                if len(tr.ticks) < tr.MAX_TICKS:
+                    tick_entry = [p["dispatched_at"], t1, 0]
+                    tr.ticks.append(tick_entry)
+                else:
+                    tr.ticks_overflow += 1
             emitted = 0
             for jt in range(n):
                 if self._states[s] is not st:
@@ -2080,6 +2161,8 @@ class InferenceEngine:
                     # the accepted run: the greedy oracle would never
                     # emit the tail — drop it.
                     break
+                if tick_entry is not None:
+                    tick_entry[2] += 1
                 self._emit(s, int(nxt[s, jt]))
                 emitted += 1
             self.metrics.tokens_per_tick.observe(emitted)
@@ -2120,6 +2203,18 @@ class InferenceEngine:
         pending = [st.request for st in self._states if st is not None]
         pending += list(self._taken)
         for req in pending:
+            # The typed engine_restart edge on every interrupted
+            # request's span, BEFORE its resolution/suspension is
+            # decided — this is the restart path specifically, so
+            # terminate()/drain force-resolves (plain _fail_inflight)
+            # never mislabel themselves as restarts.
+            srec = req.future._spans
+            if srec is not None and req.trace is not None:
+                try:
+                    srec.request_event(req.trace, "engine_restart",
+                                       {"epoch": self._epoch})
+                except Exception:  # pragma: no cover
+                    pass
             r = self._resume_or_fail(req, exc)
             if r is not None:
                 resumed.append(r)
@@ -2286,6 +2381,20 @@ class InferenceEngine:
                         self.metrics.resume_wasted_tokens.inc(wasted)
                     if self.journal is not None:
                         self.journal.note_resume(req.id)
+                    # submit-time handle (begin/finish used it too)
+                    srec = req.future._spans
+                    if srec is not None and req.trace is not None:
+                        # The typed resume edge on the request's own
+                        # span: a postmortem sees WHICH requests the
+                        # restart interrupted and what the re-prefill
+                        # cost, not just the engine-wide instant.
+                        try:
+                            srec.request_event(
+                                req.trace, "resume",
+                                {"epoch": self._epoch,
+                                 "wasted_tokens": wasted})
+                        except Exception:  # pragma: no cover
+                            pass
                 obs_tracing.instant("requests_resumed", {
                     "count": len(resumed), "epoch": self._epoch})
                 self.metrics.queue_depth.set(self.scheduler.depth)

@@ -78,6 +78,11 @@ class JournalEntry:
     deadline: Optional[float] = None
     expires_at: Optional[float] = None
     trace_id: Optional[str] = None
+    #: the originating request SPAN id (obs/tracing.py): a post-mortem
+    #: journal lookup after a SIGKILL hands it to the router, which
+    #: stamps it on the resume edge — the resumed attempt links into
+    #: the same cross-process trace tree as the dead one.
+    span_id: Optional[str] = None
     emitted: List[int] = dataclasses.field(default_factory=list)
     resumes: int = 0
 
@@ -98,6 +103,7 @@ class JournalEntry:
         return {
             "emitted_tokens": list(self.emitted),
             "deadline_remaining_ms": remaining_ms,
+            "span_id": self.span_id,
         }
 
 
@@ -140,10 +146,12 @@ class RequestJournal:
             id=req.id, prompt=tuple(req.prompt),
             max_new_tokens=req.max_new_tokens, eos_id=req.eos_id,
             deadline=req.deadline, expires_at=expires,
-            trace_id=req.trace.trace_id if req.trace is not None else None)
+            trace_id=req.trace.trace_id if req.trace is not None else None,
+            span_id=req.trace.span_id if req.trace is not None else None)
         with self._lock:
             self._entries[req.id] = entry
             self._write({"e": "b", "id": entry.id, "trace": entry.trace_id,
+                         "span": entry.span_id,
                          "prompt": list(entry.prompt),
                          "max_new": entry.max_new_tokens,
                          "eos": entry.eos_id,
@@ -226,6 +234,7 @@ class RequestJournal:
                 for entry in self._entries.values():
                     f.write(json.dumps(
                         {"e": "b", "id": entry.id, "trace": entry.trace_id,
+                         "span": entry.span_id,
                          "prompt": list(entry.prompt),
                          "max_new": entry.max_new_tokens,
                          "eos": entry.eos_id,
@@ -274,7 +283,8 @@ class RequestJournal:
                     max_new_tokens=int(ev.get("max_new") or 0),
                     eos_id=ev.get("eos"),
                     expires_at=ev.get("expires_at"),
-                    trace_id=ev.get("trace"))
+                    trace_id=ev.get("trace"),
+                    span_id=ev.get("span"))
             elif e == "t" and rid in live:
                 live[rid].emitted.append(int(ev["t"]))
             elif e == "r" and rid in live:

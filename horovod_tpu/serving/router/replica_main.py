@@ -132,6 +132,19 @@ def main(argv=None) -> int:
                     help="request-journal JSONL path (survives SIGKILL; "
                          "the router reads it post-mortem to resume "
                          "this replica's in-flight requests elsewhere)")
+    ap.add_argument("--spans", default="",
+                    help="span-stream JSONL path (distributed tracing; "
+                         "the process label is the filename stem, e.g. "
+                         "r0g1.spans.jsonl -> r0g1).  Flushed per "
+                         "record, so a SIGKILL leaves the started "
+                         "spans for the router's /trace autopsy")
+    ap.add_argument("--span-latency-threshold", type=float, default=1.0,
+                    help="tail-sampling latency threshold in seconds: "
+                         "requests slower than this keep full tick-"
+                         "level span detail")
+    ap.add_argument("--span-head-rate", type=float, default=0.0,
+                    help="deterministic head-sampling rate [0,1] for "
+                         "full span detail on otherwise-boring requests")
     ap.add_argument("--no-resume", action="store_true",
                     help="disable in-engine restart-resume (in-flight "
                          "requests fail typed on a supervised restart, "
@@ -149,6 +162,17 @@ def main(argv=None) -> int:
     from horovod_tpu.serving.router.supervisor import (
         EXIT_CODE_REPLICA_FAILED,
     )
+
+    if args.spans:
+        from horovod_tpu.obs import tracing as obs_tracing
+
+        stem = os.path.basename(args.spans).split(".")[0]
+        obs_tracing.start_spans(
+            args.spans, proc=stem or f"pid{os.getpid()}",
+            role="replica",
+            sampling=obs_tracing.SpanSampling(
+                latency_threshold_s=args.span_latency_threshold,
+                head_rate=args.span_head_rate))
 
     if args.params:
         params, cfg = load_model(args.params)
@@ -203,6 +227,10 @@ def main(argv=None) -> int:
         stop_requested.wait(0.2)
 
     srv.stop(drain_timeout=args.drain_timeout)
+    if args.spans:
+        from horovod_tpu.obs import tracing as obs_tracing
+
+        obs_tracing.stop_spans()
     print(f"replica on port {port} stopped "
           f"(engine state: {engine.health})", flush=True)
     return EXIT_CODE_REPLICA_FAILED if failed else 0

@@ -47,6 +47,23 @@ Endpoints:
 * ``GET /stats`` — the router metrics snapshot plus every replica's
   last polled status.
 * ``GET /metrics`` — the ``router_*`` families as Prometheus text.
+* ``GET /trace/<id>`` — the full request AUTOPSY: the cross-process
+  span tree for one trace id, assembled from the spans directory
+  (``span_dir``) every process of this deployment appends to — every
+  attempt on every replica generation (a SIGKILL'd attempt shows as an
+  UNFINISHED span), the failover / resume / retry edges, and the
+  carried-token accounting.  404 for an unknown id, 503 when no
+  ``span_dir`` was configured.
+
+Distributed tracing (docs/observability.md): when a span recorder is
+active in the router process (``obs.tracing.start_spans``), each
+request gets a ``router /generate`` root span with one child span per
+proxy attempt; the attempt's span id rides the ``X-Parent-Span``
+header to the replica, whose request span nests under it — the
+collector then assembles ONE tree across processes.  Failover /
+resume re-dispatches also carry ``X-Trace-Sampled: 1``: the
+downstream share of an interesting trace must not be tail-dropped by
+a replica that saw nothing unusual.
 """
 
 from __future__ import annotations
@@ -86,6 +103,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
 
     def _json(self, code: int, payload: dict,
               headers: Optional[Dict[str, str]] = None) -> None:
+        self._sent_code = code  # the root span's status (do_POST)
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
@@ -120,6 +138,32 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path.startswith("/trace/"):
+            tid = self.path[len("/trace/"):]
+            if not obs_tracing.valid_trace_id(tid):
+                self._json(400, {"error": "bad trace id",
+                                 "type": "bad_trace_id"})
+            elif router.span_dir is None:
+                self._json(503, {
+                    "error": "no span_dir configured on this router "
+                             "(RouterServer(span_dir=...))",
+                    "type": "no_span_store"})
+            else:
+                try:
+                    autopsy = router.autopsy(tid)
+                except Exception as e:
+                    # A broken store must read as a broken STORE, not
+                    # as "trace never recorded" — a 404 here would
+                    # misdirect an operator mid-postmortem.
+                    self._json(500, {
+                        "error": f"span store unreadable: {e!r}",
+                        "type": "span_store_error"})
+                    return
+                if autopsy is None:
+                    self._json(404, {"error": f"trace {tid} not found",
+                                     "type": "unknown_trace"})
+                else:
+                    self._json(200, autopsy)
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
 
@@ -127,7 +171,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
 
     def _proxy_once(self, status_ep, body: bytes,
                     trace_id: Optional[str],
-                    timeout: float) -> Tuple[int, bytes, Dict[str, str]]:
+                    timeout: float,
+                    parent_span: Optional[str] = None,
+                    force_sample: bool = False
+                    ) -> Tuple[int, bytes, Dict[str, str]]:
         """One attempt against one replica.  Raises :class:`_ProxyError`
         on connection-level failure (retry-safe); returns the replica's
         full response otherwise."""
@@ -138,6 +185,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
             headers = {"Content-Type": "application/json"}
             if trace_id:
                 headers[obs_tracing.TRACE_ID_HEADER] = trace_id
+                if parent_span:
+                    headers[obs_tracing.PARENT_SPAN_HEADER] = parent_span
+                if force_sample:
+                    headers[obs_tracing.SAMPLED_HEADER] = "1"
             conn.request("POST", "/generate", body=body, headers=headers)
             resp = conn.getresponse()
             payload = resp.read()
@@ -167,10 +218,40 @@ class _RouterHandler(BaseHTTPRequestHandler):
         if self.path != "/generate":
             self._json(404, {"error": f"unknown path {self.path}"})
             return
-        hdr = self.headers.get(obs_tracing.TRACE_ID_HEADER)
-        trace_id = hdr if obs_tracing.valid_trace_id(hdr) \
-            else obs_tracing.mint_trace_id()
+        # The shared ingress trust rule (obs/tracing.py — identical at
+        # replica ingress, so the two fronts cannot drift): a client's
+        # X-Parent-Span nests the root span, and X-Trace-Sampled
+        # force-samples every dispatch of this request — both honored
+        # only alongside a valid X-Trace-Id.
+        trace_id, client_parent, client_sampled = \
+            obs_tracing.propagation_from_headers(self.headers)
         metrics.requests.inc()
+
+        # Distributed-tracing root span (module docstring): one
+        # "router /generate" span per request, one child per proxy
+        # attempt, typed events for every failover hop.  rec is None
+        # unless obs.tracing.start_spans ran in this process — every
+        # site below is a no-op then.
+        rec = obs_tracing.spans()
+        root_sid = None
+        if rec is not None:
+            root_sid = rec.begin("router /generate", trace_id,
+                                 parent=client_parent)
+        self._sent_code = 0
+        self._root_attrs: Dict = {}
+        try:
+            self._generate(router, registry, metrics, body, trace_id,
+                           rec, root_sid, client_sampled, client_parent)
+        finally:
+            if rec is not None and root_sid is not None:
+                rec.finish(root_sid,
+                           status=f"http:{self._sent_code}"
+                           if self._sent_code else "error:unsent",
+                           attrs=self._root_attrs)
+
+    def _generate(self, router, registry, metrics, body, trace_id,
+                  rec, root_sid, client_sampled=False,
+                  client_parent=None):
 
         # Resume-aware failover state (docs/serving.md "Front tier").
         # A failed attempt may yield a RESUME DESCRIPTOR — from the
@@ -192,6 +273,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
         carried: list = []
         remaining_ms: Optional[float] = None
         absorbed_at: float = 0.0
+        carried_from: Optional[str] = None   # latest dead attempt's span
 
         def current_remaining_ms() -> Optional[float]:
             # Time the ROUTER spends between attempts (backoff, further
@@ -217,14 +299,31 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 obj["timeout_ms"] = max(1.0, rem)
             return json.dumps(obj).encode()
 
-        def absorb(desc) -> None:
+        def absorb(desc, rid: Optional[str] = None,
+                   source: Optional[str] = None) -> None:
             """Fold one attempt's resume descriptor into the carry."""
-            nonlocal remaining_ms, absorbed_at
+            nonlocal remaining_ms, absorbed_at, carried_from
             if not resumable or not isinstance(desc, dict):
                 return
+            if desc.get("span_id"):
+                carried_from = desc["span_id"]
             toks = desc.get("emitted_tokens")
             if isinstance(toks, list):
                 carried.extend(int(t) for t in toks)
+                if rec is not None and toks:
+                    # The RESUME edge, with the carried-token
+                    # accounting and (when the journal/descriptor knew
+                    # it) the dead attempt's span id — the autopsy
+                    # links the continuation to the attempt it
+                    # continues.
+                    attrs = {"carried": len(toks)}
+                    if rid:
+                        attrs["from_replica"] = rid
+                    if source:
+                        attrs["source"] = source
+                    if desc.get("span_id"):
+                        attrs["resumed_from_span"] = desc["span_id"]
+                    rec.event(trace_id, root_sid, "resume", attrs)
             rem = desc.get("deadline_remaining_ms")
             if rem is not None:
                 remaining_ms = float(rem)
@@ -261,8 +360,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
             }, headers={obs_tracing.TRACE_ID_HEADER: trace_id,
                         "X-Router-Attempts": str(attempts)})
 
+        def track_root() -> None:
+            self._root_attrs.update({
+                "attempts": attempts,
+                "carried_tokens": len(carried),
+                "resumed": bool(carried)})
+
         tried = set()
         attempts = 0
+        failed_over = False
         last: Optional[Tuple[int, bytes, Dict[str, str]]] = None
         while attempts < router.max_attempts:
             rep = registry.pick(exclude=tried)
@@ -275,15 +381,40 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 break
             if attempts:
                 metrics.retries.inc()
+                if rec is not None:
+                    rec.event(trace_id, root_sid, "retry",
+                              {"attempt": attempts + 1,
+                               "replica": rep.endpoint.rid})
                 time.sleep(min(
                     router.retry_backoff * (2.0 ** (attempts - 1)),
                     router.retry_backoff_max))
             attempts += 1
             tried.add(rep.endpoint.rid)
+            track_root()
+            att_sid = None
+            if rec is not None:
+                att_sid = rec.begin(
+                    f"attempt {attempts} -> {rep.endpoint.rid}",
+                    trace_id, parent=root_sid,
+                    attrs={"replica": rep.endpoint.rid,
+                           **({"carried_tokens": len(carried)}
+                              if carried else {})})
             t0 = time.monotonic()
             try:
                 status, payload, hdrs = self._proxy_once(
-                    rep, dispatch_body(), trace_id, router.proxy_timeout)
+                    rep, dispatch_body(), trace_id, router.proxy_timeout,
+                    # The attempt span is the replica-side request
+                    # span's parent; with no router recorder the
+                    # client's own (validated) parent is forwarded
+                    # instead, so a replicas-only span deployment
+                    # still joins the upstream caller's tree.
+                    # Failover/resume continuations are force-sampled
+                    # end to end (module docstring) — NOT routine
+                    # 429/capacity retries, which would re-introduce
+                    # per-token span volume exactly at peak load.
+                    parent_span=att_sid or client_parent,
+                    force_sample=(client_sampled or bool(carried)
+                                  or failed_over))
             except _ProxyError:
                 metrics.proxy_latency.observe(time.monotonic() - t0)
                 # Connection-level death: evict NOW (the poll thread
@@ -293,7 +424,15 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 # armed one) tells us how far decode got, so the retry
                 # RESUMES rather than re-executing.
                 registry.mark_failed(rep.endpoint.rid)
-                absorb(router.lookup_resume(rep.endpoint, trace_id))
+                failed_over = True
+                if rec is not None:
+                    rec.finish(att_sid, status="error:connection")
+                    rec.event(trace_id, root_sid, "failover",
+                              {"replica": rep.endpoint.rid,
+                               "attempt": attempts})
+                absorb(router.lookup_resume(rep.endpoint, trace_id),
+                       rid=rep.endpoint.rid, source="journal")
+                track_root()
                 reason = carry_complete()
                 if reason is not None:
                     finish_from_carry(reason, attempts)
@@ -304,12 +443,16 @@ class _RouterHandler(BaseHTTPRequestHandler):
             metrics.proxy_latency.observe(time.monotonic() - t0)
             if status in RETRYABLE_STATUS:
                 last = (status, payload, hdrs)
+                if rec is not None:
+                    rec.finish(att_sid, status=f"http:{status}")
                 # A typed engine-failure response carries the resume
                 # descriptor inline — absorb it before trying elsewhere.
                 try:
-                    absorb(json.loads(payload).get("resume"))
+                    absorb(json.loads(payload).get("resume"),
+                           rid=rep.endpoint.rid, source="descriptor")
                 except (json.JSONDecodeError, AttributeError):
                     pass
+                track_root()
                 reason = carry_complete()
                 if reason is not None:
                     finish_from_carry(reason, attempts)
@@ -317,6 +460,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 if deadline_expired():
                     break
                 continue
+            if rec is not None:
+                rec.finish(att_sid, status=f"http:{status}")
             if attempts > 1 and status == 200:
                 # Only a SUCCESS bought by a retry counts as a
                 # failover save (the documented meaning of the family).
@@ -329,6 +474,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self._relay(status, payload, hdrs)
             return
 
+        track_root()
         if deadline_expired():
             # The deadline lapsed MID-FAILOVER: same typed 504 the
             # replicas use for a queued-deadline lapse, with whatever
@@ -360,6 +506,11 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     obj["resume"] = {
                         "emitted_tokens": list(carried),
                         "deadline_remaining_ms": current_remaining_ms(),
+                        # the latest dead attempt's span id survives
+                        # the rewrite: an upstream caller that resumes
+                        # from this descriptor keeps the causal edge
+                        # into ITS trace tree (stacked front tiers)
+                        "span_id": carried_from,
                     }
                     payload = json.dumps(obj).encode()
                 except (json.JSONDecodeError, AttributeError):
@@ -399,6 +550,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
 
     def _relay(self, status: int, payload: bytes,
                headers: Dict[str, str]) -> None:
+        self._sent_code = status  # the root span's status (do_POST)
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         for k, v in headers.items():
@@ -430,6 +582,13 @@ class RouterServer:
     replica's journal file, surviving the reap).  When None, the
     router falls back to the endpoint's advertised ``journal_path``
     (still registered until the supervisor reaps it).
+
+    ``span_dir`` arms ``GET /trace/<id>``: the spans directory every
+    process of this deployment appends its span stream to
+    (``ReplicaSupervisor(span_dir=...)`` for the replicas, plus the
+    router's own ``obs.tracing.start_spans(<span_dir>/router...)``);
+    each autopsy re-reads the streams — cold by design, this is a
+    postmortem endpoint, not a hot path.
     """
 
     def __init__(self, registry: ReplicaRegistry, *,
@@ -440,10 +599,12 @@ class RouterServer:
                  proxy_timeout: float = 150.0,
                  retry_after: int = 1,
                  resume_lookup=None,
+                 span_dir: Optional[str] = None,
                  own_registry_thread: bool = True) -> None:
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         self.resume_lookup = resume_lookup
+        self.span_dir = span_dir
         self.registry = registry
         self.host = host
         self.port = port
@@ -477,6 +638,27 @@ class RouterServer:
         except Exception:  # pragma: no cover - post-mortem best effort
             return None
         return None
+
+    def autopsy(self, trace_id: str) -> Optional[Dict]:
+        """Assemble the cross-process span tree for ``trace_id`` from
+        ``span_dir``; None when the id is unknown or no span_dir is
+        configured.  Collector failures PROPAGATE (the HTTP handler
+        maps them to a typed 500 ``span_store_error``) — malformed
+        individual records/files are already skipped inside
+        :class:`~horovod_tpu.obs.trace_store.TraceStore`, so an
+        exception here means the store itself is broken and must not
+        masquerade as a missing trace."""
+        if self.span_dir is None:
+            return None
+        from horovod_tpu.obs.trace_store import TraceStore
+
+        store = TraceStore.from_dir(self.span_dir)
+        if not store.n_readable:
+            # Wrong/moved directory or every stream unreadable: "store
+            # is broken", not "trace never recorded" — surface the 500.
+            raise FileNotFoundError(
+                f"no readable span streams under {self.span_dir}")
+        return store.autopsy(trace_id)
 
     def stats(self) -> Dict:
         return {

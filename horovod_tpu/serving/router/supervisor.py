@@ -167,7 +167,8 @@ class ReplicaSupervisor:
                  startup_timeout: float = 300.0,
                  monitor_interval: float = 0.1,
                  log_dir: Optional[str] = None,
-                 journal_dir: Optional[str] = None) -> None:
+                 journal_dir: Optional[str] = None,
+                 span_dir: Optional[str] = None) -> None:
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         self._spec = spec
@@ -192,6 +193,15 @@ class ReplicaSupervisor:
         # elsewhere (RouterServer(resume_lookup=sup.resume_lookup)).
         self._journal_dir = journal_dir
         self._journal_paths: Dict[str, str] = {}
+        # Span streams (docs/observability.md "Distributed tracing"):
+        # each replica generation appends spans to
+        # span_dir/<rid>.spans.jsonl; the directory is what
+        # RouterServer(span_dir=...) assembles GET /trace/<id> from —
+        # a SIGKILL'd generation's stream is exactly the evidence the
+        # autopsy needs, so files survive the reap (pruned past gen-1
+        # like journals, bounding crash loops).
+        self._span_dir = span_dir
+        self._span_paths: Dict[str, str] = {}
         self._lock = threading.Lock()
         self._handles: Dict[int, ReplicaHandle] = {}   # slot -> handle
         self._respawn_at: Dict[int, float] = {}        # slot -> monotonic
@@ -263,16 +273,19 @@ class ReplicaSupervisor:
     # -- spawn / reap ------------------------------------------------------
 
     def _command(self, slot: int, port: int,
-                 journal_path: Optional[str] = None) -> List[str]:
+                 journal_path: Optional[str] = None,
+                 span_path: Optional[str] = None) -> List[str]:
         if callable(self._spec):
             # Custom commands own their bind address; the registry
             # still polls self._host, so the callable must agree.
-            # (Journaling is replica_main plumbing — custom programs
-            # arm their own.)
+            # (Journaling/span streams are replica_main plumbing —
+            # custom programs arm their own.)
             return list(self._spec(slot, port))
         cmd = self._spec.command(port, self._host)
         if journal_path:
             cmd += ["--journal", journal_path]
+        if span_path:
+            cmd += ["--spans", span_path]
         return cmd
 
     def resume_lookup(self, rid: str, trace_id: str) -> Optional[Dict]:
@@ -291,6 +304,29 @@ class ReplicaSupervisor:
         except Exception:  # pragma: no cover - post-mortem best effort
             return None
 
+    def _arm_gen_file(self, base_dir: Optional[str], paths: Dict[str, str],
+                      slot: int, gen: int, suffix: str) -> Optional[str]:
+        """One per-generation artifact file (journal or span stream):
+        create its path under ``base_dir``, record it in ``paths``
+        (the mapping OUTLIVES the process so post-mortem readers keep
+        working after the reap), and prune this slot's generations
+        older than gen-1 — the previous generation is live evidence
+        the router may be reading right now, anything older is
+        bounded away so a crash loop cannot grow the directory."""
+        if not base_dir or callable(self._spec):
+            return None
+        os.makedirs(base_dir, exist_ok=True)
+        path = os.path.join(base_dir, f"r{slot}g{gen}.{suffix}")
+        paths[f"r{slot}g{gen}"] = path
+        for g in range(gen - 1):
+            old = paths.pop(f"r{slot}g{g}", None)
+            if old:
+                try:
+                    os.remove(old)
+                except OSError:
+                    pass
+        return path
+
     def _spawn(self, slot: int) -> None:
         gen = self._gen.get(slot, -1) + 1
         self._gen[slot] = gen
@@ -307,30 +343,18 @@ class ReplicaSupervisor:
                              if env.get("PYTHONPATH") else pkg_root)
         prev = self._handles.get(slot)
         restarts = prev.restarts + 1 if prev is not None else 0
-        journal_path = None
-        if self._journal_dir and not callable(self._spec):
-            os.makedirs(self._journal_dir, exist_ok=True)
-            journal_path = os.path.join(self._journal_dir,
-                                        f"r{slot}g{gen}.journal.jsonl")
-            self._journal_paths[f"r{slot}g{gen}"] = journal_path
-            # Prune this slot's older generations (keep gen-1: the
-            # router may still be failing its requests over right
-            # now) — a crash-looping replica must not grow the dict
-            # and the directory without bound.
-            for g in range(gen - 1):
-                old = self._journal_paths.pop(f"r{slot}g{g}", None)
-                if old:
-                    try:
-                        os.remove(old)
-                    except OSError:
-                        pass
+        journal_path = self._arm_gen_file(
+            self._journal_dir, self._journal_paths, slot, gen,
+            "journal.jsonl")
+        span_path = self._arm_gen_file(
+            self._span_dir, self._span_paths, slot, gen, "spans.jsonl")
         out = subprocess.DEVNULL
         if self._log_dir:
             os.makedirs(self._log_dir, exist_ok=True)
             out = open(os.path.join(self._log_dir,
                                     f"r{slot}g{gen}.log"), "wb")
         proc = subprocess.Popen(
-            self._command(slot, port, journal_path), env=env,
+            self._command(slot, port, journal_path, span_path), env=env,
             stdout=out, stderr=subprocess.STDOUT if self._log_dir
             else subprocess.DEVNULL,
             start_new_session=True)

@@ -135,9 +135,14 @@ class _Handler(BaseHTTPRequestHandler):
         # otherwise — so EVERY response carries the id, including the
         # malformed-input 400s below; a trace that dead-ends exactly on
         # bad input is no trace at all.
-        hdr = self.headers.get(obs_tracing.TRACE_ID_HEADER)
-        trace_id = hdr if obs_tracing.valid_trace_id(hdr) \
-            else obs_tracing.mint_trace_id()
+        # The ONE ingress trust rule (shared with the router so the
+        # two fronts cannot drift): X-Parent-Span and X-Trace-Sampled
+        # are honored only alongside a valid propagated X-Trace-Id —
+        # a parent span on a freshly minted trace would be a dangling
+        # (or spoofed) edge, and malformed/oversized span ids are
+        # dropped, never echoed into span streams.
+        trace_id, parent_span, sampled = \
+            obs_tracing.propagation_from_headers(self.headers)
         # Read the body, even on error paths: HTTP/1.1 keep-alive
         # reuses the connection, and unread body bytes would be parsed
         # as the next request line.
@@ -199,6 +204,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "emitted_tokens": fut.tokens_so_far(),
                 "deadline_remaining_ms": max(0.0, round(
                     (deadline - time.monotonic()) * 1e3, 3)),
+                # the failed attempt's span id: a resumed re-dispatch
+                # links back to it in the cross-process trace tree
+                "span_id": fut.trace.span_id
+                if fut.trace is not None else None,
             }
 
         timeout_ms = req.get("timeout_ms")
@@ -217,6 +226,8 @@ class _Handler(BaseHTTPRequestHandler):
                 eos_id=req.get("eos_id"),
                 deadline=deadline,
                 trace_id=trace_id,
+                parent_span=parent_span,
+                sampled=sampled,
                 # Per-request speculative opt-out ("speculative":
                 # false pins the request to one-token-per-tick greedy
                 # inside the same executable; output is identical).

@@ -826,6 +826,35 @@ class TestJournalDurability:
         assert serving.RequestJournal.read_live(jp) == {}
         assert len(engine.journal) == 0
 
+    def test_journal_links_resume_into_the_originating_span(
+            self, model, tmp_path):
+        """SATELLITE (ISSUE 12): journal entries carry the originating
+        SPAN id, so a post-mortem lookup after a SIGKILL hands the
+        router the dead attempt's span — the resumed attempt links
+        into the SAME trace tree instead of starting an orphan.  The
+        id must survive the full round trip: begin record, compaction
+        rewrite, and the read_live descriptor."""
+        jp = str(tmp_path / "req.journal.jsonl")
+        engine = _engine(model, journal_path=jp)
+        fut = engine.submit([3, 4, 5], max_new_tokens=12,
+                            trace_id="tr-span")
+        for _ in range(300):
+            if len(fut.tokens_so_far()) >= 2:
+                break
+            engine.step()
+        span_id = fut.trace.span_id
+        assert span_id  # minted at submit, with or without a recorder
+        live = serving.RequestJournal.read_live(jp)
+        assert live["tr-span"]["span_id"] == span_id
+        # compaction preserves it (the rewrite path re-serializes)
+        engine.journal._dead_lines = engine.journal.COMPACT_AFTER
+        engine.journal.end(-1)  # no-op purge, but triggers nothing
+        with engine.journal._lock:
+            engine.journal._compact_locked()
+        live = serving.RequestJournal.read_live(jp)
+        assert live["tr-span"]["span_id"] == span_id
+        _run_until_done(engine, [fut])
+
     def test_torn_final_line_tolerated(self, model, tmp_path):
         """A SIGKILL can land mid-write: every complete line before
         the torn one still parses."""

@@ -637,12 +637,18 @@ class TestMetricsNamingLint:
 
     NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 
-    def test_every_family_named_and_documented(self, hvd):
+    def test_every_family_named_and_documented(self, hvd, tmp_path):
         # force the lazily-registered introspection gauges into being
         c = jax.jit(lambda x: x * 2).lower(jnp.ones((8,))).compile()
         xprof.introspect(c, fn="lint")
         R.default_registry().gauge(
             "xla_hbm_peak_bytes", "", labels=("fn",), exist_ok=True)
+        # ... and the distributed-tracing trace_* families (registered
+        # lazily by the first SpanRecorder this process opens)
+        from horovod_tpu.obs import tracing as TR
+
+        TR.SpanRecorder(str(tmp_path / "lint.spans.jsonl"),
+                        proc="lint").close()
         registries = {
             "default": R.default_registry(),
             "serving": serving.ServingMetrics().registry,

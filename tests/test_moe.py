@@ -157,8 +157,12 @@ class TestSwitchDispatchLocal:
         D, F, T = 64, 128, 256
 
         def flops(fn, *args):
+            # _cost_dict normalizes the list-wrapped cost_analysis()
+            # shape older jax returns — the ONE copy of that rule
+            from horovod_tpu.obs.xprof import _cost_dict
+
             c = jax.jit(fn).lower(*args).compile()
-            return c.cost_analysis()["flops"]
+            return _cost_dict(c)["flops"]
 
         def sparse(E):
             p = _params(jax.random.PRNGKey(0), E, D, F)
@@ -232,7 +236,9 @@ class TestDroplessMoE:
         x = jax.random.normal(jax.random.PRNGKey(1), (T, D))
 
         def flops(fn):
-            return jax.jit(fn).lower(x).compile().cost_analysis()["flops"]
+            from horovod_tpu.obs.xprof import _cost_dict
+
+            return _cost_dict(jax.jit(fn).lower(x).compile())["flops"]
 
         fd = flops(lambda x: _dense_oracle(x, p))
         fl = flops(lambda x: moe.dropless_moe(

@@ -260,7 +260,8 @@ class TestTracing:
         tr.finish = "length"
         b = tr.breakdown()
         assert b == {
-            "trace_id": "tid1", "queue_wait_s": 0.5, "prefill_s": 0.5,
+            "trace_id": "tid1", "span_id": tr.span_id,
+            "queue_wait_s": 0.5, "prefill_s": 0.5,
             "decode_s": 2.0, "decode_ticks": 7, "tokens": 8,
             "host_sync_lag_s": 0.002, "total_s": 3.0, "finish": "length",
         }

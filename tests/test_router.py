@@ -64,6 +64,8 @@ class _FakeReplica:
         self.mode = "ok"
         self.hang_s = 10.0
         self.seen_trace_ids = []
+        self.seen_parent_spans = []
+        self.seen_sampled = []
         self.seen_bodies = []
         self.generate_hits = 0
         self.reply_tokens = [1, 2, 3]
@@ -102,6 +104,10 @@ class _FakeReplica:
                 fake.generate_hits += 1
                 fake.seen_trace_ids.append(
                     self.headers.get("X-Trace-Id"))
+                fake.seen_parent_spans.append(
+                    self.headers.get("X-Parent-Span"))
+                fake.seen_sampled.append(
+                    self.headers.get("X-Trace-Sampled"))
                 if fake.mode == "drop":
                     # Die mid-request, SIGKILL-style: no status line,
                     # no body, just a dead socket.
@@ -585,6 +591,209 @@ class TestResumeFailover:
 
 
 # ---------------------------------------------------------------------------
+# distributed tracing at the router: span parentage, force-sampling,
+# header validation at ROUTER ingress, and the /trace/<id> autopsy
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.tracing
+class TestRouterSpans:
+    def _front(self, tmp_path, span_dir=True, **rt_kw):
+        from horovod_tpu.obs import tracing as TR
+
+        assert TR.spans() is None
+        rec = TR.start_spans(
+            str(tmp_path / "router.spans.jsonl"), proc="router",
+            role="router",
+            sampling=TR.SpanSampling(latency_threshold_s=600.0))
+        fakes = {"a": _FakeReplica("a", queue_depth=0),
+                 "b": _FakeReplica("b", queue_depth=5)}
+        reg = _registry(*fakes.values())
+        rt_kw.setdefault("max_attempts", 3)
+        rt_kw.setdefault("retry_backoff", 0.01)
+        rt_kw.setdefault("proxy_timeout", 2.0)
+        if span_dir:
+            rt_kw.setdefault("span_dir", str(tmp_path))
+        rt = RouterServer(reg, port=0, own_registry_thread=False,
+                          **rt_kw).start()
+        host, port = rt.address
+        return f"http://{host}:{port}", fakes, reg, rt, rec
+
+    def _teardown(self, fakes, rt):
+        from horovod_tpu.obs import tracing as TR
+
+        rt.stop()
+        for f in fakes.values():
+            f.stop()
+        TR.stop_spans()
+
+    def _get(self, url):
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_failover_builds_one_tree_with_attempt_parentage(
+            self, tmp_path):
+        """A 503-resume failover: the router's stream carries root +
+        two attempt spans, each dispatch carries ITS attempt span id in
+        X-Parent-Span, the continuation is force-sampled
+        (X-Trace-Sampled), and GET /trace/<id> assembles the tree with
+        the resume edge and carried-token accounting."""
+        base, fakes, reg, rt, rec = self._front(tmp_path)
+        try:
+            fakes["a"].mode = "503resume"
+            fakes["a"].resume_desc = {"emitted_tokens": [7, 8],
+                                      "deadline_remaining_ms": 5000.0,
+                                      "span_id": "deadbeefdeadbeef"}
+            fakes["b"].reply_tokens = [9, 11]
+            code, resp, hdrs = _post(
+                base, {"tokens": [1, 2], "max_new_tokens": 4,
+                       "timeout_ms": 60000})
+            assert code == 200 and resp["resumed"] is True
+            tid = hdrs["X-Trace-Id"]
+            # each replica saw a DIFFERENT parent (its own attempt span)
+            pa, pb = (fakes["a"].seen_parent_spans[-1],
+                      fakes["b"].seen_parent_spans[-1])
+            assert pa and pb and pa != pb
+            # first attempt: nothing interesting yet — not forced;
+            # the failover continuation IS forced end to end
+            assert fakes["a"].seen_sampled[-1] is None
+            assert fakes["b"].seen_sampled[-1] == "1"
+
+            code, autopsy = self._get(f"{base}/trace/{tid}")
+            assert code == 200
+            assert autopsy["resumed"] is True
+            assert autopsy["carried_tokens"] == 2
+            assert autopsy["retries"] == 1
+            root = autopsy["tree"][0]
+            assert root["name"] == "router /generate"
+            att = {c["name"]: c for c in root["children"]}
+            assert set(att) == {"attempt 1 -> a", "attempt 2 -> b"}
+            assert att["attempt 1 -> a"]["span_id"] == pa
+            assert att["attempt 2 -> b"]["span_id"] == pb
+            assert att["attempt 1 -> a"]["status"] == "http:503"
+            assert att["attempt 2 -> b"]["status"] == "http:200"
+            resume_ev = [e for e in autopsy["events"]
+                         if e["type"] == "resume"][0]
+            assert resume_ev["attrs"]["carried"] == 2
+            # the descriptor's span id links the dead attempt in
+            assert resume_ev["attrs"]["resumed_from_span"] \
+                == "deadbeefdeadbeef"
+            assert root["attrs"]["attempts"] == 2
+            assert root["attrs"]["resumed"] is True
+            assert root["status"] == "http:200"
+        finally:
+            self._teardown(fakes, rt)
+
+    def test_router_ingress_parent_span_validation(self, tmp_path):
+        """ROUTER-ingress twins of the replica-ingress edge cases
+        (tests/test_tracing.py): a client X-Parent-Span nests the
+        router root — but only alongside a VALID X-Trace-Id; spoofed /
+        malformed / oversized parents are dropped."""
+        base, fakes, reg, rt, rec = self._front(tmp_path)
+        try:
+            cases = [
+                ({"X-Trace-Id": "up-1", "X-Parent-Span": "c" * 16},
+                 "up-1", "c" * 16),       # valid: honored
+                ({"X-Parent-Span": "d" * 16},
+                 None, None),             # spoofed on a fresh trace
+                ({"X-Trace-Id": "up-2", "X-Parent-Span": "x" * 65},
+                 "up-2", None),           # oversized
+                ({"X-Trace-Id": "up-3", "X-Parent-Span": "sp ace"},
+                 "up-3", None),           # malformed
+                ({"X-Trace-Id": "bad id!", "X-Parent-Span": "e" * 16},
+                 None, None),             # invalid trace id => both out
+            ]
+            for headers, want_tid, want_parent in cases:
+                code, resp, _ = _post(
+                    base, {"tokens": [1], "max_new_tokens": 2},
+                    headers=headers)
+                assert code == 200
+                tid = resp.get("trace_id") or \
+                    fakes["a"].seen_trace_ids[-1]
+                if want_tid is not None:
+                    assert tid == want_tid
+                with open(rec.path) as f:
+                    roots = [json.loads(l) for l in f
+                             if '"router /generate"' in l]
+                root = [r for r in roots if r["trace"] == tid][-1]
+                assert root.get("parent") == want_parent, headers
+        finally:
+            self._teardown(fakes, rt)
+
+    def test_client_force_sample_rides_through_to_the_replica(
+            self, tmp_path):
+        """X-Trace-Sampled from the CLIENT (with a valid trace id — the
+        same trust gate as X-Parent-Span) must reach the replica on the
+        FIRST attempt: it is the documented way to capture one
+        request's full tick detail through the front tier."""
+        base, fakes, reg, rt, rec = self._front(tmp_path)
+        try:
+            _post(base, {"tokens": [1], "max_new_tokens": 2},
+                  headers={"X-Trace-Id": "force-1",
+                           "X-Trace-Sampled": "1"})
+            assert fakes["a"].seen_sampled[-1] == "1"
+            # the gate: no (valid) trace id => not trusted
+            _post(base, {"tokens": [1], "max_new_tokens": 2},
+                  headers={"X-Trace-Sampled": "1"})
+            assert fakes["a"].seen_sampled[-1] is None
+        finally:
+            self._teardown(fakes, rt)
+
+    def test_client_parent_forwarded_without_router_recorder(self):
+        """A replicas-only span deployment (no recorder in the router
+        process): the client's validated X-Parent-Span must still be
+        FORWARDED so the replica's span joins the upstream tree —
+        dropped silently only when invalid/untrusted."""
+        from horovod_tpu.obs import tracing as TR
+
+        assert TR.spans() is None  # no router recorder in this test
+        fakes = {"a": _FakeReplica("a")}
+        reg = _registry(*fakes.values())
+        rt = RouterServer(reg, port=0, own_registry_thread=False,
+                          max_attempts=2, proxy_timeout=2.0).start()
+        try:
+            host, port = rt.address
+            base = f"http://{host}:{port}"
+            _post(base, {"tokens": [1], "max_new_tokens": 2},
+                  headers={"X-Trace-Id": "up-fwd",
+                           "X-Parent-Span": "f" * 16})
+            assert fakes["a"].seen_parent_spans[-1] == "f" * 16
+            _post(base, {"tokens": [1], "max_new_tokens": 2},
+                  headers={"X-Parent-Span": "f" * 16})  # no trace id
+            assert fakes["a"].seen_parent_spans[-1] is None
+        finally:
+            rt.stop()
+            fakes["a"].stop()
+
+    def test_trace_endpoint_error_paths(self, tmp_path):
+        base, fakes, reg, rt, rec = self._front(tmp_path)
+        try:
+            code, resp = self._get(f"{base}/trace/not!valid!")
+            assert code == 400 and resp["type"] == "bad_trace_id"
+            code, resp = self._get(f"{base}/trace/{'0' * 16}")
+            assert code == 404 and resp["type"] == "unknown_trace"
+            # a broken STORE must not masquerade as a missing trace
+            rt.span_dir = str(tmp_path / "moved_or_mistyped")
+            code, resp = self._get(f"{base}/trace/{'0' * 16}")
+            assert code == 500 and resp["type"] == "span_store_error"
+        finally:
+            self._teardown(fakes, rt)
+
+    def test_trace_endpoint_without_span_dir_is_typed_503(
+            self, tmp_path):
+        base, fakes, reg, rt, rec = self._front(tmp_path,
+                                                span_dir=False)
+        try:
+            code, resp = self._get(f"{base}/trace/{'0' * 16}")
+            assert code == 503 and resp["type"] == "no_span_store"
+        finally:
+            self._teardown(fakes, rt)
+
+
+# ---------------------------------------------------------------------------
 # the /stats routing contract + Retry-After on a REAL engine
 # ---------------------------------------------------------------------------
 
@@ -1002,3 +1211,151 @@ class TestFrontTierChaos:
         finally:
             rt.stop()
             sup.stop(drain=False)
+
+    @pytest.mark.tracing
+    def test_sigkill_autopsy_one_tree_and_tail_sampling(self, model):
+        """ACCEPTANCE (ISSUE 12): SIGKILL a replica mid-decode under
+        the router with journaling AND span streams armed.  GET
+        /trace/<id> for an affected request returns ONE tree showing
+        BOTH replica attempts (the dead one as an UNFINISHED span —
+        the kill evidence), the failover + resume edges with
+        carried-token accounting linking the continuation to the dead
+        attempt's span id — while the response stays byte-identical to
+        the no-fault oracle.  And a clean request under no fault is
+        correctly tail-dropped: its breakdown survives on the span's
+        finish record, its tick-level detail does not."""
+        from horovod_tpu.obs import tracing as TR
+
+        params, cfg = model
+        span_dir = tempfile.mkdtemp(prefix="router_spans_")
+        journal_dir = tempfile.mkdtemp(prefix="router_journal_")
+        spec = ReplicaSpec(
+            seed=0, slots=4, warm=(8, 30), tick_timeout=30.0,
+            drain_timeout=3.0, request_timeout=90.0,
+            # latency can't trigger retention on this slow CPU config:
+            # only the failover/resume path may keep tick detail
+            extra_args=("--span-latency-threshold", "600"))
+        reg = ReplicaRegistry(poll_interval=0.15, poll_timeout=1.0,
+                              heartbeat_stale=5.0)
+        sup = ReplicaSupervisor(spec, 2, registry=reg,
+                                unhealthy_grace=1.5, shutdown_grace=2.0,
+                                backoff_initial=0.1,
+                                journal_dir=journal_dir,
+                                span_dir=span_dir)
+        assert TR.spans() is None
+        TR.start_spans(os.path.join(span_dir, "router.spans.jsonl"),
+                       proc="router", role="router",
+                       sampling=TR.SpanSampling(
+                           latency_threshold_s=600.0))
+        rt = RouterServer(reg, port=0, max_attempts=4,
+                          retry_backoff=0.05, proxy_timeout=120.0,
+                          resume_lookup=sup.resume_lookup,
+                          span_dir=span_dir)
+        sup.start()
+        rt.start()
+
+        def walk(node):
+            yield node
+            for c in node["children"]:
+                yield from walk(c)
+
+        try:
+            assert sup.wait_ready(timeout=240), "replicas never ready"
+            host, port = rt.address
+            base = f"http://{host}:{port}"
+            steps = 24
+            rng = np.random.default_rng(7)
+            prompts = [[int(t) for t in rng.integers(1, 60, 2 + i % 3)]
+                       for i in range(6)]
+
+            def kill_busy_replica():
+                deadline = time.monotonic() + 60.0
+                while time.monotonic() < deadline:
+                    for h in sup.replicas():
+                        try:
+                            live = serving.RequestJournal.read_live(
+                                sup._journal_paths[h.rid])
+                        except Exception:
+                            continue
+                        if any(5 <= len(d["emitted_tokens"]) <= steps - 8
+                               for d in live.values()):
+                            os.kill(h.pid, signal.SIGKILL)
+                            return
+                    time.sleep(0.02)
+                raise AssertionError("no replica ever got mid-decode")
+
+            results = _burst(base, prompts, steps, timeout=120,
+                             kill_after=kill_busy_replica)
+
+            assert len(results) == len(prompts)
+            assert not [i for i, (c, _) in results.items() if c is None]
+            resumed_tid = None
+            for i, (code, resp) in results.items():
+                assert code == 200, f"req {i}: {code} {resp}"
+                # byte-identical to the no-fault oracle, THROUGH the
+                # kill, the failover, and the resume
+                assert resp["tokens"] == _ref_greedy(
+                    params, cfg, prompts[i], steps), f"req {i}"
+                if resp.get("resumed") and resumed_tid is None:
+                    resumed_tid = resp["trace_id"]
+            assert resumed_tid is not None, f"no resume: {results}"
+
+            # --- the autopsy: ONE tree, both attempts, typed edges ---
+            def get(url):
+                try:
+                    with urllib.request.urlopen(url, timeout=15) as r:
+                        return r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+
+            code, autopsy = get(f"{base}/trace/{resumed_tid}")
+            assert code == 200
+            assert autopsy["resumed"] is True
+            assert autopsy["failovers"] >= 1
+            assert autopsy["carried_tokens"] >= 1
+            assert "router" in autopsy["processes"]
+            assert len(autopsy["processes"]) >= 3  # router + 2 replicas
+            assert len(autopsy["tree"]) == 1, "ONE tree, one root"
+            spans = list(walk(autopsy["tree"][0]))
+            gen_spans = [s for s in spans if s["name"] == "generate"]
+            assert len(gen_spans) >= 2, "both replica attempts present"
+            assert len({s["proc"] for s in gen_spans}) >= 2
+            dead = [s for s in gen_spans if s["unfinished"]]
+            done = [s for s in gen_spans if not s["unfinished"]]
+            assert dead and done, (
+                "the killed attempt must surface UNFINISHED and the "
+                f"survivor finished: {gen_spans}")
+            resume_ev = [e for e in autopsy["events"]
+                         if e["type"] == "resume"
+                         and e["attrs"].get("source") == "journal"][0]
+            assert resume_ev["attrs"]["carried"] \
+                == autopsy["carried_tokens"]
+            # the journal's span id links the resume edge to the DEAD
+            # attempt's span — the tree is causal, not just temporal
+            assert resume_ev["attrs"]["resumed_from_span"] \
+                in {s["span_id"] for s in dead}
+            # the survivor's share was force-sampled end to end: its
+            # tick-level detail is IN the tree despite clean latency
+            survivor_ticks = [s for s in spans if s["name"] == "tick"
+                              and s["proc"] == done[0]["proc"]]
+            assert survivor_ticks, "forced retention on the resume leg"
+
+            # --- tail sampling: a clean request keeps only breakdown --
+            code, resp, _ = _post(base, {"tokens": prompts[0],
+                                         "max_new_tokens": 4})
+            assert code == 200
+            clean_tid = resp["trace_id"]
+            code, clean = get(f"{base}/trace/{clean_tid}")
+            assert code == 200
+            cspans = [s for root in clean["tree"]
+                      for s in walk(root)]
+            assert not [s for s in cspans if s["name"] == "tick"], \
+                "clean-load trace must be tail-dropped"
+            cgen = [s for s in cspans if s["name"] == "generate"][0]
+            assert cgen["attrs"]["decode_ticks"] == 3  # breakdown kept
+            assert "retained" not in cgen["attrs"]
+            assert clean["detail_spans_dropped"] >= 1
+        finally:
+            rt.stop()
+            sup.stop(drain=False)
+            TR.stop_spans()

@@ -1,0 +1,651 @@
+"""Distributed request tracing (obs/tracing.py span layer +
+obs/trace_store.py collector + obs/trace CLI).
+
+Four layers of proof:
+
+* **Span model / recorder**: JSONL stream shapes (anchor, start,
+  event, finish, detail, drop), durable start-before-kill ordering,
+  the closed typed-event vocabulary, deterministic cross-process head
+  sampling.
+* **Tail sampling**: full tick-level detail retained ONLY for traces
+  that error, carry a typed event (failover/resume/eviction/...), are
+  forced via ``X-Trace-Sampled``, exceed the latency threshold, or
+  head-sample in; everything else keeps just the breakdown on the
+  finish record (+ a drop marker).
+* **Collector**: trees assembled ACROSS streams with wall-clock
+  anchor alignment, unfinished spans (a SIGKILL'd process's evidence)
+  surfaced, autopsy JSON / ASCII tree / Perfetto export.
+* **Ingress validation**: ``X-Parent-Span`` honored only alongside a
+  valid propagated ``X-Trace-Id``; malformed / oversized / spoofed
+  parents dropped at the replica's HTTP ingress.  (Router-ingress
+  twins live in tests/test_router.py.)
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from horovod_tpu import serving
+from horovod_tpu.models import transformer as T
+from horovod_tpu.obs import tracing as TR
+from horovod_tpu.obs.trace import main as trace_cli
+from horovod_tpu.obs.trace_store import TraceStore
+from horovod_tpu.serving.journal import RequestJournal
+
+from conftest import http_post_json as _post  # noqa: E402
+
+pytestmark = pytest.mark.tracing
+
+
+def _cfg():
+    return T.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=48, dtype=jnp.float32, attention_impl="reference",
+        n_kv_heads=2)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return T.init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _engine(model, **kw):
+    params, cfg = model
+    defaults = dict(n_slots=2, max_len=40, min_prefill_bucket=4,
+                    restart_backoff=0.01, restart_backoff_max=0.05)
+    defaults.update(kw)
+    return serving.InferenceEngine(
+        params, cfg, serving.EngineConfig(**defaults))
+
+
+def _run_until_done(engine, futs, max_ticks=400):
+    for _ in range(max_ticks):
+        if all(f.done() for f in futs):
+            return
+        engine.step()
+    raise AssertionError("engine did not finish within the tick budget")
+
+
+@pytest.fixture()
+def spans(tmp_path):
+    """A started span recorder (high latency threshold: nothing
+    retains by accident), detached afterwards so the module global
+    never leaks into other tests."""
+    assert TR.spans() is None
+    rec = TR.start_spans(
+        str(tmp_path / "proc.spans.jsonl"), proc="testproc",
+        role="replica",
+        sampling=TR.SpanSampling(latency_threshold_s=600.0))
+    yield rec, tmp_path
+    if TR.spans() is None:
+        TR.activate_spans(rec)
+    TR.stop_spans()
+
+
+def _lines(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------------------
+# span model + recorder
+# ---------------------------------------------------------------------------
+
+
+class TestSpanModel:
+    def test_mint_and_validate_ids(self):
+        sid = TR.mint_span_id()
+        assert TR.valid_span_id(sid) and len(sid) == 16
+        assert TR.valid_span_id("edge-abc.01_2")
+        assert not TR.valid_span_id("")
+        assert not TR.valid_span_id(None)
+        assert not TR.valid_span_id("x" * 65)        # oversized
+        assert not TR.valid_span_id("bad span!")     # bad charset
+
+    def test_head_sampling_is_deterministic_and_rate_shaped(self):
+        ids = [TR.mint_trace_id() for _ in range(400)]
+        a = [TR.head_sampled(t, 0.25) for t in ids]
+        b = [TR.head_sampled(t, 0.25) for t in ids]
+        assert a == b                      # same verdict, any process
+        frac = sum(a) / len(a)
+        assert 0.1 < frac < 0.45           # roughly the asked-for rate
+        assert not any(TR.head_sampled(t, 0.0) for t in ids)
+        assert all(TR.head_sampled(t, 1.0) for t in ids)
+
+    def test_stream_shapes_and_anchor(self, spans):
+        rec, tmp = spans
+        tid = TR.mint_trace_id()
+        sid = rec.begin("root", tid, attrs={"x": 1})
+        rec.event(tid, sid, "failover", {"replica": "r0g0"})
+        rec.finish(sid, status="ok")
+        lines = _lines(rec.path)
+        assert lines[0]["k"] == "anchor"
+        assert lines[0]["proc"] == "testproc"
+        assert lines[0]["role"] == "replica"
+        # anchor pairs the two clocks for collector-side alignment
+        assert abs((lines[0]["wall"] - lines[0]["mono"])
+                   - (time.time() - time.monotonic())) < 5.0
+        s, e, f = lines[1], lines[2], lines[3]
+        assert (s["k"], s["id"], s["trace"], s["name"]) \
+            == ("s", sid, tid, "root")
+        assert (e["k"], e["type"], e["span"]) == ("e", "failover", sid)
+        assert (f["k"], f["id"], f["status"]) == ("f", sid, "ok")
+
+    def test_event_vocabulary_is_closed(self, spans):
+        rec, _ = spans
+        with pytest.raises(ValueError, match="unknown span event"):
+            rec.event(TR.mint_trace_id(), None, "exploded")
+
+    def test_start_spans_is_single_per_process(self, spans):
+        with pytest.raises(ValueError, match="already started"):
+            TR.start_spans("/tmp/nope.jsonl")
+
+    def test_request_begin_is_flushed_before_resolution(self, spans):
+        """The durability contract: the start record is ON DISK the
+        moment the request is live — a SIGKILL any time later still
+        leaves the span for the autopsy."""
+        rec, _ = spans
+        tr = TR.RequestTrace("durable-1")
+        tr.submitted_at = time.monotonic()
+        rec.request_begin(tr)
+        kinds = [l["k"] for l in _lines(rec.path)]
+        assert kinds[-1] == "s"  # flushed, without any finish yet
+
+
+# ---------------------------------------------------------------------------
+# tail sampling
+# ---------------------------------------------------------------------------
+
+
+class TestTailSampling:
+    def _resolved_trace(self, trace_id=None, *, dur=0.001, ticks=3,
+                        error=None, events=(), sampled=False):
+        tr = TR.RequestTrace(trace_id)
+        now = time.monotonic()
+        tr.submitted_at = now - dur
+        tr.admitted_at = tr.submitted_at + dur / 4
+        tr.first_token_at = tr.submitted_at + dur / 2
+        tr.finished_at = now
+        tr.finish, tr.error = ("length", None) if error is None \
+            else (None, error)
+        tr.sampled = sampled
+        tr.ticks = [(now - 1e-3 * (i + 1), now - 1e-3 * i, 1)
+                    for i in range(ticks)]
+        for ev in events:
+            tr.events.append((ev, now, None))
+        return tr
+
+    def _names(self, rec):
+        return [l.get("name") for l in _lines(rec.path)
+                if l["k"] == "d"]
+
+    def test_clean_fast_request_tail_drops_detail(self, spans):
+        rec, _ = spans
+        tr = self._resolved_trace()
+        rec.request_begin(tr)
+        rec.request_done(tr)
+        lines = _lines(rec.path)
+        assert not [l for l in lines if l["k"] == "d"]  # no detail
+        drop = [l for l in lines if l["k"] == "x"]
+        assert drop and drop[0]["n"] == 3 and drop[0]["why"] == "tail"
+        fin = [l for l in lines if l["k"] == "f"][0]
+        # the breakdown is KEPT on the finish record
+        assert fin["a"]["total_s"] is not None
+        assert "retained" not in fin["a"]
+        assert rec.n_dropped == 1 and rec.n_retained == 0
+
+    def test_routine_spec_fallback_does_not_force_retention(self,
+                                                            spans):
+        """spec_fallback is a ROUTINE event under low-acceptance
+        speculative load — it stays visible as an event record but
+        must not drag full tick detail past tail sampling (only the
+        failure-class RETAIN_EVENT_TYPES do)."""
+        rec, _ = spans
+        tr = self._resolved_trace()
+        rec.request_begin(tr)
+        rec.request_event(tr, "spec_fallback", {"slot": 0})
+        rec.request_done(tr)
+        lines = _lines(rec.path)
+        assert [l for l in lines if l["k"] == "e"
+                and l["type"] == "spec_fallback"]  # event IS recorded
+        fin = [l for l in lines if l["k"] == "f"][-1]
+        assert "retained" not in fin["a"]          # ... detail is not
+        assert not [l for l in lines if l["k"] == "d"]
+        assert "spec_fallback" not in TR.RETAIN_EVENT_TYPES
+        assert TR.RETAIN_EVENT_TYPES < TR.SPAN_EVENT_TYPES
+
+    @pytest.mark.parametrize("kw,reason", [
+        (dict(error="EngineFailedError"), "error"),
+        (dict(sampled=True), "forced"),
+        (dict(events=("resume",)), "event"),
+        (dict(events=("eviction",)), "event"),
+        (dict(dur=1000.0), "latency"),
+    ])
+    def test_retention_reasons(self, spans, kw, reason):
+        rec, _ = spans
+        tr = self._resolved_trace(**kw)
+        rec.request_begin(tr)
+        rec.request_done(tr)
+        fin = [l for l in _lines(rec.path) if l["k"] == "f"][-1]
+        assert fin["a"]["retained"] == reason
+        names = self._names(rec)
+        assert names.count("tick") == 3
+        assert {"queue", "prefill", "decode"} <= set(names)
+
+    def test_head_sampling_retains(self, tmp_path):
+        rec = TR.SpanRecorder(str(tmp_path / "h.jsonl"), proc="h",
+                              sampling=TR.SpanSampling(
+                                  latency_threshold_s=600.0,
+                                  head_rate=1.0))
+        tr = self._resolved_trace()
+        rec.request_begin(tr)
+        rec.request_done(tr)
+        rec.close()
+        fin = [l for l in _lines(rec.path) if l["k"] == "f"][0]
+        assert fin["a"]["retained"] == "head"
+
+    def test_tick_span_cap(self, tmp_path):
+        rec = TR.SpanRecorder(str(tmp_path / "c.jsonl"), proc="c",
+                              sampling=TR.SpanSampling(
+                                  latency_threshold_s=600.0,
+                                  max_tick_spans=4))
+        tr = self._resolved_trace(ticks=9, error="Boom")
+        tr.ticks_overflow = 7   # ticks past the RequestTrace buffer cap
+        rec.request_begin(tr)
+        rec.request_done(tr)
+        rec.close()
+        lines = _lines(rec.path)
+        assert sum(1 for l in lines
+                   if l["k"] == "d" and l["name"] == "tick") == 4
+        cap = [l for l in lines if l["k"] == "x"][0]
+        # shed = (9 buffered - 4 written) + 7 never buffered
+        assert cap["n"] == 12 and cap["why"] == "max_tick_spans"
+
+
+# ---------------------------------------------------------------------------
+# collector: trees, clock alignment, autopsy, renders
+# ---------------------------------------------------------------------------
+
+
+class TestTraceStore:
+    def _two_process_trace(self, tmp_path, *, finish_child=True):
+        """A router-shaped trace across two streams with DIFFERENT
+        clock anchors: router at wall offset 0, replica with its
+        monotonic clock shifted by 100s (collector must re-align)."""
+        tid = "autopsy-1"
+        router = TR.SpanRecorder(str(tmp_path / "router.spans.jsonl"),
+                                 proc="router", role="router")
+        root = router.begin("router /generate", tid, t0=time.monotonic())
+        att1 = router.begin("attempt 1 -> r0g0", tid, parent=root)
+        rep = TR.SpanRecorder(str(tmp_path / "r0g0.spans.jsonl"),
+                              proc="r0g0", role="replica")
+        # fake a skewed monotonic clock: shift mono anchor by -100
+        lines = _lines(rep.path)
+        rep.close()
+        lines[0]["mono"] -= 100.0
+        with open(rep.path, "w") as f:
+            f.write(json.dumps(lines[0]) + "\n")
+        rep = TR.SpanRecorder(str(tmp_path / "r0g0b.spans.jsonl"),
+                              proc="r0g0", role="replica")
+        child = rep.begin("generate", tid, parent=att1,
+                          t0=time.monotonic(),
+                          attrs={"prompt_tokens": 3})
+        router.event(tid, root, "failover", {"replica": "r0g0"})
+        router.event(tid, root, "resume",
+                     {"carried": 5, "from_replica": "r0g0"})
+        att2 = router.begin("attempt 2 -> r1g0", tid, parent=root)
+        if finish_child:
+            rep.finish(child, status="ok", attrs={"tokens": 4})
+        router.finish(att1, status="error:connection")
+        router.finish(att2, status="http:200")
+        router.finish(root, status="http:200",
+                      attrs={"attempts": 2, "resumed": True})
+        router.close()
+        rep.close()
+        return tid
+
+    def test_tree_assembly_and_clock_alignment(self, tmp_path):
+        tid = self._two_process_trace(tmp_path)
+        store = TraceStore.from_dir(str(tmp_path))
+        roots = store.tree(tid)
+        assert len(roots) == 1
+        root = roots[0]
+        assert root.name == "router /generate"
+        att_names = [c.name for c in root.children]
+        assert att_names == ["attempt 1 -> r0g0", "attempt 2 -> r1g0"]
+        child = root.children[0].children[0]
+        assert child.proc == "r0g0" and child.name == "generate"
+        # clock alignment: the replica's span must land on the SAME
+        # wall axis as the router's (within the test's runtime), not
+        # 100 seconds away
+        assert abs(child.t0 - root.t0) < 5.0
+
+    def test_autopsy_fields(self, tmp_path):
+        tid = self._two_process_trace(tmp_path)
+        a = TraceStore.from_dir(str(tmp_path)).autopsy(tid)
+        assert a["trace_id"] == tid
+        assert set(a["processes"]) == {"router", "r0g0"}
+        assert a["resumed"] is True
+        assert a["failovers"] == 1
+        assert a["carried_tokens"] == 5
+        assert a["span_count"] == 4
+        assert not a["unfinished_spans"]
+        assert len(a["attempts"]) == 3  # 2 router attempts + 1 replica
+        assert a["duration_s"] is not None
+
+    def test_unfinished_span_surfaces_kill_evidence(self, tmp_path):
+        tid = self._two_process_trace(tmp_path, finish_child=False)
+        store = TraceStore.from_dir(str(tmp_path))
+        a = store.autopsy(tid)
+        assert len(a["unfinished_spans"]) == 1
+        txt = store.ascii_tree(tid)
+        assert "UNFINISHED" in txt
+        rep_attempt = [x for x in a["attempts"] if x["proc"] == "r0g0"]
+        assert rep_attempt[0]["unfinished"] is True
+        assert rep_attempt[0]["status"] == "unfinished"
+
+    def test_ascii_tree_and_perfetto(self, tmp_path):
+        tid = self._two_process_trace(tmp_path)
+        store = TraceStore.from_dir(str(tmp_path))
+        txt = store.ascii_tree(tid)
+        assert "router /generate [router]" in txt
+        assert "generate [r0g0]" in txt
+        assert "! failover" in txt and "! resume" in txt
+        events = store.perfetto(tid)
+        procs = {e["args"]["name"] for e in events
+                 if e.get("name") == "process_name"}
+        assert procs == {"router", "r0g0"}       # one track per process
+        spans = [e for e in events if e.get("ph") == "X"]
+        assert len(spans) == 4
+        instants = {e["name"] for e in events if e.get("ph") == "i"}
+        assert {"failover", "resume"} <= instants
+
+    def test_perfetto_concurrent_requests_get_distinct_rows(
+            self, tmp_path):
+        """Two OVERLAPPING request spans in one process must land on
+        different thread rows (same-row overlap renders as a bogus
+        flame nesting in Perfetto), while a request's own children
+        (phases/ticks) share its row — true nesting."""
+        rec = TR.SpanRecorder(str(tmp_path / "p.jsonl"), proc="rep",
+                              role="replica")
+        t0 = time.monotonic()
+        a = rec.begin("generate", "ta", t0=t0)
+        b = rec.begin("generate", "tb", t0=t0 + 0.001)  # overlaps a
+        rec.finish(a, t1=t0 + 0.1)
+        rec.finish(b, t1=t0 + 0.1)
+        rec.close()
+        store = TraceStore([str(tmp_path / "p.jsonl")])
+        pf = store.perfetto()  # combined export: all traces, one file
+        ev_a = [e for e in pf if e.get("ph") == "X"
+                and e["args"]["trace_id"] == "ta"][0]
+        ev_b = [e for e in pf if e.get("ph") == "X"
+                and e["args"]["trace_id"] == "tb"][0]
+        assert ev_a["pid"] == ev_b["pid"]      # same process track
+        assert ev_a["tid"] != ev_b["tid"]      # distinct rows
+        # a retained trace's detail spans inherit the request's row
+        tid2 = self._two_process_trace(tmp_path)
+        pf = TraceStore.from_dir(str(tmp_path)).perfetto(tid2)
+        by_span = {e["args"]["span_id"]: e for e in pf
+                   if e.get("ph") == "X" and "span_id" in e.get(
+                       "args", {})}
+        root = [e for e in pf if e.get("ph") == "X"
+                and e["name"] == "router /generate"][0]
+        atts = [e for e in pf if e.get("ph") == "X"
+                and e["name"].startswith("attempt")]
+        assert all(a["tid"] == root["tid"] and a["pid"] == root["pid"]
+                   for a in atts)  # one request = one router row
+
+    def test_unknown_trace_and_unreadable_stream(self, tmp_path):
+        tid = self._two_process_trace(tmp_path)
+        (tmp_path / "garbage.spans.jsonl").write_text("{not json\n")
+        (tmp_path / "empty.spans.jsonl").write_text("")
+        # a stray BINARY file matching the glob must be skipped, not
+        # abort the whole load with UnicodeDecodeError
+        (tmp_path / "binary.spans.jsonl").write_bytes(
+            b"\x80\x81\xfe\xff\x00binary")
+        # ... as must individually malformed records: valid JSON of
+        # the wrong shape (null timestamps, a bare list, a foreign
+        # schema) skips the RECORD, never kills the store
+        (tmp_path / "foreign.spans.jsonl").write_text(
+            '{"k":"s","id":"m1","trace":"autopsy-1","t0":null}\n'
+            '[1,2,3]\n'
+            '{"k":"f","id":"m1","t1":"soon"}\n'
+            '{"some":"other","jsonl":"schema"}\n')
+        store = TraceStore.from_dir(str(tmp_path))
+        assert store.autopsy("nonexistent") is None
+        assert store.autopsy(tid) is not None  # healthy streams intact
+        store2 = TraceStore([str(tmp_path / "missing-*.jsonl"),
+                             str(tmp_path / "does_not_exist.jsonl")])
+        assert store2.trace_ids() == []
+
+    def test_torn_final_line_tolerated(self, tmp_path):
+        tid = self._two_process_trace(tmp_path)
+        with open(tmp_path / "r0g0b.spans.jsonl", "a") as f:
+            f.write('{"k":"s","id":"torn","trace":"autopsy-1","t0"')
+        a = TraceStore.from_dir(str(tmp_path)).autopsy(tid)
+        assert a["span_count"] == 4  # torn line skipped, rest intact
+
+    def test_cli_list_tree_json_perfetto(self, tmp_path, capsys):
+        tid = self._two_process_trace(tmp_path)
+        assert trace_cli(["--spans", str(tmp_path), "--list"]) == 0
+        out = capsys.readouterr().out
+        assert tid in out and "resumed" in out
+        assert trace_cli(["--spans", str(tmp_path), tid]) == 0
+        assert "router /generate" in capsys.readouterr().out
+        assert trace_cli(["--spans", str(tmp_path), tid, "--json"]) == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed["carried_tokens"] == 5
+        pf = str(tmp_path / "out.perfetto.json")
+        assert trace_cli(["--spans", str(tmp_path), tid,
+                          "--perfetto", pf]) == 0
+        capsys.readouterr()
+        assert json.load(open(pf))
+        assert trace_cli(["--spans", str(tmp_path), "bogus-id"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# engine integration
+# ---------------------------------------------------------------------------
+
+
+class TestEngineSpans:
+    def test_request_span_with_parent_and_forced_detail(self, model,
+                                                        spans):
+        rec, _ = spans
+        eng = _engine(model)
+        eng.warmup([4])
+        fut = eng.submit([1, 2, 3], max_new_tokens=5,
+                         trace_id="edge-req", parent_span="p" * 16,
+                         sampled=True)
+        _run_until_done(eng, [fut])
+        eng.stop()
+        lines = _lines(rec.path)
+        start = [l for l in lines if l["k"] == "s"
+                 and l["trace"] == "edge-req"][0]
+        assert start["parent"] == "p" * 16
+        assert start["a"]["prompt_tokens"] == 3
+        fin = [l for l in lines if l["k"] == "f"
+               and l["id"] == start["id"]][0]
+        assert fin["status"] == "ok"
+        assert fin["a"]["retained"] == "forced"
+        assert fin["a"]["tokens"] == 5
+        ticks = [l for l in lines if l["k"] == "d"
+                 and l["trace"] == "edge-req" and l["name"] == "tick"]
+        # 5 tokens = 1 prefill + 4 decode-tick emissions
+        assert len(ticks) == 4
+        assert all(l["parent"] == start["id"] for l in ticks)
+
+    def test_clean_request_detail_dropped_breakdown_kept(self, model,
+                                                         spans):
+        rec, _ = spans
+        eng = _engine(model)
+        eng.warmup([4])
+        fut = eng.submit([1, 2, 3], max_new_tokens=5)
+        _run_until_done(eng, [fut])
+        eng.stop()
+        tid = fut.trace_id
+        lines = _lines(rec.path)
+        assert not [l for l in lines if l["k"] == "d"
+                    and l["trace"] == tid]
+        fin = [l for l in lines if l["k"] == "f"][-1]
+        assert fin["a"]["decode_ticks"] == 4     # breakdown survives
+        assert [l for l in lines if l["k"] == "x"
+                and l["trace"] == tid]
+
+    def test_restart_resume_emits_typed_event_same_span(self, model,
+                                                        spans):
+        """A crash mid-decode, restart-resume ON: the resumed request
+        keeps its span id, the stream carries the typed ``resume``
+        event on that same span, and retention flips to full detail."""
+        rec, _ = spans
+        inj = serving.FaultInjector()
+        eng = _engine(model, faults=inj, max_restarts=3)
+        eng.warmup([4])
+        inj.add(serving.FaultSpec(
+            site="decode_tick", kind="raise",
+            skip=inj.visits("decode_tick") + 2, max_fires=1))
+        fut = eng.submit([1, 2, 3], max_new_tokens=8)
+        _run_until_done(eng, [fut])
+        eng.stop()
+        assert fut.finish_reason == "length"
+        lines = _lines(rec.path)
+        start = [l for l in lines if l["k"] == "s"
+                 and l["trace"] == fut.trace_id][0]
+        evs = [l for l in lines if l["k"] == "e"
+               and l["trace"] == fut.trace_id]
+        assert [e["type"] for e in evs] == ["engine_restart", "resume"]
+        assert all(e["span"] == start["id"] for e in evs)  # ONE tree
+        assert evs[1]["a"]["wasted_tokens"] >= 1
+        fin = [l for l in lines if l["k"] == "f"
+               and l["id"] == start["id"]][0]
+        assert fin["a"]["retained"] == "event"
+        # the response breakdown discloses the events too
+        assert [e["type"] for e in fut.breakdown()["events"]] \
+            == ["engine_restart", "resume"]
+
+    def test_journal_carries_span_id(self, model, spans, tmp_path):
+        """Satellite regression (beside the resume-failover tests):
+        the journal's begin record carries the originating span id, so
+        a post-mortem ``read_live`` descriptor links the resumed
+        attempt into the SAME trace tree."""
+        rec, _ = spans
+        jp = str(tmp_path / "req.journal.jsonl")
+        eng = _engine(model, journal_path=jp)
+        eng.warmup([4])
+        fut = eng.submit([1, 2, 3], max_new_tokens=20,
+                         trace_id="kill-me")
+        for _ in range(6):
+            eng.step()
+        assert not fut.done()
+        live = RequestJournal.read_live(jp)
+        desc = live["kill-me"]
+        assert desc["span_id"] == fut.trace.span_id
+        assert len(desc["emitted_tokens"]) >= 1
+        fut.cancel()
+        _run_until_done(eng, [fut])
+        eng.stop()
+
+    def test_disabled_recorder_leaves_no_trace_state(self, model):
+        assert TR.spans() is None
+        eng = _engine(model)
+        eng.warmup([4])
+        fut = eng.submit([1, 2, 3], max_new_tokens=5)
+        _run_until_done(eng, [fut])
+        eng.stop()
+        assert fut.trace.ticks == []      # no buffering when disabled
+        assert fut.breakdown().get("events") is None
+
+
+# ---------------------------------------------------------------------------
+# replica HTTP ingress: header validation edge cases
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaIngressHeaders:
+    @pytest.fixture()
+    def server(self, model, spans):
+        rec, _ = spans
+        eng = _engine(model)
+        eng.warmup([4])
+        srv = serving.ServingServer(eng, port=0).start()
+        host, port = srv.address
+        yield rec, f"http://{host}:{port}/generate"
+        srv.stop(drain_timeout=5.0)
+
+    def _post_hdrs(self, url, payload, headers):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json", **headers},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return r.status, json.loads(r.read())
+
+    def _start_for(self, rec, tid):
+        return [l for l in _lines(rec.path)
+                if l["k"] == "s" and l["trace"] == tid]
+
+    def _fin_for(self, rec, span_id, timeout=5.0):
+        """The span's finish record, POLLED: the HTTP response is sent
+        when the future resolves (`_done.set()`), which happens just
+        BEFORE request_done appends the finish line — a fixed-point
+        read right after the response races the recorder."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            fins = [l for l in _lines(rec.path)
+                    if l["k"] == "f" and l["id"] == span_id]
+            if fins:
+                return fins[0]
+            time.sleep(0.02)
+        raise AssertionError(f"no finish record for span {span_id}")
+
+    def test_valid_parent_with_valid_trace_propagates(self, server):
+        rec, url = server
+        code, body = self._post_hdrs(
+            url, {"tokens": [1, 2], "max_new_tokens": 2},
+            {"X-Trace-Id": "prop-1", "X-Parent-Span": "a" * 16,
+             "X-Trace-Sampled": "1"})
+        assert code == 200
+        start = self._start_for(rec, "prop-1")[0]
+        assert start["parent"] == "a" * 16
+        fin = self._fin_for(rec, start["id"])
+        assert fin["a"]["retained"] == "forced"  # X-Trace-Sampled
+
+    def test_spoofed_parent_on_fresh_trace_is_dropped(self, server):
+        """X-Parent-Span WITHOUT a propagated trace id: the parent
+        would dangle into some other tenant's tree — dropped, the
+        request roots its own trace."""
+        rec, url = server
+        code, body = self._post_hdrs(
+            url, {"tokens": [1, 2], "max_new_tokens": 2},
+            {"X-Parent-Span": "b" * 16})
+        assert code == 200
+        start = self._start_for(rec, body["trace_id"])[0]
+        assert "parent" not in start
+
+    @pytest.mark.parametrize("bad", [
+        "has spaces", "x" * 65, "<script>", ""])
+    def test_malformed_or_oversized_parent_dropped(self, server, bad):
+        rec, url = server
+        code, body = self._post_hdrs(
+            url, {"tokens": [1, 2], "max_new_tokens": 2},
+            {"X-Trace-Id": "prop-bad-" + str(len(bad)),
+             "X-Parent-Span": bad})
+        assert code == 200
+        start = self._start_for(rec, body["trace_id"])[0]
+        assert "parent" not in start
+
+    def test_sampled_header_needs_valid_trace_id(self, server):
+        rec, url = server
+        code, body = self._post_hdrs(
+            url, {"tokens": [1, 2], "max_new_tokens": 2},
+            {"X-Trace-Sampled": "1"})  # no trace id: not trusted
+        assert code == 200
+        start = self._start_for(rec, body["trace_id"])[0]
+        fin = self._fin_for(rec, start["id"])
+        assert "retained" not in fin["a"]
