@@ -25,6 +25,14 @@ host_syncs_per_tick):
 
     python benchmarks/serving.py --engine [--slots 8] [--arrival-rate 4]
 
+plus the sampled-vs-greedy throughput A/B (per-slot vectorized
+sampling is data in the same executable; the ratio is the in-tick
+sort/softmax/categorical cost) and, with ``--stream``, the SSE
+streaming leg: client-observed TTFB p50/p99 (first token event on the
+wire) against the non-streamed server-reported TTFT:
+
+    python benchmarks/serving.py --engine --stream
+
 ``--router N`` drives the REPLICATED front tier (docs/serving.md
 "Front tier"): a ReplicaSupervisor spawns N replica processes (each a
 full engine + HTTP server, seeded identically), a router proxies the
@@ -543,6 +551,131 @@ def _ab_tracing(args, cfg, params):
     }
 
 
+def _ab_sampled(args, cfg, params):
+    """Sampled-vs-greedy throughput A/B: per-slot sampling rides the
+    SAME compiled tick as parameter columns, so the only cost is the
+    in-tick sort/softmax/categorical — this measures it (same
+    interleaved-rep p25 idiom as the overlap A/B), and asserts the
+    zero-recompile property across the whole mix."""
+    from horovod_tpu import serving
+
+    S = args.slots
+    prompt = np.random.default_rng(2).integers(
+        0, cfg.vocab_size, max(args.prompt_len // 2, 1)).tolist()
+    eng = serving.InferenceEngine(
+        params, cfg, serving.EngineConfig(
+            n_slots=S, max_len=cfg.max_seq,
+            max_prefills_per_tick=args.max_prefills_per_tick,
+            max_queue_depth=max(2 * S, 8)))
+    eng.warmup([len(prompt)])
+    base_compiles = eng.decode_compilations
+    steps = max(min(max(args.steps, 24), cfg.max_seq - len(prompt) + 1), 1)
+    dts = {"greedy": [], "sampled": []}
+    for _ in range(max(args.iters, 4)):
+        for name, kw in (("greedy", {}),
+                         ("sampled", dict(temperature=1.0, top_k=16,
+                                          top_p=0.9))):
+            futs = [eng.submit(prompt, max_new_tokens=steps, seed=i,
+                               **kw) for i in range(S)]
+            while not all(f.done() for f in futs):
+                full = eng.slots.active_count == S
+                t0 = time.perf_counter()
+                eng.step()
+                dt = time.perf_counter() - t0
+                if full and eng.slots.active_count == S:
+                    dts[name].append(dt)
+    q = {n: float(np.percentile(d, 25)) for n, d in dts.items()}
+    return {
+        "decode_tok_s_greedy": round(S / q["greedy"], 2),
+        "decode_tok_s_sampled": round(S / q["sampled"], 2),
+        "sampled_vs_greedy_ratio": round(q["greedy"] / q["sampled"], 3),
+        "sampling_recompiles": eng.decode_compilations - base_compiles,
+    }
+
+
+def _ab_stream(args, cfg, params):
+    """The streaming-transport leg (``--stream``): client-observed
+    TTFB — request start to the FIRST SSE token event on the wire —
+    p50/p99 against the non-streamed server-reported TTFT on the same
+    closed-loop HTTP workload.  Streaming exists to close the gap
+    between 'first token computed' and 'first byte a user sees'; this
+    reports both ends of it."""
+    import http.client
+
+    from horovod_tpu import serving
+    from horovod_tpu.serving import sse
+
+    eng = serving.InferenceEngine(
+        params, cfg, serving.EngineConfig(
+            n_slots=args.slots, max_len=cfg.max_seq,
+            max_prefills_per_tick=args.max_prefills_per_tick,
+            max_queue_depth=max(args.n_requests, 8)))
+    prompt = np.random.default_rng(3).integers(
+        0, cfg.vocab_size, max(args.prompt_len // 2, 1)).tolist()
+    eng.warmup([len(prompt)])
+    srv = serving.ServingServer(eng, port=0).start()
+    host, port = srv.address
+    steps = max(min(args.steps, cfg.max_seq - len(prompt) + 1), 1)
+    n = max(min(args.n_requests, 16), 8)
+
+    def post(body):
+        c = http.client.HTTPConnection(host, port, timeout=60)
+        c.request("POST", "/generate", body=json.dumps(body).encode())
+        return c, c.getresponse()
+
+    ttft_ms, ttfb_ms, toks = [], [], {}
+    try:
+        for i in range(n):
+            c, r = post({"tokens": prompt, "max_new_tokens": steps,
+                         "temperature": 1.0, "seed": i})
+            resp = json.loads(r.read())
+            c.close()
+            ttft_ms.append(resp["ttft_ms"])
+            toks.setdefault("plain", []).append(resp["tokens"])
+        for i in range(n):
+            t0 = time.perf_counter()
+            c, r = post({"tokens": prompt, "max_new_tokens": steps,
+                         "temperature": 1.0, "seed": i,
+                         "stream": True})
+            if r.status != 200:
+                raise RuntimeError(
+                    f"stream request {i} rejected: {r.status} "
+                    f"{r.read()!r}")
+            parser = sse.SSEParser()
+            events = []
+            while not any(k == "token" for k, _ in events):
+                data = r.read1(256)
+                if not data:  # error stream / EOF before any token
+                    raise RuntimeError(
+                        f"stream {i} ended without a token event: "
+                        f"{events}")
+                events.extend(parser.feed(data))
+            ttfb_ms.append((time.perf_counter() - t0) * 1e3)
+            while True:
+                data = r.read1(4096)
+                if not data:
+                    break
+                events.extend(parser.feed(data))
+            c.close()
+            toks.setdefault("stream", []).append(
+                [p["token"] for k, p in events if k == "token"])
+    finally:
+        srv.stop(drain_timeout=10)
+    snap = eng.metrics.streamed_ttfb.snapshot()
+    return {
+        "stream_ttfb_ms_p50": round(float(np.percentile(ttfb_ms, 50)), 3),
+        "stream_ttfb_ms_p99": round(float(np.percentile(ttfb_ms, 99)), 3),
+        "nonstream_ttft_ms_p50":
+            round(float(np.percentile(ttft_ms, 50)), 3),
+        "nonstream_ttft_ms_p99":
+            round(float(np.percentile(ttft_ms, 99)), 3),
+        # server-side first-event histogram (arrival -> wire)
+        "stream_ttfb_server_mean_s": snap["mean"],
+        "stream_equal_output_tokens": toks["plain"] == toks["stream"],
+        "streamed_tokens": eng.metrics.streamed_tokens.value,
+    }
+
+
 def _router_mode(args, cfg) -> None:
     """Open-loop benchmark through the replicated front tier: N
     replica PROCESSES behind the join-shortest-queue router, the same
@@ -818,6 +951,8 @@ def _engine_mode(args, T, cfg, params) -> None:
     pab = None if args.overlap_only else _ab_paged(args, cfg, params)
     tab = None if args.overlap_only else _ab_tracing(args, cfg, params)
     sab = None if args.overlap_only else _ab_spec(args, T, cfg)
+    smab = None if args.overlap_only else _ab_sampled(args, cfg, params)
+    stab = _ab_stream(args, cfg, params) if args.stream else None
 
     engine, snap = over["engine"], over["snap"]
     ttft = snap["ttft_seconds"]
@@ -881,6 +1016,10 @@ def _engine_mode(args, T, cfg, params) -> None:
         result.update(tab)
     if sab is not None:
         result.update(sab)
+    if smab is not None:
+        result.update(smab)
+    if stab is not None:
+        result.update(stab)
 
     # Static-batch reference at B = n_slots: the closed-loop ceiling the
     # engine is measured against (same cfg, full batch decoding in
@@ -946,6 +1085,16 @@ def _engine_mode(args, T, cfg, params) -> None:
               f"{sab['spec_acceptance_rate']}, "
               f"{sab['spec_tokens_per_tick_mean']:.2f} tok/tick) | "
               f"adversarial {sab['spec_adversarial_ratio']}x")
+    if smab is not None:
+        print(f"sampled  {smab['decode_tok_s_sampled']:9.1f} tok/s vs "
+              f"{smab['decode_tok_s_greedy']:9.1f} greedy -> "
+              f"{smab['sampled_vs_greedy_ratio']}x "
+              f"({smab['sampling_recompiles']} recompiles)")
+    if stab is not None:
+        print(f"stream   TTFB p50 {stab['stream_ttfb_ms_p50']}ms "
+              f"p99 {stab['stream_ttfb_ms_p99']}ms vs non-stream TTFT "
+              f"p50 {stab['nonstream_ttft_ms_p50']}ms "
+              f"p99 {stab['nonstream_ttft_ms_p99']}ms")
     print(f"static   B={B} {result['static_batch_decode_tok_s']:9.1f} "
           f"tok/s (closed-loop ceiling)")
     print(json.dumps(result))
@@ -996,6 +1145,11 @@ def main() -> None:
     ap.add_argument("--overlap-only", action="store_true",
                     help="engine mode: skip the synchronous-baseline "
                          "run (no overlap A/B, no tracing A/B)")
+    ap.add_argument("--stream", action="store_true",
+                    help="engine mode: add the SSE streaming leg — "
+                         "client-observed TTFB p50/p99 (first token "
+                         "event on the wire) vs non-streamed TTFT on "
+                         "the same closed-loop HTTP workload")
     ap.add_argument("--trace", default="",
                     help="engine mode: record the open-loop run as a "
                          "Perfetto/Chrome trace at this path (plus "

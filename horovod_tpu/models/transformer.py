@@ -911,7 +911,8 @@ def ngram_propose(hist, pos, k: int):
 
 
 def decode_verify_paged(params: Dict, window, pool: Dict, table,
-                        cfg: TransformerConfig, active, spec_on=None):
+                        cfg: TransformerConfig, active, spec_on=None,
+                        sample=None):
     """One batched W-position VERIFY forward over a paged cache — the
     speculative tick's target-model half.
 
@@ -941,6 +942,16 @@ def decode_verify_paged(params: Dict, window, pool: Dict, table,
     may come to own.  ``spec_on`` (optional (S,) bool) forces
     ``acc = 0`` for opted-out slots — they emit exactly the one greedy
     token per tick through the same executable.
+
+    ``sample`` (optional ``(temperature, top_k, top_p, rng)`` per-slot
+    columns — :func:`sample_token_rows`): rows with ``temperature > 0``
+    replace the offset-0 token with a SAMPLED pick from the same
+    logits (key index ``pos + 1``, the token's logical position — the
+    identical schedule the plain tick and the oracle use) and have
+    ``acc`` forced to 0: drafts are verified by argmax agreement, so a
+    sampled stream never accepts them — it emits exactly one sampled
+    token per tick through this executable, which is what lets mixed
+    sampled/greedy-speculating batches share the program.
 
     Returns ``(target_tokens (S, W) int32, max_logits (S, W) f32,
     accepted (S,) int32, updated pool)`` with ``pos`` advanced by
@@ -1018,6 +1029,16 @@ def decode_verify_paged(params: Dict, window, pool: Dict, table,
     acc = jnp.cumprod(match, axis=1).sum(axis=1)  # agreeing prefix len
     if spec_on is not None:
         acc = jnp.where(spec_on, acc, 0)
+    if sample is not None:
+        # Sampled rows: offset 0 becomes the sampled pick (same logits,
+        # same key schedule as the plain tick), and acc is forced to 0
+        # — argmax-verified drafts are never valid for a sampled
+        # stream, whatever the host-side mask said.
+        temp, s_tk, s_tp, s_rng = sample
+        s0 = sample_token_rows(logits[:, 0, :], temp, s_tk, s_tp, s_rng,
+                               pos + 1, jnp.zeros((S,), jnp.int32))
+        t = t.at[:, 0].set(jnp.where(temp > 0.0, s0, t[:, 0]))
+        acc = jnp.where(temp > 0.0, 0, acc)
     acc = jnp.where(active, acc, 0)
 
     # Accepted-only scatter: window offset j lands at logical position
@@ -1222,16 +1243,90 @@ def prefill(params: Dict, prompt, cache: Dict, cfg: TransformerConfig,
     return logits[:, 0], cache
 
 
+def sample_token_rows(logits, temperature, top_k, top_p, rng, positions,
+                      rows):
+    """Pick one token per row with EVERY sampling parameter as DATA —
+    the serving engine's per-slot sampling kernel, and the math
+    :func:`sample_decode` (the per-request oracle) is defined by.  One
+    compiled executable serves any mix of greedy / temperature / top-k
+    / top-p rows: the parameters are columns, not structure, so request
+    churn never recompiles the decode tick.
+
+    ``logits``: (R, V) float32.  ``temperature``: (R,) f32 — ``<= 0``
+    is greedy argmax (raw logits), exactly the scalar ``temperature=0``
+    case.  ``top_k``: (R,) int32 — ``> 0`` restricts sampling to the k
+    most likely tokens (``0`` = off; the k-th value comes from a full
+    descending sort so k is data, matching ``lax.top_k``'s k-th value
+    bit-for-bit).  ``top_p``: (R,) f32 — nucleus sampling: keep the
+    smallest probability-sorted set whose cumulative mass reaches
+    ``top_p`` (ties at the threshold are kept; ``0`` or ``>= 1`` =
+    off), applied AFTER top-k on the temperature-scaled distribution.
+
+    PRNG schedule (the contract resume/failover identity hangs on):
+    the token at logical sequence position ``p`` of batch row ``r``
+    draws from ``fold_in(fold_in(rng[r], p), r)``.  Keys are a pure
+    function of (seed, position, row) — NOT of how generation was
+    sliced across prefills — so re-prefilling ``prompt + emitted`` and
+    continuing lands on the identical key stream: restart-resume,
+    router failover, and the engine/oracle A/B all compose by
+    construction.  ``rng``: (R, 2) uint32 base keys; ``positions``:
+    (R,) int32; ``rows``: (R,) int32 (the engine passes zeros — each
+    slot is row 0 of its own per-request oracle call)."""
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # Greedy rows divide by 1.0 (their sampled value is discarded by
+    # the final where, but NaN/Inf from a 0-division must never enter
+    # the softmax); sampled rows divide by their exact temperature.
+    scaled = logits / jnp.where(temperature > 0.0, temperature,
+                                1.0)[:, None]
+    srt = jnp.sort(scaled, axis=-1)[:, ::-1]            # descending
+    kth = jnp.take_along_axis(srt, (jnp.clip(top_k, 1, V) - 1)[:, None],
+                              axis=1)
+    scaled = jnp.where((top_k > 0)[:, None] & (scaled < kth),
+                       -jnp.inf, scaled)
+    probs = jax.nn.softmax(scaled, axis=-1)
+    ps = jnp.sort(probs, axis=-1)[:, ::-1]
+    csum = jnp.cumsum(ps, axis=-1)
+    # Sorted index i is in the nucleus iff the mass BEFORE it is still
+    # under top_p (index 0 always is); the smallest kept probability
+    # becomes the threshold, so threshold ties stay in.
+    keep = (csum - ps) < top_p[:, None]
+    thr = jnp.min(jnp.where(keep, ps, jnp.inf), axis=-1, keepdims=True)
+    p_on = (top_p > 0.0) & (top_p < 1.0)
+    scaled = jnp.where(p_on[:, None] & (probs < thr), -jnp.inf, scaled)
+
+    def pick(key, pos, row, lrow):
+        key = jax.random.fold_in(jax.random.fold_in(key, pos), row)
+        return jax.random.categorical(key, lrow)
+
+    sampled = jax.vmap(pick)(rng, positions, rows, scaled)
+    return jnp.where(temperature > 0.0, sampled.astype(jnp.int32), greedy)
+
+
 def sample_decode(params: Dict, prompt, steps: int, cfg: TransformerConfig,
                   *, rng, temperature: float = 1.0, top_k: int = 0,
+                  top_p: float = 0.0,
                   cache_shardings: Optional[Dict] = None):
     """Extend a (B, S0) prompt by ``steps`` SAMPLED tokens -> (B, steps).
 
     One batched :func:`prefill` forward fills the cache, then ``steps``
     compiled :func:`decode_step` calls generate.  ``temperature`` scales
     the logits; ``top_k > 0`` restricts sampling to the k most likely
-    tokens (clamped to the vocabulary).  ``temperature=0`` is greedy
-    (:func:`greedy_decode` is exactly that case).
+    tokens (clamped to the vocabulary); ``top_p`` in (0, 1) keeps the
+    nucleus — the smallest top-probability set whose mass reaches
+    ``top_p`` — applied after top-k.  ``temperature=0`` is greedy
+    (:func:`greedy_decode` is exactly that case).  The per-token pick
+    is :func:`sample_token_rows` with every parameter broadcast to a
+    column, which is what makes this THE per-request oracle for the
+    serving engine's vectorized per-slot sampling.
+
+    PRNG schedule: token ``i`` of row ``b`` (logical position
+    ``S0 + i``) draws from ``fold_in(fold_in(rng, S0 + i), b)`` — keys
+    depend on the token's absolute position, not the step count, so
+    ``sample_decode(prompt + emitted, rng=same)`` continues the exact
+    stream an interrupted call would have produced (the resume /
+    failover identity the serving stack leans on).  Rows draw
+    independent streams via the row fold.
 
     ``cache_shardings``: optional dict of ``NamedSharding`` matching
     :func:`cache_specs` — pins the KV cache's head dim over a ``tp``
@@ -1246,25 +1341,21 @@ def sample_decode(params: Dict, prompt, steps: int, cfg: TransformerConfig,
             for k, v in cache.items()
         }
     logits, cache = prefill(params, prompt, cache, cfg)
+    temp_col = jnp.full((B,), temperature, jnp.float32)
+    tk_col = jnp.full((B,), top_k, jnp.int32)
+    tp_col = jnp.full((B,), top_p, jnp.float32)
+    keys = jnp.broadcast_to(jnp.asarray(rng, jnp.uint32), (B, 2))
+    rows = jnp.arange(B, dtype=jnp.int32)
 
-    def pick(logits, key):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        scaled = logits / temperature
-        if top_k > 0:
-            k = min(top_k, cfg.vocab_size)
-            kth = lax.top_k(scaled, k)[0][:, -1:]
-            scaled = jnp.where(scaled >= kth, scaled, -jnp.inf)
-        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-
-    def gen(carry, key):
+    def gen(carry, pos):
         cache, logits = carry
-        tok = pick(logits, key)
+        tok = sample_token_rows(logits, temp_col, tk_col, tp_col, keys,
+                                jnp.full((B,), pos, jnp.int32), rows)
         logits, cache = decode_step(params, tok, cache, cfg)
         return (cache, logits), tok
 
-    keys = jax.random.split(rng, steps)
-    _, toks = lax.scan(gen, (cache, logits), keys)
+    _, toks = lax.scan(gen, (cache, logits),
+                       jnp.arange(S0, S0 + steps, dtype=jnp.int32))
     return jnp.moveaxis(toks, 0, 1)
 
 

@@ -58,6 +58,14 @@ from horovod_tpu.serving.metrics import (
     Histogram,
     ServingMetrics,
 )
+from horovod_tpu.serving.sampling import (
+    SamplingParams,
+    SlotSampling,
+)
+from horovod_tpu.serving.sse import (
+    SSEParser,
+    event_bytes,
+)
 from horovod_tpu.serving.scheduler import (
     CacheOutOfPagesError,
     DeadlineExceededError,
@@ -84,6 +92,7 @@ __all__ = [
     "FaultInjector", "FaultSpec", "InjectedFaultError",
     "JournalEntry", "RequestJournal",
     "Counter", "Gauge", "Histogram", "ServingMetrics",
+    "SamplingParams", "SlotSampling", "SSEParser", "event_bytes",
     "CacheOutOfPagesError", "DeadlineExceededError", "DrainingError",
     "EngineFailedError", "EngineStalledError", "QueueFullError",
     "Request", "RequestTooLongError", "Scheduler", "ServingError",

@@ -111,6 +111,8 @@ from horovod_tpu.serving.cache import (  # noqa: F401
 from horovod_tpu.serving.faults import FaultInjector
 from horovod_tpu.serving.journal import RequestJournal
 from horovod_tpu.serving.metrics import ServingMetrics
+from horovod_tpu.serving.sampling import SlotSampling, seed_key
+from horovod_tpu.serving.sampling import validate as validate_sampling
 from horovod_tpu.serving.scheduler import (
     CacheOutOfPagesError,
     DrainingError,
@@ -444,6 +446,11 @@ class _PrefixEntry:
     tokens: tuple
     pages: Optional[List[int]] = None
     first_token: int = 0
+    #: the prefix's last-position LOGITS (device (V,) array), kept so a
+    #: SAMPLED prompt-is-the-prefix admission can draw its first token
+    #: from them (the greedy first token alone is not enough — each
+    #: sampled sharer picks with its own key).
+    logits: Optional[object] = None
     epoch: int = -1
 
 
@@ -568,7 +575,8 @@ class InferenceEngine:
                 dcfg = draft_cfg
 
                 def _tick(params, dparams, tokens, active, spec_on,
-                          table, dtable, pool, dpool):
+                          table, dtable, pool, dpool, s_t, s_k, s_p,
+                          s_key):
                     self._decode_traces += 1
                     obs_tracing.record_compile("serving_decode")
                     # Draft pos follows the TARGET pos at tick entry
@@ -583,7 +591,7 @@ class InferenceEngine:
                                              axis=1)
                     t, mx, acc, pool = T.decode_verify_paged(
                         params, window, pool, table, self.cfg, active,
-                        spec_on)
+                        spec_on, sample=(s_t, s_k, s_p, s_key))
                     # Draft rollback on rejection = reset pos to the
                     # committed depth; the rejected tail's stale draft
                     # K/V is overwritten before it is ever attended
@@ -596,7 +604,7 @@ class InferenceEngine:
                 self._tick_fn = jax.jit(_tick, donate_argnums=(7, 8))
             else:
                 def _tick(params, tokens, active, spec_on, table, pool,
-                          hist):
+                          hist, s_t, s_k, s_p, s_key):
                     self._decode_traces += 1
                     obs_tracing.record_compile("serving_decode")
                     pos = pool["pos"]
@@ -612,7 +620,7 @@ class InferenceEngine:
                                              axis=1)
                     t, mx, acc, pool = T.decode_verify_paged(
                         params, window, pool, table, self.cfg, active,
-                        spec_on)
+                        spec_on, sample=(s_t, s_k, s_p, s_key))
                     # Accepted drafts are now committed history too.
                     j = jnp.arange(1, K + 1, dtype=jnp.int32)[None, :]
                     wp = pos[:, None] + j
@@ -634,37 +642,49 @@ class InferenceEngine:
             # verify for nothing.  Both executables are warmed by
             # warmup(); per-slot acceptance and the mask are data, so
             # the compile count stays constant at two.
-            def _ptick(params, tokens, active, table, pool):
+            def _ptick(params, tokens, active, table, pool, s_t, s_k,
+                       s_p, s_key):
                 self._decode_traces += 1
                 obs_tracing.record_compile("serving_decode")
+                pos = pool["pos"]
                 logits, pool = T.decode_step_paged(
                     params, tokens, pool, table, self.cfg, active)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                nxt = self._pick(logits, pos, s_t, s_k, s_p, s_key)
                 mx = jnp.max(logits, axis=-1)
                 return jnp.where(active, nxt, 0), mx, pool
 
             self._plain_tick_fn = jax.jit(_ptick, donate_argnums=(4,))
             donate = None
         elif engine_cfg.paged:
-            def _tick(params, tokens, active, table, pool):
+            def _tick(params, tokens, active, table, pool, s_t, s_k,
+                      s_p, s_key):
                 self._decode_traces += 1
                 obs_tracing.record_compile("serving_decode")
+                pos = pool["pos"]
                 logits, pool = T.decode_step_paged(
                     params, tokens, pool, table, self.cfg, active)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # The sampled pick — per-slot temperature/top-k/top-p
+                # COLUMNS and PRNG key ROWS, all data: greedy rows
+                # (temperature 0) are the argmax of old, sampled rows
+                # draw with the position-folded key, and no parameter
+                # mix ever retraces this body (the zero-recompile
+                # guard covers sampling now too).
+                nxt = self._pick(logits, pos, s_t, s_k, s_p, s_key)
                 mx = jnp.max(logits, axis=-1)
                 return jnp.where(active, nxt, 0), mx, pool
 
             donate = 4
         else:
-            def _tick(params, tokens, active, cache):
+            def _tick(params, tokens, active, cache, s_t, s_k, s_p,
+                      s_key):
                 self._decode_traces += 1
                 # Runs once per (re)trace: this IS a compile event —
                 # count it and mark it on the active trace/timeline.
                 obs_tracing.record_compile("serving_decode")
+                pos = cache["pos"]
                 logits, cache = T.decode_step_slots(
                     params, tokens, cache, self.cfg, active)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                nxt = self._pick(logits, pos, s_t, s_k, s_p, s_key)
                 # Per-slot max logit rides along for the host-side
                 # finiteness check: NaN/Inf logits (bad params, flaky
                 # hardware) must become a typed engine failure, not
@@ -767,6 +787,25 @@ class InferenceEngine:
         self._merge_tokens = jax.jit(
             lambda toks, vals, mask: jnp.where(mask, vals, toks))
 
+        # Per-slot sampling columns (serving/sampling.py): temperature /
+        # top_k / top_p / PRNG key rows ride the tick as DATA — one
+        # executable for every parameter mix, greedy = temperature-0
+        # rows.  _first_sample picks an admission group's FIRST tokens
+        # from the prefill logits with the same kernel (jit caches per
+        # (k, vocab) shape — warmed by warmup(), counted separately
+        # from the prefill compile set).
+        self._samp = SlotSampling(engine_cfg.n_slots)
+        self._sample_traces = 0
+
+        def _first_sample(logits, s_t, s_k, s_p, s_key, positions):
+            self._sample_traces += 1
+            obs_tracing.record_compile("serving_sample")
+            return T.sample_token_rows(
+                logits, s_t, s_k, s_p, s_key, positions,
+                jnp.zeros_like(positions))
+
+        self._first_sample = jax.jit(_first_sample)
+
         # Token-rate window for achieved FLOP/s: (monotonic, tokens)
         # samples taken at each stats() call, pruned to ~60s — the
         # scrape cadence defines the window, no hot-path cost.
@@ -845,8 +884,25 @@ class InferenceEngine:
                trace_id: Optional[str] = None,
                parent_span: Optional[str] = None,
                sampled: bool = False,
-               speculative: Optional[bool] = None) -> GenerationFuture:
+               speculative: Optional[bool] = None,
+               temperature: float = 0.0,
+               top_k: int = 0,
+               top_p: float = 0.0,
+               seed: Optional[int] = None) -> GenerationFuture:
         """Queue a generation request; returns its future.
+
+        ``temperature`` / ``top_k`` / ``top_p`` / ``seed`` select
+        per-request SAMPLING (serving/sampling.py; validated here,
+        :class:`ServingError` on bad values).  ``temperature=0`` (the
+        default) is greedy; a sampled request's token stream is
+        token-identical to ``sample_decode`` at the same seed/params —
+        including across restart-resume and router failover, because
+        the PRNG key schedule depends only on (seed, token position).
+        All of it rides the ONE compiled tick as per-slot data; no
+        parameter mix recompiles anything.  On a speculative engine a
+        sampled request decodes one token per tick through the same
+        executable (drafts are verified by argmax agreement, which a
+        sampled stream never satisfies).
 
         ``speculative`` is the per-request opt-out on a speculative
         engine (None = engine default): ``False`` pins the request to
@@ -889,6 +945,8 @@ class InferenceEngine:
         prompt = [int(t) for t in prompt]
         n_new = (max_new_tokens if max_new_tokens is not None
                  else self.engine_cfg.default_max_new_tokens)
+        temperature, top_k, top_p, seed = validate_sampling(
+            temperature, top_k, top_p, seed)
         if not prompt:
             raise ServingError("empty prompt")
         if n_new < 1:
@@ -920,7 +978,8 @@ class InferenceEngine:
         fut._spans = obs_tracing.spans()
         req = Request(prompt=prompt, max_new_tokens=n_new, future=fut,
                       eos_id=eos_id, deadline=deadline, trace=fut.trace,
-                      speculative=speculative)
+                      speculative=speculative, temperature=temperature,
+                      top_k=top_k, top_p=top_p, seed=seed)
         if self.journal is not None:
             # Journal BEFORE the enqueue, purge-on-resolve wired first:
             # every resolution path (retire, typed error, cancel,
@@ -1000,6 +1059,7 @@ class InferenceEngine:
         the draft free heap) and the opt-out mask (reset to the engine
         default for the next tenant)."""
         self.slots.free(slot)
+        self._samp.clear(slot)  # greedy/zero row for the next tenant
         self._spec_host[slot] = True
         # The adaptive live/idle state deliberately SURVIVES the
         # tenancy: acceptance is a property of the workload, and on
@@ -1101,6 +1161,9 @@ class InferenceEngine:
             self.slots.land_raw(pages, pre, p0)
             self.metrics.host_syncs.inc()  # the argmax fetch blocks
             entry.first_token = int(jnp.argmax(logits[0]))  # cold sync
+            # Kept on device for sampled prompt-is-the-prefix sharers:
+            # each draws its own first token from these logits.
+            entry.logits = logits[0]
         except BaseException:
             # Unpin on ANY failure (compile OOM, device fault at the
             # blocking sync): without this the pages leak at refcount 1
@@ -1321,7 +1384,12 @@ class InferenceEngine:
         if not self._spec:
             return
         for slot, req in zip(slots, reqs):
-            self._spec_host[slot] = req.speculative is not False
+            # A SAMPLED request never speculates: drafts are verified
+            # by argmax agreement, which a sampled stream would reject
+            # every tick — the kernel also forces its acceptance to 0
+            # as defense in depth, this just skips paying for drafts.
+            self._spec_host[slot] = (req.speculative is not False
+                                     and req.temperature <= 0.0)
         if not self._spec_model:
             # FULL-WIDTH rows: zero the whole row, not just the prompt
             # bucket — a previous tenant's committed tokens beyond the
@@ -1507,6 +1575,18 @@ class InferenceEngine:
         draft.land([s], pre, lens, start=0)
         return True
 
+    @staticmethod
+    def _pick(logits, pos, s_t, s_k, s_p, s_key):
+        """The ONE in-tick next-token pick, shared by every tick body:
+        the token being chosen sits at logical position ``pos + 1``
+        (``pos`` = the pool position at tick ENTRY — the input token's
+        slot), so its PRNG key is ``fold_in(fold_in(key, pos + 1), 0)``
+        — exactly the per-request ``sample_decode`` oracle's schedule
+        for row 0 (tests/test_sampling.py).  Greedy rows short to
+        argmax inside the kernel."""
+        return T.sample_token_rows(logits, s_t, s_k, s_p, s_key,
+                                   pos + 1, jnp.zeros_like(pos))
+
     def _run_tick(self, tokens_dev, active_dev):
         """Dispatch ONE compiled decode tick.  Returns ``(next-token
         device vector, pending extras)`` — the extras are what
@@ -1515,6 +1595,7 @@ class InferenceEngine:
         target-token window ``nxt`` ``(S, W)``, ``mx`` ``(S, W)``, the
         per-slot accepted length ``acc`` ``(S,)``, and the dispatch-
         time speculation mask."""
+        s_t, s_k, s_p, s_key = self._samp.device()
         if self._spec:
             if not self._dev_spec_host.any():
                 # Nobody speculating this tick: the plain one-token
@@ -1524,7 +1605,8 @@ class InferenceEngine:
                 self._spec_stale |= self.slots.active_mask()
                 nxt, mx, cache = self._plain_tick_fn(
                     self.params, tokens_dev, active_dev,
-                    self._dev_table, self.slots.cache)
+                    self._dev_table, self.slots.cache,
+                    s_t, s_k, s_p, s_key)
                 self.slots.cache = cache
                 return nxt, {"nxt": nxt, "mx": mx}
             if self._spec_model:
@@ -1532,13 +1614,13 @@ class InferenceEngine:
                     self.params, self.draft_params, tokens_dev,
                     active_dev, self._dev_spec, self._dev_table,
                     self._dev_dtable, self.slots.cache,
-                    self.draft_slots.cache)
+                    self.draft_slots.cache, s_t, s_k, s_p, s_key)
                 self.draft_slots.cache = dpool
             else:
                 nxt, t, mx, acc, pool, hist = self._tick_fn(
                     self.params, tokens_dev, active_dev, self._dev_spec,
                     self._dev_table, self.slots.cache,
-                    self._history())
+                    self._history(), s_t, s_k, s_p, s_key)
                 self._dev_history = hist
             self.slots.cache = pool
             return nxt, {"nxt": t, "mx": mx, "acc": acc,
@@ -1546,10 +1628,11 @@ class InferenceEngine:
         if self.engine_cfg.paged:
             nxt, mx, cache = self._tick_fn(
                 self.params, tokens_dev, active_dev, self._dev_table,
-                self.slots.cache)
+                self.slots.cache, s_t, s_k, s_p, s_key)
         else:
             nxt, mx, cache = self._tick_fn(
-                self.params, tokens_dev, active_dev, self.slots.cache)
+                self.params, tokens_dev, active_dev, self.slots.cache,
+                s_t, s_k, s_p, s_key)
         self.slots.cache = cache
         return nxt, {"nxt": nxt, "mx": mx}
 
@@ -1714,6 +1797,27 @@ class InferenceEngine:
             b *= 2
         return min(b, self.slots.max_len)
 
+    def _first_tokens(self, reqs: List[Request], logits) -> np.ndarray:
+        """An admission group's FIRST tokens from its prefill logits —
+        the prefill IS the first decode step.  All-greedy groups keep
+        the plain argmax fetch; any sampled member routes the whole
+        group through the shared sampling kernel (greedy rows still
+        argmax inside it), each row drawing with its own seed at key
+        index ``len(prompt)`` — for a RESUMED request the prompt
+        already includes the emitted tokens, so the index continues
+        the stream exactly where the last life stopped."""
+        if all(r.temperature <= 0.0 for r in reqs):
+            return np.asarray(jnp.argmax(logits, axis=-1))
+        k = len(reqs)
+        temp = np.array([r.temperature for r in reqs], np.float32)
+        tk = np.array([r.top_k for r in reqs], np.int32)
+        tp = np.array([r.top_p for r in reqs], np.float32)
+        keys = np.stack([seed_key(r.seed) for r in reqs])
+        pos = np.array([len(r.prompt) for r in reqs], np.int32)
+        return np.asarray(self._first_sample(
+            logits, jnp.asarray(temp), jnp.asarray(tk), jnp.asarray(tp),
+            jnp.asarray(keys), jnp.asarray(pos)))
+
     def _admit_batch(self, reqs: List[Request]) -> None:
         """ONE bucketed batch-K prefill admits the whole group (the
         burst-TTFT lever: K prompts cost one forward pass, not K) ->
@@ -1756,6 +1860,13 @@ class InferenceEngine:
                 if req.trace.first_token_at is None:
                     req.trace.first_token_at = now
             self.metrics.admitted.inc()
+            # The slot's sampling columns land BEFORE the next decode
+            # dispatch (step() admits first) — an async re-upload of
+            # four (S,)-rows, no sync.  Greedy requests write zeros,
+            # which IS the greedy row.
+            self._samp.set(slot, temperature=req.temperature,
+                           top_k=req.top_k, top_p=req.top_p,
+                           seed=req.seed)
             self._states[slot] = _SlotState(request=req,
                                             last_token=int(first),
                                             n_generated=0)
@@ -1793,7 +1904,7 @@ class InferenceEngine:
             assert slot is not None  # take() is bounded by free_count
             slots.append(slot)
         self.slots.insert_batch(slots, pre_cache)
-        firsts = np.asarray(jnp.argmax(logits, axis=-1))  # one sync for K
+        firsts = self._first_tokens(reqs, logits)  # one sync for K
         return slots, reqs, firsts
 
     def _map_pages(self, slot: int, req: Request,
@@ -1862,11 +1973,19 @@ class InferenceEngine:
             suf_lens = np.asarray([len(r.prompt) - p0 for r in live],
                                   np.int32)
             if int(suf_lens.max()) == 0:
-                # The prompt IS the prefix: its K/V and first greedy
-                # token already exist — admission is pure bookkeeping.
+                # The prompt IS the prefix: its K/V already exists —
+                # admission is pure bookkeeping, and GREEDY sharers
+                # reuse the cached first token.  SAMPLED sharers each
+                # draw their own first token from the prefix's cached
+                # last-position logits (one kernel call, same (k, V)
+                # executable as a regular sampled admission).
                 self.slots.set_pos(slots, [p0] * k)
-                firsts = np.asarray([entry.first_token] * k)
-                synced = False
+                if any(r.temperature > 0.0 for r in live):
+                    firsts = self._first_tokens(live, jnp.broadcast_to(
+                        entry.logits, (k, entry.logits.shape[-1])))
+                else:
+                    firsts = np.asarray([entry.first_token] * k)
+                    synced = False
             else:
                 bucket = self._bucket(int(suf_lens.max()))
                 padded = np.zeros((k, bucket), np.int32)
@@ -1878,7 +1997,7 @@ class InferenceEngine:
                     jnp.asarray(suf_lens), pk, pv, jnp.int32(p0))
                 self._prefill_calls += 1
                 self.slots.land(slots, suf, suf_lens, start=p0)
-                firsts = np.asarray(jnp.argmax(logits, axis=-1))
+                firsts = self._first_tokens(live, logits)
         else:
             bucket = max(self._bucket(len(r.prompt)) for r in live)
             padded = np.zeros((k, bucket), np.int32)
@@ -1890,7 +2009,7 @@ class InferenceEngine:
                 self.params, jnp.asarray(padded), jnp.asarray(lens))
             self._prefill_calls += 1
             self.slots.land(slots, pre, lens, start=0)
-            firsts = np.asarray(jnp.argmax(logits, axis=-1))
+            firsts = self._first_tokens(live, logits)
         for slot, req in zip(slots, live):
             self._page_pos[slot] = len(req.prompt)
         self._spec_admit(slots, live)
@@ -2241,16 +2360,26 @@ class InferenceEngine:
             fut._finish("length")  # bookkeeping was lost — finish now
             self.metrics.completed.inc()
             return None
-        # Greedy decode is a pure function of the token sequence, so
-        # prefilling prompt + emitted and continuing yields output
-        # token-identical to an uninterrupted run.  The ORIGINAL id is
+        # Decode — greedy AND sampled (the PRNG key schedule is a pure
+        # function of seed + token position) — is a pure function of
+        # the token sequence, so prefilling prompt + emitted and
+        # continuing yields output token-identical to an uninterrupted
+        # run.  The ORIGINAL id is
         # kept: it is the journal key, and it preserves the request's
         # FCFS age (preemption picks victims by id — surviving a crash
         # must not mark old work as young).
         new = Request(prompt=list(entry.prompt) + list(entry.emitted),
                       max_new_tokens=entry.remaining, future=fut,
                       eos_id=entry.eos_id, deadline=req.deadline,
-                      trace=req.trace, speculative=req.speculative)
+                      trace=req.trace, speculative=req.speculative,
+                      # Sampling params survive verbatim: the key
+                      # schedule is position-based, so the re-prefill
+                      # of prompt + emitted continues the exact stream
+                      # (first resumed token draws at key index
+                      # len(prompt + emitted) — the next unwritten
+                      # position).
+                      temperature=entry.temperature, top_k=entry.top_k,
+                      top_p=entry.top_p, seed=entry.seed)
         new.id = req.id
         new.submitted_at = req.submitted_at
         # Wasted work = tokens RE-prefilled that were already computed
@@ -2295,6 +2424,10 @@ class InferenceEngine:
         self._dev_dtable = None
         self._dtable_uploaded = -1
         self._dev_history = None
+        # Sampling columns: zero the host rows and drop the device
+        # copy (it belonged to the dead lineage); re-admissions — the
+        # resume path included — repopulate before the next dispatch.
+        self._samp.reset()
 
     def _fail_queue(self, exc: BaseException) -> None:
         for req in self.scheduler.drain_pending():
@@ -2589,6 +2722,16 @@ class InferenceEngine:
                         for _ in range(k)]
                 while not all(f.done() for f in futs):
                     self.step()
+        # Sampled admissions compile the (k, vocab) first-token sampler
+        # (the tick executables already contain the sampling kernel —
+        # parameters are data — so only this admission-side shape set
+        # needs warming; one sampled group per k covers it).
+        for k in range(1, kmax + 1):
+            futs = [self.submit(prompts[0], max_new_tokens=2,
+                                temperature=1.0, seed=i)
+                    for i in range(k)]
+            while not all(f.done() for f in futs):
+                self.step()
         if self._spec:
             # The speculative engine owns TWO decode executables — the
             # draft/verify tick and the plain one-token tick it falls
@@ -2752,6 +2895,11 @@ class InferenceEngine:
             "decode_compilations": self._decode_traces,
             "prefill_compilations": self._prefill_traces,
             "prefill_calls": self._prefill_calls,
+            # The admission-side first-token sampler's compile count
+            # ((k, vocab) shapes, warmed by warmup()) — the decode
+            # guard stays on decode_compilations: sampling parameters
+            # are data and never retrace the tick.
+            "sample_compilations": self._sample_traces,
             # (bucket, batch) shape pairs the prefill has compiled for
             # — bounded by buckets x max_prefills_per_tick.
             "prefill_buckets": sorted(self._prefill_fns),

@@ -83,6 +83,14 @@ class JournalEntry:
     #: stamps it on the resume edge — the resumed attempt links into
     #: the same cross-process trace tree as the dead one.
     span_id: Optional[str] = None
+    #: sampling parameters (serving/sampling.py) — a resume must decode
+    #: with the ORIGINAL knobs and seed: the PRNG key schedule is
+    #: position-based, so ``prompt + emitted`` at the same seed
+    #: continues the exact token stream.
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    seed: int = 0
     emitted: List[int] = dataclasses.field(default_factory=list)
     resumes: int = 0
 
@@ -147,16 +155,32 @@ class RequestJournal:
             max_new_tokens=req.max_new_tokens, eos_id=req.eos_id,
             deadline=req.deadline, expires_at=expires,
             trace_id=req.trace.trace_id if req.trace is not None else None,
-            span_id=req.trace.span_id if req.trace is not None else None)
+            span_id=req.trace.span_id if req.trace is not None else None,
+            temperature=getattr(req, "temperature", 0.0),
+            top_k=getattr(req, "top_k", 0),
+            top_p=getattr(req, "top_p", 0.0),
+            seed=getattr(req, "seed", 0))
         with self._lock:
             self._entries[req.id] = entry
-            self._write({"e": "b", "id": entry.id, "trace": entry.trace_id,
-                         "span": entry.span_id,
-                         "prompt": list(entry.prompt),
-                         "max_new": entry.max_new_tokens,
-                         "eos": entry.eos_id,
-                         "expires_at": entry.expires_at})
+            self._write(self._begin_line(entry))
         return entry
+
+    @staticmethod
+    def _begin_line(entry: JournalEntry) -> Dict:
+        """The ONE shape of a begin record (begin + compaction write
+        it; :meth:`read_live` parses it).  Sampling keys are written
+        only when non-default, keeping greedy journals byte-compatible
+        with pre-sampling readers."""
+        line = {"e": "b", "id": entry.id, "trace": entry.trace_id,
+                "span": entry.span_id,
+                "prompt": list(entry.prompt),
+                "max_new": entry.max_new_tokens,
+                "eos": entry.eos_id,
+                "expires_at": entry.expires_at}
+        if entry.temperature > 0.0:
+            line["samp"] = [entry.temperature, entry.top_k,
+                            entry.top_p, entry.seed]
+        return line
 
     def append(self, rid: int, tok: int) -> None:
         """Record one EMITTED token (no-op for an already-ended entry —
@@ -232,14 +256,8 @@ class RequestJournal:
         try:
             with open(tmp, "w", encoding="utf-8") as f:
                 for entry in self._entries.values():
-                    f.write(json.dumps(
-                        {"e": "b", "id": entry.id, "trace": entry.trace_id,
-                         "span": entry.span_id,
-                         "prompt": list(entry.prompt),
-                         "max_new": entry.max_new_tokens,
-                         "eos": entry.eos_id,
-                         "expires_at": entry.expires_at},
-                        separators=(",", ":")) + "\n")
+                    f.write(json.dumps(self._begin_line(entry),
+                                       separators=(",", ":")) + "\n")
                     for tok in entry.emitted:
                         f.write(json.dumps({"e": "t", "id": entry.id,
                                             "t": tok},
@@ -278,13 +296,16 @@ class RequestJournal:
                 continue  # torn write at the kill instant
             e, rid = ev.get("e"), ev.get("id")
             if e == "b":
+                samp = ev.get("samp") or [0.0, 0, 0.0, 0]
                 live[rid] = JournalEntry(
                     id=rid, prompt=tuple(ev.get("prompt") or ()),
                     max_new_tokens=int(ev.get("max_new") or 0),
                     eos_id=ev.get("eos"),
                     expires_at=ev.get("expires_at"),
                     trace_id=ev.get("trace"),
-                    span_id=ev.get("span"))
+                    span_id=ev.get("span"),
+                    temperature=float(samp[0]), top_k=int(samp[1]),
+                    top_p=float(samp[2]), seed=int(samp[3]))
             elif e == "t" and rid in live:
                 live[rid].emitted.append(int(ev["t"]))
             elif e == "r" and rid in live:
@@ -300,5 +321,11 @@ class RequestJournal:
                 "prompt": list(entry.prompt),
                 "max_new_tokens": entry.max_new_tokens,
                 "eos_id": entry.eos_id,
+                # Informational for the failover path: the router
+                # re-dispatches the ORIGINAL request body (which
+                # carries the sampling fields) — the position-based
+                # key schedule makes the continuation automatic.
+                "temperature": entry.temperature,
+                "seed": entry.seed,
             }
         return out

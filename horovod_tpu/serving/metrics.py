@@ -180,6 +180,22 @@ class ServingMetrics:
             "Accepted/drafted ratio per slot per speculative tick",
             buckets=(0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875,
                      1.0))
+        # Streaming transport (docs/serving.md "HTTP API"): per-token
+        # SSE delivery, cancel-on-disconnect, and the user-facing
+        # latency number streaming exists to improve — time to the
+        # FIRST STREAMED TOKEN EVENT on the wire (vs ttft, which stops
+        # at the engine emitting it).
+        self.streamed_tokens = r.counter(
+            "serving_streamed_tokens_total",
+            "Tokens delivered as SSE token events (stream=true)")
+        self.disconnects = r.counter(
+            "serving_disconnects_total",
+            "Streaming clients that vanished mid-stream (request "
+            "cancelled, slot/pages reclaimed within one tick)")
+        self.streamed_ttfb = r.histogram(
+            "serving_streamed_ttfb_seconds",
+            "Request arrival to first streamed token event on the "
+            "wire (the honest user-facing TTFT for stream=true)")
         self.model_flops_per_token = r.gauge(
             "serving_model_flops_per_token",
             "Configured model FLOPs per generated token "
@@ -221,6 +237,9 @@ class ServingMetrics:
             "spec_acceptance_ratio":
                 round(self.spec_accepted.value / self.spec_drafted.value,
                       4) if self.spec_drafted.value else None,
+            "streamed_tokens": self.streamed_tokens.value,
+            "disconnects": self.disconnects.value,
+            "streamed_ttfb_seconds": self.streamed_ttfb.snapshot(),
             "host_syncs": self.host_syncs.value,
             "host_syncs_per_tick":
                 round(self.host_syncs.value / ticks, 4) if ticks else None,

@@ -35,6 +35,22 @@ deadline that expires mid-failover resolves as the same typed 504 the
 replicas use.  Only the paid-for work moves; nothing is generated
 twice, nothing is dropped.
 
+STREAMING (``"stream": true`` — docs/serving.md "Sampling +
+streaming"): the replica's chunked SSE body is proxied through
+event-by-event with trace headers intact, token indices kept GLOBAL
+across failovers.  A replica that dies mid-stream (connection death,
+or an in-band ``error`` event carrying a resume descriptor) is failed
+over like the non-streamed path — the journal/descriptor tells the
+router every token the dead replica emitted, the continuation is
+dispatched as ``prompt + frontier`` with the remaining budgets, and
+the client's stream continues WITHOUT re-emitting anything it already
+received (tokens the dead replica journaled but never got onto the
+wire are synthesized by the router first, then the survivor's events
+follow).  The terminal ``done`` event carries the full concatenated
+token list (``resumed: true``), byte-identical to an uninterrupted
+run.  A client that disconnects mid-stream tears down the upstream
+leg, which cancels the request on the replica within one tick.
+
 Endpoints:
 
 * ``POST /generate`` — proxied with failover, as above.  Adds
@@ -77,6 +93,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
 from horovod_tpu.obs import tracing as obs_tracing
+from horovod_tpu.serving import sse
 from horovod_tpu.serving.journal import RequestJournal
 from horovod_tpu.serving.router.registry import ReplicaRegistry
 
@@ -203,6 +220,32 @@ class _RouterHandler(BaseHTTPRequestHandler):
         finally:
             conn.close()
 
+    def _proxy_open(self, status_ep, body: bytes,
+                    trace_id: Optional[str], timeout: float,
+                    parent_span: Optional[str] = None,
+                    force_sample: bool = False):
+        """Open one attempt and return ``(conn, resp)`` WITHOUT reading
+        the body — the streaming variant of :meth:`_proxy_once` (the
+        caller forwards the SSE body incrementally and must close the
+        connection).  Raises :class:`_ProxyError` on connection-level
+        failure before any response line arrived."""
+        ep = status_ep.endpoint
+        conn = http.client.HTTPConnection(ep.host, ep.port,
+                                          timeout=timeout)
+        try:
+            headers = {"Content-Type": "application/json"}
+            if trace_id:
+                headers[obs_tracing.TRACE_ID_HEADER] = trace_id
+                if parent_span:
+                    headers[obs_tracing.PARENT_SPAN_HEADER] = parent_span
+                if force_sample:
+                    headers[obs_tracing.SAMPLED_HEADER] = "1"
+            conn.request("POST", "/generate", body=body, headers=headers)
+            return conn, conn.getresponse()
+        except (OSError, socket.timeout, http.client.HTTPException) as e:
+            conn.close()
+            raise _ProxyError(f"replica {ep.rid}: {e}") from e
+
     def do_POST(self):
         router: "RouterServer" = self.server.router
         registry = router.registry
@@ -270,6 +313,12 @@ class _RouterHandler(BaseHTTPRequestHandler):
         resumable = (isinstance(body_obj, dict)
                      and isinstance(body_obj.get("tokens"), list)
                      and isinstance(body_obj.get("max_new_tokens"), int))
+        if isinstance(body_obj, dict) and body_obj.get("stream"):
+            self._generate_stream(router, registry, metrics, body,
+                                  body_obj, resumable, trace_id, rec,
+                                  root_sid, client_sampled,
+                                  client_parent)
+            return
         carried: list = []
         remaining_ms: Optional[float] = None
         absorbed_at: float = 0.0
@@ -281,6 +330,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
             # path gets this for free (remaining computed at read
             # time); the inline-descriptor path must age it here, or
             # every crash-hop would extend the request's wall budget.
+            # TWIN: _generate_stream has the streamed variant of this
+            # carry machinery (frontier vs carried; wire already
+            # partially sent) — budget/descriptor semantics changed
+            # here must change there too.
             if remaining_ms is None:
                 return None
             return remaining_ms - (time.monotonic() - absorbed_at) * 1e3
@@ -529,6 +582,467 @@ class _RouterHandler(BaseHTTPRequestHandler):
             "attempts": attempts,
         }, headers={"Retry-After": str(router.retry_after),
                     obs_tracing.TRACE_ID_HEADER: trace_id})
+
+    def _generate_stream(self, router, registry, metrics, body,
+                         body_obj, resumable, trace_id, rec, root_sid,
+                         client_sampled=False, client_parent=None):
+        """``POST /generate`` with ``"stream": true`` — proxy the
+        replica's SSE body through event-by-event, failing over
+        MID-STREAM without re-emitting anything the client already has
+        (module docstring).
+
+        The carry is a FRONTIER: every token any replica is known to
+        have emitted, in order.  ``sent`` counts token events on the
+        client's wire — always a prefix of the frontier (the journal
+        may know tokens that never reached the wire; the router
+        synthesizes their events before forwarding a survivor).
+        TWIN: ``_generate`` holds the non-streamed variant of this
+        carry machinery — budget/descriptor semantics changed here
+        must change there too (the differences are deliberate: the
+        frontier keeps the LONGER of events-seen vs journal, and a
+        non-resumable body can only retry before the first wire
+        event).  Each
+        attempt is dispatched with ``prompt + frontier`` and the
+        remaining budgets; the position-keyed sampling PRNG makes the
+        continuation token-identical for sampled requests too."""
+        frontier: list = []       # every token any replica emitted
+        sent = 0                  # token events on the client's wire
+        remaining_ms: Optional[float] = None
+        absorbed_at = 0.0
+        carried_from: Optional[str] = None
+        headers_sent = False
+
+        class _ClientGone(Exception):
+            """The CLIENT hung up — distinct from upstream death, so
+            the failover loop cannot mistake one for the other."""
+
+        def current_remaining_ms() -> Optional[float]:
+            if remaining_ms is None:
+                return None
+            return remaining_ms - (time.monotonic() - absorbed_at) * 1e3
+
+        def deadline_expired() -> bool:
+            rem = current_remaining_ms()
+            return rem is not None and rem <= 0.0
+
+        def dispatch_body() -> bytes:
+            rem = current_remaining_ms()
+            # A non-resumable body (no token list / no int
+            # max_new_tokens) can never be rewritten — and the loop
+            # below guarantees it is only ever re-dispatched before
+            # the first token event reached the client.
+            if not resumable or (not frontier and rem is None):
+                return body
+            obj = dict(body_obj)
+            obj["tokens"] = list(body_obj["tokens"]) + frontier
+            obj["max_new_tokens"] = \
+                body_obj["max_new_tokens"] - len(frontier)
+            if rem is not None:
+                obj["timeout_ms"] = max(1.0, rem)
+            return json.dumps(obj).encode()
+
+        def absorb(base: list, desc, rid=None, source=None) -> None:
+            """Fold a dead attempt's resume descriptor into the
+            frontier.  ``base`` is what the attempt was DISPATCHED
+            with; the descriptor's ``emitted_tokens`` are the
+            attempt's own share, appended after it.  The journal's
+            view is a superset of what we saw as events, never a
+            contradiction — keep whichever is longer."""
+            nonlocal remaining_ms, absorbed_at, carried_from
+            if not resumable or not isinstance(desc, dict):
+                return
+            if desc.get("span_id"):
+                carried_from = desc["span_id"]
+            toks = desc.get("emitted_tokens")
+            if isinstance(toks, list):
+                cand = list(base) + [int(t) for t in toks]
+                if len(cand) > len(frontier):
+                    frontier[:] = cand
+                if rec is not None and toks:
+                    attrs = {"carried": len(frontier)}
+                    if rid:
+                        attrs["from_replica"] = rid
+                    if source:
+                        attrs["source"] = source
+                    if desc.get("span_id"):
+                        attrs["resumed_from_span"] = desc["span_id"]
+                    rec.event(trace_id, root_sid, "resume", attrs)
+            rem = desc.get("deadline_remaining_ms")
+            if rem is not None:
+                remaining_ms = float(rem)
+                absorbed_at = time.monotonic()
+
+        def send_headers(rid: Optional[str], attempts: int) -> None:
+            nonlocal headers_sent
+            if headers_sent:
+                return
+            headers_sent = True
+            self._sent_code = 200
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header(obs_tracing.TRACE_ID_HEADER, trace_id)
+            if rid:
+                self.send_header("X-Router-Replica", rid)
+            self.send_header("X-Router-Attempts", str(attempts))
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            self.close_connection = True  # the stream owns the socket
+
+        def emit(kind, payload) -> None:
+            data = sse.event_bytes(kind, payload)
+            try:
+                self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+            except OSError as e:
+                raise _ClientGone() from e
+
+        def emit_token(tok: int, text=None) -> None:
+            nonlocal sent
+            ev = {"i": sent, "token": int(tok)}
+            if text is not None:
+                ev["text"] = text
+            emit("token", ev)
+            sent += 1
+
+        def catch_up() -> None:
+            # Tokens the journal proved emitted but the client never
+            # received (the dead replica was killed between journaling
+            # and the socket): synthesize their events — ids only,
+            # ids are the authoritative cross-replica representation.
+            while sent < len(frontier):
+                emit_token(frontier[sent])
+
+        def finish_chunks() -> None:
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+
+        def carry_reason() -> Optional[str]:
+            # The frontier may already BE the full result (the dead
+            # replica emitted its last token but never finished the
+            # stream) — re-dispatching would 400 or decode past EOS.
+            if not (resumable and frontier):
+                return None
+            eos = body_obj.get("eos_id")
+            if eos is not None and frontier[-1] == eos:
+                return "eos"
+            if len(frontier) >= body_obj["max_new_tokens"]:
+                return "length"
+            return None
+
+        def finish_from_frontier(reason: str, attempts: int) -> None:
+            send_headers(None, attempts)
+            catch_up()
+            metrics.resume_failovers.inc()
+            emit("done", {"tokens": list(frontier),
+                          "finish_reason": reason,
+                          "resumed": True,
+                          "resume_carried_tokens": len(frontier),
+                          "trace_id": trace_id})
+            finish_chunks()
+
+        def track_root(attempts: int) -> None:
+            self._root_attrs.update({
+                "attempts": attempts, "streamed": True,
+                "carried_tokens": len(frontier),
+                "resumed": bool(frontier)})
+
+        tried = set()
+        attempts = 0
+        failed_over = False
+        last: Optional[Tuple[int, bytes, Dict[str, str]]] = None
+        try:
+            while attempts < router.max_attempts:
+                rep = registry.pick(exclude=tried)
+                if rep is None and tried:
+                    rep = registry.pick()  # a respawn may have rejoined
+                if rep is None:
+                    break
+                if attempts:
+                    metrics.retries.inc()
+                    if rec is not None:
+                        rec.event(trace_id, root_sid, "retry",
+                                  {"attempt": attempts + 1,
+                                   "replica": rep.endpoint.rid})
+                    time.sleep(min(
+                        router.retry_backoff * (2.0 ** (attempts - 1)),
+                        router.retry_backoff_max))
+                attempts += 1
+                tried.add(rep.endpoint.rid)
+                track_root(attempts)
+                att_sid = None
+                if rec is not None:
+                    att_sid = rec.begin(
+                        f"attempt {attempts} -> {rep.endpoint.rid}",
+                        trace_id, parent=root_sid,
+                        attrs={"replica": rep.endpoint.rid,
+                               "streamed": True,
+                               **({"carried_tokens": len(frontier)}
+                                  if frontier else {})})
+                dispatched = list(frontier)
+                t0 = time.monotonic()
+                try:
+                    conn, resp = self._proxy_open(
+                        rep, dispatch_body(), trace_id,
+                        router.proxy_timeout,
+                        parent_span=att_sid or client_parent,
+                        force_sample=(client_sampled or bool(frontier)
+                                      or failed_over))
+                except _ProxyError:
+                    metrics.proxy_latency.observe(time.monotonic() - t0)
+                    registry.mark_failed(rep.endpoint.rid)
+                    failed_over = True
+                    if rec is not None:
+                        rec.finish(att_sid, status="error:connection")
+                        rec.event(trace_id, root_sid, "failover",
+                                  {"replica": rep.endpoint.rid,
+                                   "attempt": attempts})
+                    absorb(dispatched,
+                           router.lookup_resume(rep.endpoint, trace_id),
+                           rid=rep.endpoint.rid, source="journal")
+                    track_root(attempts)
+                    reason = carry_reason()
+                    if reason is not None:
+                        finish_from_frontier(reason, attempts)
+                        return
+                    if deadline_expired():
+                        break
+                    continue
+                status = resp.status
+                ctype = resp.getheader("Content-Type") or ""
+                if status != 200 or "text/event-stream" not in ctype:
+                    # A pre-stream answer: submit-time rejection (the
+                    # replica never started the SSE body) — exactly the
+                    # non-streamed retry/relay protocol.
+                    payload = resp.read()
+                    hdrs = {}
+                    for h in (obs_tracing.TRACE_ID_HEADER,
+                              "Retry-After"):
+                        v = resp.getheader(h)
+                        if v is not None:
+                            hdrs[h] = v
+                    conn.close()
+                    metrics.proxy_latency.observe(time.monotonic() - t0)
+                    if rec is not None:
+                        rec.finish(att_sid, status=f"http:{status}")
+                    if status in RETRYABLE_STATUS:
+                        last = (status, payload, hdrs)
+                        try:
+                            absorb(dispatched,
+                                   json.loads(payload).get("resume"),
+                                   rid=rep.endpoint.rid,
+                                   source="descriptor")
+                        except (json.JSONDecodeError, AttributeError):
+                            pass
+                        track_root(attempts)
+                        reason = carry_reason()
+                        if reason is not None:
+                            finish_from_frontier(reason, attempts)
+                            return
+                        if deadline_expired():
+                            break
+                        continue
+                    if not headers_sent:
+                        hdrs.setdefault(obs_tracing.TRACE_ID_HEADER,
+                                        trace_id)
+                        hdrs["X-Router-Replica"] = rep.endpoint.rid
+                        hdrs["X-Router-Attempts"] = str(attempts)
+                        self._relay(status, payload, hdrs)
+                        return
+                    # Mid-stream continuation met a non-retryable
+                    # answer (e.g. the remaining deadline lapsed into
+                    # a 504): surface it in-band and end the stream.
+                    try:
+                        obj = json.loads(payload)
+                    except json.JSONDecodeError:
+                        obj = {}
+                    emit("error", {
+                        "type": obj.get("type", f"http_{status}"),
+                        "error": obj.get("error",
+                                         f"replica answered {status}"),
+                        "trace_id": trace_id})
+                    finish_chunks()
+                    return
+                # 200 text/event-stream: forward it.
+                if attempts > 1:
+                    metrics.failovers.inc()
+                metrics.proxy_latency.observe(time.monotonic() - t0)
+                send_headers(rep.endpoint.rid, attempts)
+                catch_up()
+                parser = sse.SSEParser()
+                outcome = None  # "done" | "error" | ("failover", desc)
+                try:
+                    while outcome is None:
+                        # read1, not read: read(n) BLOCKS until n bytes
+                        # accumulate, which would buffer the live
+                        # stream into one burst — read1 returns as
+                        # soon as the current chunk has data, so each
+                        # token event forwards the moment it lands.
+                        data = resp.read1(4096)
+                        if not data:
+                            break  # EOF before a terminal event
+                        for kind, ev in parser.feed(data):
+                            if kind == "token" and "token" in ev:
+                                tok = int(ev["token"])
+                                frontier.append(tok)
+                                # text pieces survive only unresumed
+                                # streams: a continuation replica only
+                                # detokenized its own share, and a
+                                # spliced text stream would lie.
+                                emit_token(tok,
+                                           None if dispatched
+                                           else ev.get("text"))
+                            elif kind == "done":
+                                out = dict(ev)
+                                out["tokens"] = dispatched + [
+                                    int(t)
+                                    for t in (ev.get("tokens") or [])]
+                                out.setdefault("trace_id", trace_id)
+                                if dispatched:
+                                    out.pop("text", None)
+                                    out["resumed"] = True
+                                    out["resume_carried_tokens"] = \
+                                        len(dispatched)
+                                    metrics.resume_failovers.inc()
+                                emit("done", out)
+                                outcome = "done"
+                                break
+                            elif kind == "error":
+                                if (ev.get("type") == "engine_failed"
+                                        and resumable
+                                        and attempts
+                                        < router.max_attempts):
+                                    # The replica's engine died under
+                                    # the stream and said so, resume
+                                    # descriptor attached: fail over.
+                                    outcome = ("failover",
+                                               ev.get("resume"))
+                                else:
+                                    out = dict(ev)
+                                    out.setdefault("trace_id", trace_id)
+                                    emit("error", out)
+                                    outcome = "error"
+                                break
+                except (OSError, socket.timeout,
+                        http.client.HTTPException):
+                    outcome = None  # connection death mid-stream
+                finally:
+                    conn.close()
+                if outcome in ("done", "error"):
+                    if rec is not None:
+                        rec.finish(att_sid, status=f"sse:{outcome}")
+                    finish_chunks()
+                    return
+                failed_over = True
+                if isinstance(outcome, tuple):
+                    if rec is not None:
+                        rec.finish(att_sid, status="sse:engine_failed")
+                    absorb(dispatched, outcome[1],
+                           rid=rep.endpoint.rid, source="descriptor")
+                else:
+                    registry.mark_failed(rep.endpoint.rid)
+                    if rec is not None:
+                        rec.finish(att_sid, status="error:connection")
+                        rec.event(trace_id, root_sid, "failover",
+                                  {"replica": rep.endpoint.rid,
+                                   "attempt": attempts})
+                    absorb(dispatched,
+                           router.lookup_resume(rep.endpoint, trace_id),
+                           rid=rep.endpoint.rid, source="journal")
+                track_root(attempts)
+                if not resumable and sent:
+                    # The client already has token events and the body
+                    # cannot express a continuation: a retry would
+                    # re-emit from scratch (duplicates on the wire).
+                    # End the stream with a terminal error instead.
+                    emit("error", {
+                        "type": "stream_interrupted",
+                        "error": "replica died mid-stream and the "
+                                 "request body is not resumable (a "
+                                 "token-list prompt and integer "
+                                 "max_new_tokens are required)",
+                        "trace_id": trace_id, "attempts": attempts})
+                    finish_chunks()
+                    return
+                reason = carry_reason()
+                if reason is not None:
+                    finish_from_frontier(reason, attempts)
+                    return
+                if deadline_expired():
+                    break
+
+            track_root(attempts)
+            if deadline_expired():
+                if headers_sent:
+                    catch_up()
+                    emit("error", {
+                        "type": "deadline_exceeded",
+                        "error": "deadline expired during failover",
+                        "tokens_so_far": list(frontier),
+                        "trace_id": trace_id, "attempts": attempts})
+                    finish_chunks()
+                else:
+                    self._json(504, {
+                        "error": "deadline expired during failover",
+                        "type": "deadline_exceeded",
+                        "trace_id": trace_id, "attempts": attempts,
+                        "tokens_so_far": frontier,
+                    }, headers={obs_tracing.TRACE_ID_HEADER: trace_id,
+                                "X-Router-Attempts": str(attempts)})
+                return
+            metrics.requests_failed.inc()
+            if headers_sent:
+                # Out of options with the stream already open: one
+                # terminal in-band error, full-frontier resume
+                # descriptor attached (a stacked front tier can
+                # continue from it).
+                catch_up()
+                err = {"type": "no_replicas",
+                       "error": "no replica reachable after "
+                                f"{attempts} attempt(s)",
+                       "trace_id": trace_id, "attempts": attempts}
+                if frontier:
+                    err["resume"] = {
+                        "emitted_tokens": list(frontier),
+                        "deadline_remaining_ms": current_remaining_ms(),
+                        "span_id": carried_from}
+                emit("error", err)
+                finish_chunks()
+                return
+            if last is not None:
+                status, payload, hdrs = last
+                if frontier:
+                    try:
+                        obj = json.loads(payload)
+                        obj["resume"] = {
+                            "emitted_tokens": list(frontier),
+                            "deadline_remaining_ms":
+                                current_remaining_ms(),
+                            "span_id": carried_from}
+                        payload = json.dumps(obj).encode()
+                    except (json.JSONDecodeError, AttributeError):
+                        pass
+                hdrs.setdefault(obs_tracing.TRACE_ID_HEADER, trace_id)
+                hdrs.setdefault("Retry-After", str(router.retry_after))
+                hdrs["X-Router-Attempts"] = str(attempts)
+                self._relay(status, payload, hdrs)
+                return
+            self._json(503, {
+                "error": "no replica in rotation"
+                         if not attempts else
+                         f"no replica reachable after {attempts} "
+                         f"attempt(s)",
+                "type": "no_replicas",
+                "trace_id": trace_id, "attempts": attempts,
+            }, headers={"Retry-After": str(router.retry_after),
+                        obs_tracing.TRACE_ID_HEADER: trace_id})
+        except _ClientGone:
+            # The CLIENT hung up mid-stream: the per-attempt finally
+            # already closed the upstream leg, which cancels the
+            # request on the replica (its own disconnect handling) —
+            # nothing more to send, just give the socket back.
+            self.close_connection = True
 
     @staticmethod
     def _merge_resumed(payload: bytes, carried: list, metrics) -> bytes:

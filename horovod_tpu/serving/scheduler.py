@@ -104,7 +104,21 @@ class Request:
     # zero as data) — output is identical either way, this is a
     # latency-predictability knob, not a correctness one.
     speculative: Optional[bool] = None
+    # Per-request sampling (horovod_tpu/serving/sampling.py; validated
+    # at submit): temperature=0 is greedy — the default, and what every
+    # pre-sampling caller gets.  The engine rides these through the
+    # compiled tick as per-slot data columns; a resumed request keeps
+    # them verbatim (the PRNG key schedule is position-based, so the
+    # re-prefilled continuation lands on the identical key stream).
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+    seed: int = 0
     id: int = dataclasses.field(default_factory=lambda: next(_req_ids))
+
+    @property
+    def sampled(self) -> bool:
+        return self.temperature > 0.0
 
 
 class Scheduler:

@@ -8,16 +8,36 @@ many-callers-one-controller shape, over HTTP).
 Endpoints:
 
 * ``POST /generate`` — body ``{"tokens": [...], "max_new_tokens": N,
-  "eos_id": E?, "timeout_ms": T?, "speculative": bool?}`` (or
+  "eos_id": E?, "timeout_ms": T?, "speculative": bool?,
+  "temperature": f?, "top_k": K?, "top_p": p?, "seed": s?,
+  "stream": bool?}`` (or
   ``{"text": ...}`` when the
   server was built with an ``encode`` callable).  Replies ``{"tokens":
   [...], "finish_reason": ..., "ttft_ms": ...}`` (+ ``"text"`` with a
   detokenizer).  Typed rejections map to HTTP: queue full -> 429,
   too long -> 413, deadline -> 504, draining / engine failed -> 503,
-  bad request -> 400.  When no ``timeout_ms`` is sent, the request's
+  bad request -> 400 (including invalid sampling parameters).  When no
+  ``timeout_ms`` is sent, the request's
   engine deadline defaults to the server's ``request_timeout`` — every
   admitted request carries a deadline, so a vanished client can never
   pin a slot to ``max_new_tokens``.
+
+  ``temperature``/``top_k``/``top_p``/``seed`` select per-request
+  SAMPLING (temperature 0 = greedy, the default; docs/serving.md
+  "Sampling + streaming") — one compiled tick serves every mix, and a
+  fixed seed reproduces the stream across retries, restarts, and
+  failovers.  ``"stream": true`` switches the response to chunked
+  Server-Sent Events (``text/event-stream``): one ``token`` event per
+  retired token as the engine's overlapped pipeline emits it (one-tick
+  lag), then exactly one terminal ``done`` (same payload as the
+  non-streamed 200) or ``error`` (same payload as the typed-error
+  bodies, resume descriptor included) event — see
+  :mod:`horovod_tpu.serving.sse` for the exact frames.  A client that
+  disconnects mid-stream CANCELS its request: the engine reclaims the
+  slot and its KV pages on the next tick
+  (``serving_disconnects_total``).  Submit-time rejections arrive as
+  ordinary JSON error responses — the stream only starts once the
+  request is live.
 * ``GET /healthz`` — readiness keyed to the engine state machine:
   200 for ``healthy``/``degraded``, **503 for ``draining`` and
   ``failed``** so load balancers stop routing before teardown or after
@@ -46,6 +66,9 @@ SUCCESS AND on every typed-error path), alongside a per-request timing
 from __future__ import annotations
 
 import json
+import queue
+import select
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -53,6 +76,7 @@ from typing import Callable, Optional, Sequence
 
 from horovod_tpu.obs import tracing as obs_tracing
 from horovod_tpu.obs.registry import default_registry
+from horovod_tpu.serving import sse
 from horovod_tpu.serving.engine import DEGRADED, HEALTHY, InferenceEngine
 from horovod_tpu.serving.scheduler import (
     CacheOutOfPagesError,
@@ -211,6 +235,18 @@ class _Handler(BaseHTTPRequestHandler):
             }
 
         timeout_ms = req.get("timeout_ms")
+        stream = bool(req.get("stream"))
+        t_recv = time.monotonic()
+        tok_q: Optional[queue.Queue] = None
+        on_token = None
+        if stream:
+            # The engine thread must never block on a client socket:
+            # tokens cross to this handler thread through a queue, and
+            # the SSE writes happen here (bounded by max_new_tokens).
+            tok_q = queue.Queue()
+
+            def on_token(tok, piece, _q=tok_q):
+                _q.put((tok, piece))
         fut = None
         try:
             # Every request gets an engine deadline: the client's
@@ -225,13 +261,27 @@ class _Handler(BaseHTTPRequestHandler):
                 max_new_tokens=req.get("max_new_tokens"),
                 eos_id=req.get("eos_id"),
                 deadline=deadline,
+                on_token=on_token,
                 trace_id=trace_id,
                 parent_span=parent_span,
                 sampled=sampled,
                 # Per-request speculative opt-out ("speculative":
                 # false pins the request to one-token-per-tick greedy
                 # inside the same executable; output is identical).
-                speculative=req.get("speculative"))
+                speculative=req.get("speculative"),
+                # Per-request sampling (validated in submit; bad
+                # values land in the ServingError -> 400 path below).
+                temperature=req.get("temperature", 0.0),
+                top_k=req.get("top_k", 0),
+                top_p=req.get("top_p", 0.0),
+                seed=req.get("seed"))
+            if stream:
+                # The request is live: from here the response is the
+                # SSE stream (200 + chunked), errors included — it
+                # never raises back into the JSON error paths.
+                self._stream_response(engine, fut, trace_id, tok_q,
+                                      t_recv, deadline)
+                return
             # The engine's deadline retirement (partial result, reason
             # "deadline") should win over this hard HTTP timeout, which
             # only fires when the engine cannot retire (e.g. hung) —
@@ -292,6 +342,153 @@ class _Handler(BaseHTTPRequestHandler):
         if engine.detokenize is not None:
             payload["text"] = fut.text
         self._json(200, payload, trace_id=trace_id)
+
+    # -- SSE streaming (stream=true) ---------------------------------------
+
+    def _client_gone(self) -> bool:
+        """Peek the client socket between events: a readable socket
+        whose recv returns b"" is a half-closed connection — the
+        client hung up while we were decoding.  (A client PIPELINING
+        bytes reads as data, not a hangup.)"""
+        try:
+            r, _, _ = select.select([self.connection], [], [], 0)
+            if not r:
+                return False
+            return self.connection.recv(1, socket.MSG_PEEK) == b""
+        except (OSError, ValueError):
+            return True
+
+    def _stream_response(self, engine: InferenceEngine, fut, trace_id,
+                         tok_q: "queue.Queue", t_recv: float,
+                         deadline: float) -> None:
+        """Stream one live request as chunked SSE: token events as the
+        engine emits them (the overlap pipeline's one-tick lag — a
+        token event means the identity-checked, journaled emission
+        already happened), then exactly one terminal ``done``/``error``
+        event (:mod:`horovod_tpu.serving.sse`).
+
+        Client disconnect — detected on a failed event write OR by the
+        socket peek while idle between tokens — CANCELS the request:
+        the engine reclaims the slot and its pages on its next tick
+        (``serving_disconnects_total``).  The stream never raises back
+        into ``do_POST``'s JSON error paths: once the 200 is on the
+        wire, failures are in-band ``error`` events (engine failures
+        carry the same resume descriptor the non-streamed 503 does, so
+        a router can fail the stream over mid-flight)."""
+        metrics = engine.metrics
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header(obs_tracing.TRACE_ID_HEADER, trace_id)
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        # The stream owns this connection to the end — no keep-alive
+        # reuse after a mid-stream cancel/error could half-happen.
+        self.close_connection = True
+        budget = t_recv + self.server.request_timeout \
+            + self.server.timeout_grace
+        first = True
+        n_sent = 0
+
+        def emit(kind, payload) -> None:
+            data = sse.event_bytes(kind, payload)
+            self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+
+        def send_tok(tok, piece) -> None:
+            # The ONE token-event emitter (live loop + post-resolution
+            # drain): event shape, TTFB observation, and counters
+            # cannot drift between the two.
+            nonlocal first, n_sent
+            ev = {"i": n_sent, "token": int(tok)}
+            if piece is not None:
+                ev["text"] = piece
+            emit("token", ev)
+            if first:
+                first = False
+                metrics.streamed_ttfb.observe(time.monotonic() - t_recv)
+            n_sent += 1
+            metrics.streamed_tokens.inc()
+
+        try:
+            while True:
+                try:
+                    tok, piece = tok_q.get(timeout=0.05)
+                except queue.Empty:
+                    if fut.done():
+                        break
+                    if time.monotonic() > budget:
+                        # The hard HTTP timeout (engine hung past its
+                        # own deadline retirement): cancel and say so
+                        # in-band.
+                        fut.cancel()
+                        emit("error", {
+                            "type": "timeout",
+                            "error": "generation still in progress at "
+                                     "the server timeout",
+                            "trace_id": trace_id})
+                        self.wfile.write(b"0\r\n\r\n")
+                        return
+                    if self._client_gone():
+                        raise ConnectionAbortedError("client gone")
+                    continue
+                send_tok(tok, piece)
+            # Resolved: drain what the resolving emission already
+            # queued (tokens always land on the queue before the
+            # future resolves), then the one terminal event.
+            while True:
+                try:
+                    tok, piece = tok_q.get_nowait()
+                except queue.Empty:
+                    break
+                send_tok(tok, piece)
+            try:
+                out = fut.result(timeout=0)
+            except EngineFailedError as e:
+                # Same resume contract as the non-streamed 503: the
+                # router absorbs the descriptor and continues the
+                # stream on a surviving replica.
+                emit("error", {
+                    "type": "engine_failed", "error": str(e),
+                    "trace_id": trace_id,
+                    "resume": {
+                        "emitted_tokens": fut.tokens_so_far(),
+                        "deadline_remaining_ms": max(0.0, round(
+                            (deadline - time.monotonic()) * 1e3, 3)),
+                        "span_id": fut.trace.span_id
+                        if fut.trace is not None else None,
+                    }})
+            except DeadlineExceededError as e:
+                emit("error", {"type": "deadline_exceeded",
+                               "error": str(e), "trace_id": trace_id})
+            except CacheOutOfPagesError as e:
+                # Preempted mid-decode (pool exhausted): same type tag
+                # as the non-streamed 429, retryable elsewhere.
+                emit("error", {"type": "out_of_pages", "error": str(e),
+                               "trace_id": trace_id})
+            except ServingError as e:
+                emit("error", {"type": "error", "error": str(e),
+                               "trace_id": trace_id})
+            else:
+                payload = {
+                    "tokens": out,
+                    "finish_reason": fut.finish_reason,
+                    "ttft_ms": round(fut.ttft * 1e3, 3)
+                    if fut.ttft else None,
+                    "breakdown": fut.breakdown(),
+                    "trace_id": trace_id,
+                }
+                if engine.detokenize is not None:
+                    payload["text"] = fut.text
+                emit("done", payload)
+            self.wfile.write(b"0\r\n\r\n")
+        except OSError:
+            # Client disconnect (write failed, or the idle peek saw
+            # the hangup): cancel — the engine reclaims the slot and
+            # its pages on the next tick; the future resolves
+            # "cancelled" with the tokens so far, which also purges
+            # the journal entry.
+            if fut.cancel():
+                metrics.disconnects.inc()
 
 
 class ServingServer:

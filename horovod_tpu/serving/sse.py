@@ -1,0 +1,79 @@
+"""Server-Sent Events plumbing shared by the serving server (emit), the
+router (parse + re-emit across failovers), and the tests (client-side
+assertions) — ONE definition of the wire format so the three cannot
+drift.
+
+The stream a ``POST /generate`` with ``"stream": true`` returns
+(docs/serving.md "HTTP API"):
+
+* ``event: token`` — ``{"i": N, "token": ID}`` (+ ``"text"`` with a
+  detokenizer): one event per retired token, in order, ``i`` the
+  0-based GLOBAL index within the request (the router keeps it global
+  across failovers, so a client can detect gaps/dupes trivially).
+* ``event: done`` — the same payload shape as the non-streamed 200
+  body (``tokens`` — the full id list, authoritative — plus
+  ``finish_reason`` / ``ttft_ms`` / ``breakdown`` / ``trace_id``).
+* ``event: error`` — the same payload shape as the non-streamed typed
+  error body (``type`` / ``error`` / optional ``resume`` descriptor),
+  for failures AFTER the 200 + headers are already on the wire.
+
+Every stream ends with exactly one ``done`` OR one ``error`` event
+(the terminal event), carried over chunked transfer encoding.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+__all__ = ["SSEParser", "event_bytes", "read_stream"]
+
+
+def event_bytes(kind: str, payload: Dict) -> bytes:
+    """One SSE event frame: ``event: <kind>`` + one JSON ``data`` line."""
+    return (f"event: {kind}\ndata: "
+            f"{json.dumps(payload, separators=(',', ':'))}\n\n").encode()
+
+
+class SSEParser:
+    """Incremental SSE frame parser: feed raw body bytes (any chunking),
+    get completed ``(kind, payload)`` events out.  Unknown lines are
+    ignored (comments, retry hints); a frame with unparseable JSON data
+    surfaces as ``(kind, {"_raw": <text>})`` rather than killing the
+    stream — the consumer decides how loud to be."""
+
+    def __init__(self) -> None:
+        self._buf = b""
+
+    def feed(self, data: bytes) -> List[Tuple[str, Dict]]:
+        self._buf += data
+        out: List[Tuple[str, Dict]] = []
+        while b"\n\n" in self._buf:
+            frame, self._buf = self._buf.split(b"\n\n", 1)
+            kind, payload = "message", {}
+            for line in frame.decode("utf-8", "replace").splitlines():
+                if line.startswith("event:"):
+                    kind = line[len("event:"):].strip()
+                elif line.startswith("data:"):
+                    text = line[len("data:"):].strip()
+                    try:
+                        payload = json.loads(text)
+                    except json.JSONDecodeError:
+                        payload = {"_raw": text}
+            out.append((kind, payload))
+        return out
+
+
+def read_stream(resp, chunk: int = 4096) -> List[Tuple[str, Dict]]:
+    """Drain an ``http.client.HTTPResponse`` SSE body to completion —
+    the test/client convenience.  Uses ``read1`` (returns as soon as
+    the current chunk has data) so events arrive live; plain
+    ``read(n)`` would block until ``n`` bytes accumulate."""
+    parser = SSEParser()
+    events: List[Tuple[str, Dict]] = []
+    read1 = getattr(resp, "read1", None)
+    while True:
+        data = read1(chunk) if read1 is not None else resp.read(chunk)
+        if not data:
+            return events
+        events.extend(parser.feed(data))

@@ -1,0 +1,360 @@
+"""Per-slot vectorized sampling (horovod_tpu/serving/sampling.py +
+models/transformer.py:sample_token_rows).
+
+The gold check mirrors the engine's greedy story: whatever MIX of
+greedy / temperature / top-k / top-p requests shares the slot pool,
+each one's sampled stream must be token-identical to per-request
+``sample_decode`` at the same seed — the per-request oracle — with
+ZERO decode recompilations across the whole mix (sampling parameters
+are data, not structure).  The PRNG key schedule is position-based, so
+the same identity must survive a restart-resume (re-prefill of
+``prompt + emitted``) unchanged.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import serving
+from horovod_tpu.models import transformer as T
+from horovod_tpu.serving import sampling as S
+from horovod_tpu.serving.faults import FaultInjector, FaultSpec
+
+pytestmark = pytest.mark.serving
+
+
+def _cfg(**kw):
+    base = T.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=48, dtype=jnp.float32, attention_impl="reference",
+        n_kv_heads=2)
+    return dataclasses.replace(base, **kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return T.init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _oracle(params, cfg, prompt, steps, *, temperature=0.0, top_k=0,
+            top_p=0.0, seed=0):
+    return np.asarray(T.sample_decode(
+        params, jnp.asarray([prompt], jnp.int32), steps, cfg,
+        rng=jax.random.PRNGKey(seed), temperature=temperature,
+        top_k=top_k, top_p=top_p))[0].tolist()
+
+
+def _run(engine, futs, max_ticks=600):
+    for _ in range(max_ticks):
+        if all(f.done() for f in futs):
+            return
+        engine.step()
+    raise AssertionError("engine did not finish within the tick budget")
+
+
+# ---------------------------------------------------------------------------
+# kernel units
+# ---------------------------------------------------------------------------
+
+
+class TestSampleTokenRows:
+    def _logits(self, rows=4, vocab=32, seed=0):
+        return jax.random.normal(jax.random.PRNGKey(seed),
+                                 (rows, vocab)).astype(jnp.float32)
+
+    def _pick(self, logits, temp, tk, tp, seeds, positions):
+        r = logits.shape[0]
+        keys = jnp.asarray(np.stack([S.seed_key(s) for s in seeds]))
+        return np.asarray(T.sample_token_rows(
+            logits, jnp.asarray(temp, jnp.float32),
+            jnp.asarray(tk, jnp.int32), jnp.asarray(tp, jnp.float32),
+            keys, jnp.asarray(positions, jnp.int32),
+            jnp.zeros((r,), jnp.int32)))
+
+    def test_greedy_rows_are_argmax(self):
+        lg = self._logits()
+        out = self._pick(lg, [0.0] * 4, [0] * 4, [0.0] * 4,
+                         [1, 2, 3, 4], [5] * 4)
+        np.testing.assert_array_equal(out, np.argmax(np.asarray(lg), -1))
+
+    def test_top_k_one_is_argmax(self):
+        lg = self._logits()
+        out = self._pick(lg, [2.0] * 4, [1] * 4, [0.0] * 4,
+                         [7, 8, 9, 10], [3] * 4)
+        np.testing.assert_array_equal(out, np.argmax(np.asarray(lg), -1))
+
+    def test_top_p_tiny_is_argmax(self):
+        # The nucleus always keeps index 0 of the sorted order — a
+        # top_p below any single probability keeps ONLY the argmax.
+        lg = self._logits()
+        out = self._pick(lg, [1.0] * 4, [0] * 4, [1e-9] * 4,
+                         [7, 8, 9, 10], [3] * 4)
+        np.testing.assert_array_equal(out, np.argmax(np.asarray(lg), -1))
+
+    def test_top_k_masks_to_top_set(self):
+        lg = self._logits(rows=8, vocab=32, seed=3)
+        out = self._pick(lg, [5.0] * 8, [4] * 8, [0.0] * 8,
+                         list(range(8)), list(range(8)))
+        top4 = np.argsort(-np.asarray(lg), axis=-1)[:, :4]
+        for r in range(8):
+            assert out[r] in top4[r]
+
+    def test_deterministic_and_seed_sensitive(self):
+        lg = self._logits(rows=8)
+        a = self._pick(lg, [3.0] * 8, [0] * 8, [0.0] * 8,
+                       list(range(8)), [2] * 8)
+        b = self._pick(lg, [3.0] * 8, [0] * 8, [0.0] * 8,
+                       list(range(8)), [2] * 8)
+        np.testing.assert_array_equal(a, b)
+        c = self._pick(lg, [3.0] * 8, [0] * 8, [0.0] * 8,
+                       [s + 100 for s in range(8)], [2] * 8)
+        assert (a != c).any()  # different seeds, different draws
+        d = self._pick(lg, [3.0] * 8, [0] * 8, [0.0] * 8,
+                       list(range(8)), [3] * 8)
+        assert (a != d).any()  # different positions, different draws
+
+    def test_seed_key_matches_prngkey(self):
+        """The drift guard: the host-side key layout must equal the
+        real ``jax.random.PRNGKey`` for every legal seed."""
+        for seed in (0, 1, 42, 2**20 + 17, S.MAX_SEED - 1):
+            np.testing.assert_array_equal(
+                S.seed_key(seed), np.asarray(jax.random.PRNGKey(seed)))
+
+    def test_validate_rejects_bad_params(self):
+        with pytest.raises(serving.ServingError):
+            S.validate(temperature=-0.5)
+        with pytest.raises(serving.ServingError):
+            S.validate(temperature=float("nan"))
+        with pytest.raises(serving.ServingError):
+            S.validate(top_k=-1)
+        with pytest.raises(serving.ServingError):
+            S.validate(top_p=1.5)
+        with pytest.raises(serving.ServingError):
+            S.validate(seed=-1)
+        with pytest.raises(serving.ServingError):
+            S.validate(seed=S.MAX_SEED)
+        with pytest.raises(serving.ServingError):
+            S.validate(temperature="hot")
+        assert S.validate(1.0, 5, 0.9, 7) == (1.0, 5, 0.9, 7)
+        assert S.validate() == (0.0, 0, 0.0, 0)
+
+    def test_slot_sampling_upload_caching(self):
+        cols = serving.SlotSampling(3)
+        d1 = cols.device()
+        assert cols.device() is d1  # clean: cached
+        cols.set(1, temperature=0.8, top_k=3, top_p=0.9, seed=11)
+        d2 = cols.device()
+        assert d2 is not d1
+        assert float(d2[0][1]) == pytest.approx(0.8)
+        np.testing.assert_array_equal(np.asarray(d2[3][1]), [0, 11])
+        cols.clear(1)
+        assert float(cols.device()[0][1]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# the oracle itself
+# ---------------------------------------------------------------------------
+
+
+class TestSampleDecodeOracle:
+    def test_temperature_zero_is_greedy_with_top_p(self, model):
+        params, cfg = model
+        prompt = jnp.asarray([[3, 4, 5]], jnp.int32)
+        g = np.asarray(T.greedy_decode(params, prompt, 5, cfg))
+        s = np.asarray(T.sample_decode(
+            params, prompt, 5, cfg, rng=jax.random.PRNGKey(1),
+            temperature=0.0, top_p=0.9))
+        np.testing.assert_array_equal(g, s)
+
+    def test_continuation_identity(self, model):
+        """The resume/failover contract at the oracle level: sampling
+        ``prompt + first_half`` with the same rng continues the exact
+        stream — keys depend on token POSITION, not the prefill
+        split."""
+        params, cfg = model
+        kw = dict(rng=jax.random.PRNGKey(9), temperature=1.3, top_k=8,
+                  top_p=0.9)
+        prompt = jnp.asarray([[7, 8, 9]], jnp.int32)
+        full = np.asarray(T.sample_decode(params, prompt, 8, cfg, **kw))
+        head = np.asarray(T.sample_decode(params, prompt, 3, cfg, **kw))
+        grown = jnp.concatenate(
+            [prompt, jnp.asarray(head, jnp.int32)], axis=1)
+        tail = np.asarray(T.sample_decode(params, grown, 5, cfg, **kw))
+        np.testing.assert_array_equal(
+            np.concatenate([head, tail], axis=1), full)
+
+    def test_batch_rows_draw_independent_streams(self, model):
+        params, cfg = model
+        prompt = jnp.asarray([[3, 4, 5], [3, 4, 5]], jnp.int32)
+        out = np.asarray(T.sample_decode(
+            params, prompt, 8, cfg, rng=jax.random.PRNGKey(2),
+            temperature=1.5))
+        assert (out[0] != out[1]).any()
+
+
+# ---------------------------------------------------------------------------
+# the engine: mixed-parameter batches == per-request oracle
+# ---------------------------------------------------------------------------
+
+
+MIX = [
+    ([3, 4, 5], dict()),                                     # greedy
+    ([7, 8], dict(temperature=1.1, seed=5)),                 # temp only
+    ([1, 2, 3, 4], dict(temperature=0.7, top_k=5, seed=9)),  # top-k
+    ([9], dict(temperature=1.5, top_p=0.8, seed=13)),        # top-p
+]
+
+
+class TestEngineSampling:
+    @pytest.mark.perf
+    def test_mixed_batch_matches_oracle_zero_recompiles(self, model):
+        """THE acceptance property: one compiled decode executable
+        serves mixed greedy/temperature/top-k/top-p traffic, each
+        slot's stream token-identical to ``sample_decode`` at its own
+        seed, with zero decode recompiles across churn."""
+        params, cfg = model
+        eng = serving.InferenceEngine(params, cfg, serving.EngineConfig(
+            n_slots=4, max_len=32, tick_timeout=0))
+        eng.warmup([1, 4])
+        base = eng.decode_compilations
+        # two waves of churn over the same slots
+        for wave in range(2):
+            futs = [eng.submit(p, max_new_tokens=8, **kw)
+                    for p, kw in MIX]
+            _run(eng, futs)
+            for (p, kw), f in zip(MIX, futs):
+                assert f.result(1) == _oracle(params, cfg, p, 8, **kw), \
+                    f"wave {wave}, params {kw}"
+        assert eng.decode_compilations == base, \
+            "sampling parameter mix recompiled the decode tick"
+
+    def test_sync_and_contiguous_modes_match_oracle(self, model):
+        params, cfg = model
+        for ec in (serving.EngineConfig(n_slots=4, max_len=32,
+                                        overlap=False, tick_timeout=0),
+                   serving.EngineConfig(n_slots=4, max_len=32,
+                                        paged=False, tick_timeout=0)):
+            eng = serving.InferenceEngine(params, cfg, ec)
+            eng.warmup([1, 4])
+            futs = [eng.submit(p, max_new_tokens=6, **kw)
+                    for p, kw in MIX[:3]]
+            _run(eng, futs)
+            for (p, kw), f in zip(MIX, futs):
+                assert f.result(1) == _oracle(params, cfg, p, 6, **kw)
+
+    def test_sampled_prefix_sharers_draw_own_tokens(self, model):
+        """Attach-only admission (prompt == registered prefix) must
+        give each SAMPLED sharer its own first token from the cached
+        prefix logits — not the cached greedy token."""
+        params, cfg = model
+        eng = serving.InferenceEngine(params, cfg, serving.EngineConfig(
+            n_slots=4, max_len=32, tick_timeout=0))
+        eng.warmup([1, 4])
+        prefix = [5, 6, 7, 8]
+        eng.register_prefix(prefix)
+        futs = [eng.submit(prefix, max_new_tokens=6,
+                           temperature=1.4, seed=s) for s in (3, 17)]
+        futs.append(eng.submit(prefix, max_new_tokens=6))  # greedy
+        _run(eng, futs)
+        for s, f in zip((3, 17), futs[:2]):
+            assert f.result(1) == _oracle(params, cfg, prefix, 6,
+                                          temperature=1.4, seed=s)
+        assert futs[2].result(1) == _oracle(params, cfg, prefix, 6)
+        assert [f.result(1) for f in futs[:2]][0] != \
+            [f.result(1) for f in futs[:2]][1]
+
+    def test_restart_resume_keeps_sampled_stream(self, model):
+        """Crash mid-decode: resumed sampled output is token-identical
+        to an uninterrupted run — the journal carries the sampling
+        params and the position-keyed PRNG continues the stream."""
+        params, cfg = model
+        faults = FaultInjector()
+        eng = serving.InferenceEngine(params, cfg, serving.EngineConfig(
+            n_slots=4, max_len=32, tick_timeout=0, faults=faults))
+        eng.warmup([1, 4])
+        faults.add(FaultSpec(site="decode_tick", kind="raise",
+                             skip=faults.visits("decode_tick") + 4))
+        subs = [([3, 4, 5], dict(temperature=1.3, top_k=8, top_p=0.9,
+                                 seed=21)),
+                ([7, 8], dict(temperature=0.9, seed=4))]
+        futs = [eng.submit(p, max_new_tokens=10, **kw)
+                for p, kw in subs]
+        _run(eng, futs)
+        assert eng.metrics.resumed.value >= 1
+        for (p, kw), f in zip(subs, futs):
+            assert f.result(1) == _oracle(params, cfg, p, 10, **kw)
+
+    def test_speculative_mixed_sampled_and_greedy(self, model):
+        """On a speculative engine a sampled request emits exactly its
+        oracle stream (drafts never accepted for it — acceptance
+        forced to 0 as data) while greedy slots keep speculating; the
+        compile count stays at the spec engine's two executables."""
+        params, cfg = model
+        eng = serving.InferenceEngine(params, cfg, serving.EngineConfig(
+            n_slots=4, max_len=32, speculative=True, spec_k=3,
+            spec_draft="ngram", spec_adaptive=False, tick_timeout=0))
+        eng.warmup([1, 4])
+        base = eng.decode_compilations
+        subs = [([3, 4, 5], dict()),
+                ([7, 8], dict(temperature=1.1, seed=5)),
+                ([1, 2, 3, 4], dict(temperature=0.7, top_k=5, seed=9))]
+        futs = [eng.submit(p, max_new_tokens=8, **kw)
+                for p, kw in subs]
+        _run(eng, futs)
+        for (p, kw), f in zip(subs, futs):
+            assert f.result(1) == _oracle(params, cfg, p, 8, **kw)
+        assert eng.decode_compilations == base
+
+    def test_submit_validation_and_defaults(self, model):
+        params, cfg = model
+        eng = serving.InferenceEngine(params, cfg, serving.EngineConfig(
+            n_slots=2, max_len=32, tick_timeout=0))
+        with pytest.raises(serving.ServingError):
+            eng.submit([1], temperature=-1.0)
+        with pytest.raises(serving.ServingError):
+            eng.submit([1], top_p=2.0)
+        with pytest.raises(serving.ServingError):
+            eng.submit([1], seed=-5)
+
+
+# ---------------------------------------------------------------------------
+# journal round trip
+# ---------------------------------------------------------------------------
+
+
+class TestJournalSampling:
+    def test_begin_and_read_live_round_trip(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        j = serving.RequestJournal(path)
+        fut = serving.GenerationFuture()
+        import horovod_tpu.obs.tracing as obs_tracing
+
+        fut.trace = obs_tracing.RequestTrace("a" * 16)
+        req = serving.Request(prompt=[1, 2], max_new_tokens=8,
+                              future=fut, eos_id=3, trace=fut.trace,
+                              temperature=1.25, top_k=4, top_p=0.75,
+                              seed=99)
+        j.begin(req)
+        j.append(req.id, 7)
+        live = serving.RequestJournal.read_live(path)
+        d = live["a" * 16]
+        assert d["emitted_tokens"] == [7]
+        assert d["temperature"] == 1.25 and d["seed"] == 99
+        entry = j.get(req.id)
+        assert (entry.temperature, entry.top_k, entry.top_p,
+                entry.seed) == (1.25, 4, 0.75, 99)
+
+    def test_greedy_begin_line_stays_compact(self, tmp_path):
+        path = str(tmp_path / "g.jsonl")
+        j = serving.RequestJournal(path)
+        fut = serving.GenerationFuture()
+        req = serving.Request(prompt=[1], max_new_tokens=2, future=fut)
+        j.begin(req)
+        import json as _json
+
+        line = _json.loads(open(path).read().splitlines()[0])
+        assert "samp" not in line  # greedy journals stay pre-sampling

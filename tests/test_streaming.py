@@ -1,0 +1,583 @@
+"""SSE token streaming + cancel-on-disconnect (serving/sse.py, the
+``stream=true`` path of serving/server.py, and the router's mid-stream
+failover in serving/router/server.py).
+
+The gold checks:
+
+* a streamed response's concatenated token events == the non-streamed
+  200 body == the per-request ``sample_decode`` oracle, indices
+  gapless;
+* a client that disconnects mid-stream CANCELS the request — the slot
+  (and its pages) is reclaimed within a tick, counted in
+  ``serving_disconnects_total``;
+* the router proxies the chunked body through live, and a replica that
+  dies MID-STREAM is failed over from its journal/descriptor with no
+  duplicated and no dropped token events on the client's wire — the
+  stream stays byte-identical to an uninterrupted run (the SIGKILL
+  subprocess drill proves it against a real kill).
+"""
+
+import dataclasses
+import http.client
+import json
+import os
+import signal
+import socket
+import tempfile
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import serving
+from horovod_tpu.models import transformer as T
+from horovod_tpu.serving import sse
+from horovod_tpu.serving.router import (
+    ReplicaEndpoint,
+    ReplicaRegistry,
+    ReplicaSpec,
+    ReplicaSupervisor,
+    RouterServer,
+)
+
+pytestmark = pytest.mark.streaming
+
+
+def _cfg(**kw):
+    base = T.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=48, dtype=jnp.float32, attention_impl="reference",
+        n_kv_heads=2)
+    return dataclasses.replace(base, **kw)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return T.init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _oracle(params, cfg, prompt, steps, *, temperature=0.0, top_k=0,
+            top_p=0.0, seed=0):
+    return np.asarray(T.sample_decode(
+        params, jnp.asarray([prompt], jnp.int32), steps, cfg,
+        rng=jax.random.PRNGKey(seed), temperature=temperature,
+        top_k=top_k, top_p=top_p))[0].tolist()
+
+
+def _post(host, port, body, timeout=60, headers=None):
+    c = http.client.HTTPConnection(host, port, timeout=timeout)
+    c.request("POST", "/generate", body=json.dumps(body).encode(),
+              headers=headers or {})
+    return c, c.getresponse()
+
+
+def _tokens(events):
+    return [p["token"] for k, p in events if k == "token"]
+
+
+def _indices(events):
+    return [p["i"] for k, p in events if k == "token"]
+
+
+def _terminal(events, kind):
+    out = [p for k, p in events if k == kind]
+    assert len(out) == 1, f"expected one {kind} event: {events}"
+    return out[0]
+
+
+# ---------------------------------------------------------------------------
+# wire-format plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestSSEPlumbing:
+    def test_event_round_trip_any_chunking(self):
+        frames = (sse.event_bytes("token", {"i": 0, "token": 5})
+                  + sse.event_bytes("done", {"tokens": [5],
+                                             "finish_reason": "eos"}))
+        for step in (1, 3, 7, len(frames)):
+            p = sse.SSEParser()
+            evs = []
+            for off in range(0, len(frames), step):
+                evs.extend(p.feed(frames[off:off + step]))
+            assert [k for k, _ in evs] == ["token", "done"]
+            assert evs[0][1] == {"i": 0, "token": 5}
+            assert evs[1][1]["finish_reason"] == "eos"
+
+    def test_unparseable_data_survives(self):
+        p = sse.SSEParser()
+        evs = p.feed(b"event: token\ndata: not-json\n\n")
+        assert evs == [("token", {"_raw": "not-json"})]
+
+
+# ---------------------------------------------------------------------------
+# the serving server's stream
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def stream_server(model):
+    """One warmed engine + HTTP server for the whole class; a slow
+    detokenizer (~5 ms/token) keeps generation observable so the
+    disconnect test can land mid-stream deterministically."""
+    params, cfg = model
+    cfg = _cfg(max_seq=128)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+
+    def slow_detok(t):
+        time.sleep(0.005)
+        return f"<{t}>"
+
+    eng = serving.InferenceEngine(
+        params, cfg,
+        serving.EngineConfig(n_slots=2, max_len=128, tick_timeout=0),
+        detokenize=slow_detok)
+    eng.warmup([1, 4])
+    srv = serving.ServingServer(eng, port=0).start()
+    yield params, cfg, eng, srv
+    srv.stop(drain_timeout=5)
+
+
+@pytest.mark.serving
+class TestServerStreaming:
+    def test_stream_equals_nonstream_equals_oracle(self, stream_server):
+        params, cfg, eng, srv = stream_server
+        host, port = srv.address
+        body = {"tokens": [3, 4, 5], "max_new_tokens": 8,
+                "temperature": 1.2, "seed": 7}
+        c, r = _post(host, port, body)
+        plain = json.loads(r.read())
+        c.close()
+        c, r = _post(host, port, {**body, "stream": True})
+        assert r.status == 200
+        assert "text/event-stream" in r.getheader("Content-Type")
+        assert r.getheader("X-Trace-Id")
+        events = sse.read_stream(r)
+        c.close()
+        done = _terminal(events, "done")
+        want = _oracle(params, cfg, [3, 4, 5], 8, temperature=1.2,
+                       seed=7)
+        assert _tokens(events) == done["tokens"] == plain["tokens"] \
+            == want
+        assert _indices(events) == list(range(8))
+        # streamed detokenization rides the events
+        assert all("text" in p for k, p in events if k == "token")
+        assert done["finish_reason"] == "length"
+        assert done["ttft_ms"] is not None
+        snap = eng.stats()
+        assert snap["streamed_tokens"] >= 8
+        assert snap["streamed_ttfb_seconds"]["count"] >= 1
+
+    def test_greedy_stream_default(self, stream_server):
+        params, cfg, eng, srv = stream_server
+        host, port = srv.address
+        c, r = _post(host, port, {"tokens": [9, 2], "max_new_tokens": 5,
+                                  "stream": True})
+        events = sse.read_stream(r)
+        c.close()
+        assert _tokens(events) == _oracle(params, cfg, [9, 2], 5)
+
+    def test_eos_finish_streams_done(self, stream_server):
+        params, cfg, eng, srv = stream_server
+        want = _oracle(params, cfg, [3, 4, 5], 8)
+        eos = want[2]  # force an early EOS retirement
+        c, r = _post(host := srv.address[0], port := srv.address[1],
+                     {"tokens": [3, 4, 5], "max_new_tokens": 8,
+                      "eos_id": eos, "stream": True})
+        events = sse.read_stream(r)
+        c.close()
+        done = _terminal(events, "done")
+        assert done["finish_reason"] == "eos"
+        # retires at the FIRST occurrence of the eos value
+        assert _tokens(events) == want[:want.index(eos) + 1]
+
+    def test_submit_rejection_is_plain_json(self, stream_server):
+        params, cfg, eng, srv = stream_server
+        host, port = srv.address
+        # too long -> 413, never a stream
+        c, r = _post(host, port, {"tokens": [1], "max_new_tokens": 4096,
+                                  "stream": True})
+        assert r.status == 413
+        assert "json" in r.getheader("Content-Type")
+        json.loads(r.read())
+        c.close()
+        # bad sampling param -> 400
+        c, r = _post(host, port, {"tokens": [1], "temperature": -1,
+                                  "stream": True})
+        assert r.status == 400
+        c.close()
+
+    def test_disconnect_cancels_and_reclaims_slot(self, stream_server):
+        params, cfg, eng, srv = stream_server
+        host, port = srv.address
+        before = eng.metrics.disconnects.value
+        c, r = _post(host, port, {"tokens": [9], "max_new_tokens": 120,
+                                  "temperature": 1.0, "seed": 3,
+                                  "stream": True})
+        assert r.status == 200
+        parser = sse.SSEParser()
+        got = []
+        while len(got) < 3:
+            got.extend(parser.feed(r.read1(128)))
+        # hard hangup (RST) mid-stream
+        c.sock.shutdown(socket.SHUT_RDWR)
+        c.close()
+        deadline = time.monotonic() + 20.0
+        while eng.slots.active_count and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.slots.active_count == 0, "slot leaked past disconnect"
+        assert eng.metrics.disconnects.value == before + 1
+        # the engine never decoded to the full budget for a dead client
+        assert eng.metrics.streamed_tokens.value < \
+            eng.metrics.tokens_generated.value + 120
+
+
+# ---------------------------------------------------------------------------
+# the router's streamed proxy + mid-stream failover
+# ---------------------------------------------------------------------------
+
+
+def _stack(model, n=2, max_len=128, detok_sleep=0.0, max_restarts=3):
+    """N in-process replicas (full engines + HTTP servers, journal
+    files armed) behind a polled registry + router."""
+    params, cfg = model
+    cfg = _cfg(max_seq=max_len)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    tmp = tempfile.mkdtemp(prefix="stream_stack_")
+
+    def detok(t):
+        if detok_sleep:
+            time.sleep(detok_sleep)
+        return f"<{t}>"
+
+    servers = []
+    for i in range(n):
+        eng = serving.InferenceEngine(
+            params, cfg,
+            serving.EngineConfig(
+                n_slots=2, max_len=max_len, tick_timeout=0,
+                max_restarts=max_restarts,
+                journal_path=os.path.join(tmp, f"r{i}.journal.jsonl")),
+            detokenize=detok if detok_sleep else None)
+        eng.warmup([1, 4])
+        servers.append(serving.ServingServer(eng, port=0).start())
+    reg = ReplicaRegistry(poll_interval=0.1)
+    for i, s in enumerate(servers):
+        h, p = s.address
+        reg.add(ReplicaEndpoint(f"r{i}", h, p,
+                                journal_path=s.engine.journal.path))
+    rt = RouterServer(reg, port=0, max_attempts=4,
+                      retry_backoff=0.05).start()
+    deadline = time.monotonic() + 10.0
+    while (len(reg.in_rotation()) < n
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    assert len(reg.in_rotation()) == n
+    return params, cfg, servers, reg, rt
+
+
+def _teardown(servers, rt):
+    rt.stop()
+    for s in servers:
+        try:
+            s.stop(drain_timeout=2)
+        except Exception:
+            pass
+
+
+@pytest.mark.router
+class TestRouterStreaming:
+    def test_streamed_proxy_pass_through(self, model):
+        params, cfg, servers, reg, rt = _stack(model, n=1, max_len=48)
+        try:
+            host, port = rt.address
+            body = {"tokens": [3, 4, 5], "max_new_tokens": 8,
+                    "temperature": 1.2, "seed": 7, "stream": True}
+            c, r = _post(host, port, body,
+                         headers={"X-Trace-Id": "t" * 16})
+            assert r.status == 200
+            assert r.getheader("X-Trace-Id") == "t" * 16
+            assert r.getheader("X-Router-Replica") == "r0"
+            events = sse.read_stream(r)
+            c.close()
+            want = _oracle(params, cfg, [3, 4, 5], 8, temperature=1.2,
+                           seed=7)
+            assert _tokens(events) == want
+            assert _terminal(events, "done")["tokens"] == want
+            assert _indices(events) == list(range(8))
+        finally:
+            _teardown(servers, rt)
+
+    def test_midstream_failover_resumes_without_dupes(self, model):
+        """Terminate the serving replica's engine mid-stream: the
+        in-band error event's resume descriptor fails the stream over,
+        the survivor continues from the frontier, and the client's
+        wire shows every token exactly once — byte-identical to the
+        uninterrupted sampled oracle."""
+        params, cfg, servers, reg, rt = _stack(model, detok_sleep=0.02,
+                                               max_restarts=0)
+        try:
+            host, port = rt.address
+            N = 60
+            c, r = _post(host, port,
+                         {"tokens": [9, 11], "max_new_tokens": N,
+                          "temperature": 1.1, "seed": 5,
+                          "timeout_ms": 60000, "stream": True},
+                         timeout=120)
+            assert r.status == 200
+            parser = sse.SSEParser()
+            events = []
+            while len(_tokens(events)) < 5:
+                events.extend(parser.feed(r.read1(256)))
+            victim = int(r.getheader("X-Router-Replica")[1])
+            servers[victim].engine.terminate("chaos: killed mid-stream")
+            while True:
+                data = r.read1(512)
+                if not data:
+                    break
+                events.extend(parser.feed(data))
+            c.close()
+            done = _terminal(events, "done")
+            want = _oracle(params, cfg, [9, 11], N, temperature=1.1,
+                           seed=5)
+            assert _indices(events) == list(range(N)), \
+                "duplicated or dropped token events"
+            assert _tokens(events) == want
+            assert done["tokens"] == want
+            assert done["resumed"] is True
+            assert done["resume_carried_tokens"] >= 1
+            assert reg.metrics.resume_failovers.value >= 1
+            # the survivor, not the corpse, finished the request
+            other = servers[1 - victim].engine
+            assert other.metrics.completed.value >= 1
+        finally:
+            _teardown(servers, rt)
+
+    def test_nonresumable_stream_ends_typed_not_crashed(self, model):
+        """A streamed body WITHOUT max_new_tokens is not resumable (the
+        router cannot rewrite it): when its replica dies after token
+        events already reached the client, the stream must end with a
+        terminal ``stream_interrupted`` error event — never a re-issued
+        from-scratch duplicate stream, and never a dead handler with no
+        terminal event (regression: the failover path used to KeyError
+        on the body rewrite)."""
+        params, cfg, servers, reg, rt = _stack(model, detok_sleep=0.02,
+                                               max_restarts=0)
+        try:
+            host, port = rt.address
+            c, r = _post(host, port,
+                         {"tokens": [9, 11], "temperature": 1.1,
+                          "seed": 5, "stream": True},  # no max_new
+                         timeout=60)
+            assert r.status == 200
+            parser = sse.SSEParser()
+            events = []
+            while len(_tokens(events)) < 3:
+                events.extend(parser.feed(r.read1(256)))
+            victim = int(r.getheader("X-Router-Replica")[1])
+            servers[victim].engine.terminate("chaos")
+            while True:
+                data = r.read1(512)
+                if not data:
+                    break
+                events.extend(parser.feed(data))
+            c.close()
+            err = _terminal(events, "error")
+            # In-band death relays the replica's typed engine_failed;
+            # connection-level death (e.g. SIGKILL) gets the router's
+            # stream_interrupted.  Either way: ONE terminal typed
+            # error, no crash, no duplicate re-issued stream.
+            assert err["type"] in ("engine_failed",
+                                   "stream_interrupted")
+            assert _indices(events) == list(range(len(_tokens(events))))
+        finally:
+            _teardown(servers, rt)
+
+
+class _CutStreamReplica:
+    """A fake replica that answers /generate with an SSE stream of
+    ``n_tokens`` token events and then KILLS the connection without a
+    terminal event — the wire signature of a SIGKILL mid-stream —
+    while /stats keeps it in rotation."""
+
+    def __init__(self, n_tokens=3):
+        import http.server
+
+        fake = self
+
+        class H(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                body = json.dumps({
+                    "queue_depth": 0, "occupancy": 0.0,
+                    "engine_state": "healthy",
+                    "heartbeat_age_s": 0.01}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                for i in range(fake.n_tokens):
+                    data = sse.event_bytes("token",
+                                           {"i": i, "token": 40 + i})
+                    self.wfile.write(b"%x\r\n" % len(data) + data
+                                     + b"\r\n")
+                # die mid-stream: no terminal event, dead socket
+                self.connection.shutdown(socket.SHUT_RDWR)
+                self.connection.close()
+
+        from http.server import ThreadingHTTPServer
+
+        self.n_tokens = n_tokens
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def address(self):
+        return self.httpd.server_address[:2]
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.mark.router
+class TestRouterStreamConnectionDeath:
+    def test_connection_cut_nonresumable_terminal_error(self):
+        """Connection death mid-stream with a NON-resumable body (no
+        max_new_tokens): the router must end the client's stream with
+        a terminal ``stream_interrupted`` error — the regression was a
+        KeyError rewriting the body for a retry, which killed the
+        handler with no terminal event at all."""
+        fake = _CutStreamReplica(n_tokens=3)
+        reg = ReplicaRegistry(poll_interval=0.1)
+        h, p = fake.address
+        reg.add(ReplicaEndpoint("rX", h, p))
+        rt = RouterServer(reg, port=0, max_attempts=3,
+                          retry_backoff=0.01).start()
+        try:
+            deadline = time.monotonic() + 5.0
+            while not reg.in_rotation() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            host, port = rt.address
+            c, r = _post(host, port, {"tokens": [1, 2],
+                                      "temperature": 1.0,
+                                      "stream": True}, timeout=30)
+            assert r.status == 200
+            events = sse.read_stream(r)
+            c.close()
+            assert _tokens(events) == [40, 41, 42]
+            err = _terminal(events, "error")
+            assert err["type"] == "stream_interrupted"
+        finally:
+            rt.stop()
+            fake.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos: a real SIGKILL under a live stream (subprocess replicas)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestStreamingFrontTierChaos:
+    def test_sigkill_mid_stream_no_dupes_no_drops(self, model):
+        """ACCEPTANCE (ISSUE 13): SIGKILL a replica while it is
+        actively streaming a SAMPLED request.  The router reads the
+        dead replica's journal post-mortem, re-emits only what the
+        client never received, and continues the stream on the
+        survivor — the client's SSE stream ends with gapless indices
+        and a token sequence byte-identical to ``sample_decode`` at
+        the request's seed.  No duplicated events, no dropped tokens,
+        ``resumed: true`` on the terminal done event."""
+        params, cfg = model
+        spec = ReplicaSpec(seed=0, slots=4, warm=(8,),
+                           tick_timeout=30.0, drain_timeout=3.0,
+                           request_timeout=90.0)
+        reg = ReplicaRegistry(poll_interval=0.15, poll_timeout=1.0,
+                              heartbeat_stale=5.0)
+        journal_dir = tempfile.mkdtemp(prefix="stream_chaos_")
+        sup = ReplicaSupervisor(spec, 2, registry=reg,
+                                unhealthy_grace=1.5,
+                                shutdown_grace=2.0,
+                                backoff_initial=0.1,
+                                journal_dir=journal_dir)
+        rt = RouterServer(reg, port=0, max_attempts=4,
+                          retry_backoff=0.05, proxy_timeout=120.0,
+                          resume_lookup=sup.resume_lookup)
+        sup.start()
+        rt.start()
+        try:
+            assert sup.wait_ready(timeout=240), "replicas never ready"
+            host, port = rt.address
+            steps = 40
+            trace = "f" * 16
+            kill_done = threading.Event()
+
+            def kill_streaming_replica():
+                """SIGKILL whichever replica's journal shows OUR
+                request mid-decode — enough emitted to force a real
+                carry, enough remaining that the kill lands before
+                retirement."""
+                deadline = time.monotonic() + 60.0
+                while time.monotonic() < deadline:
+                    for h in sup.replicas():
+                        try:
+                            live = serving.RequestJournal.read_live(
+                                sup._journal_paths[h.rid])
+                        except Exception:
+                            continue
+                        d = live.get(trace)
+                        if (d is not None and
+                                5 <= len(d["emitted_tokens"])
+                                <= steps - 15):
+                            os.kill(h.pid, signal.SIGKILL)
+                            kill_done.set()
+                            return
+                    time.sleep(0.01)
+
+            killer = threading.Thread(target=kill_streaming_replica,
+                                      daemon=True)
+            c, r = _post(host, port,
+                         {"tokens": [9, 11], "max_new_tokens": steps,
+                          "temperature": 1.1, "seed": 5,
+                          "timeout_ms": 90000, "stream": True},
+                         timeout=120, headers={"X-Trace-Id": trace})
+            assert r.status == 200
+            killer.start()
+            events = sse.read_stream(r)
+            c.close()
+            killer.join(5.0)
+            assert kill_done.is_set(), \
+                "the kill never landed mid-stream (request too fast?)"
+            done = _terminal(events, "done")
+            want = _oracle(params, cfg, [9, 11], steps,
+                           temperature=1.1, seed=5)
+            assert _indices(events) == list(range(steps)), \
+                "duplicated or dropped token events across the kill"
+            assert _tokens(events) == want
+            assert done["tokens"] == want
+            assert done.get("resumed") is True
+            assert done.get("resume_carried_tokens", 0) >= 5
+            assert reg.metrics.resume_failovers.value >= 1
+        finally:
+            rt.stop()
+            sup.stop(drain=False)
