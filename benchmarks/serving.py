@@ -916,6 +916,150 @@ def _chaos_mode(args, T, cfg, params) -> None:
     print(json.dumps(result))
 
 
+def _slo_mode(args, T) -> None:
+    """SLO-scheduling benchmark (``--slo``, docs/serving.md
+    "Scheduling"): a scenario-diverse workload — bursty arrivals,
+    BIMODAL prompt lengths (short interactive queries sharing the
+    engine with a stream of long batch prompts), mixed priority
+    classes — served twice over identical arrivals:
+
+    * **slo**: chunked prefill (``prefill_chunk_tokens``) + priority
+      classes + preemption — the PR 14 scheduler;
+    * **fcfs**: whole-prompt prefill, every request one class — the
+      historical engine.
+
+    The JSON line reports per-class TTFT p50/p99 for both, the
+    interactive-class p99 ratio (the acceptance criterion: >= 2x
+    better under the long-prompt interference leg), total tok/s (must
+    stay within 10%), preemption counts, per-request oracle identity
+    for the SLO leg (chunked + preempted + resumed output must be
+    token-identical), and ``decode_recompiles`` (must be 0 — chunk
+    boundaries and priorities are data)."""
+    from horovod_tpu import serving
+
+    steps = min(args.steps, 16)
+    long_len, chunk = 288, 32
+    cfg = T.TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model,
+        n_heads=args.n_heads, n_layers=args.n_layers, d_ff=args.d_ff,
+        max_seq=long_len + 2 * steps + 32,
+        n_kv_heads=args.kv_heads[-1] if args.kv_heads else 0,
+        attention_impl="reference",
+        dtype=jnp.float32 if jax.devices()[0].platform == "cpu"
+        else jnp.bfloat16)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+
+    rng = np.random.default_rng(0)
+    # Bimodal, bursty: two waves, each an interleaved mix of LONG
+    # batch prompts and bursts of short interactive ones — the
+    # interference leg: in FCFS order every short prompt behind a long
+    # one waits out its whole prefill.
+    work = []  # (arrival_s, prompt, priority)
+    t = 0.0
+    for wave in range(2):
+        for j in range(2):  # long batch prompts lead the wave
+            n = int(rng.integers(long_len - 48, long_len + 1))
+            work.append((t, rng.integers(0, cfg.vocab_size, n).tolist(),
+                         "batch"))
+        for j in range(6):  # ... then a burst of interactive queries
+            n = int(rng.integers(3, 13))
+            work.append((t + 0.01 * (j + 1),
+                         rng.integers(0, cfg.vocab_size, n).tolist(),
+                         "interactive"))
+        t += 0.25
+
+    def run(slo: bool):
+        engine = serving.InferenceEngine(
+            params, cfg, serving.EngineConfig(
+                n_slots=4, max_len=cfg.max_seq,
+                max_prefills_per_tick=args.max_prefills_per_tick,
+                max_queue_depth=64,
+                prefill_chunk_tokens=chunk if slo else 0))
+        warm_lens = sorted({len(p) for _, p, _ in work})
+        engine.warmup([warm_lens[0], warm_lens[len(warm_lens) // 2],
+                       warm_lens[-1]])
+        warm_compiles = engine.decode_compilations
+        engine.metrics = serving.ServingMetrics()
+        engine.start()
+        futs = []
+        t0 = time.monotonic()
+        for arrival, prompt, pri in work:
+            now = time.monotonic() - t0
+            if now < arrival:
+                time.sleep(arrival - now)
+            futs.append((pri, prompt, engine.submit(
+                prompt, max_new_tokens=steps,
+                priority=pri if slo else "interactive")))
+        while not all(f.done() for _, _, f in futs):
+            time.sleep(0.002)
+        wall = time.monotonic() - t0
+        engine.stop()
+        snap = engine.stats()
+        by_class = {"interactive": [], "batch": []}
+        oracle_ok = oracle_bad = 0
+        for pri, prompt, f in futs:
+            if f.ttft is not None:
+                by_class[pri].append(f.ttft)
+            if slo:
+                ref = np.asarray(T.greedy_decode(
+                    params, jnp.asarray([prompt], jnp.int32), steps,
+                    cfg))[0].tolist()
+                if f.result(timeout=0) == ref:
+                    oracle_ok += 1
+                else:
+                    oracle_bad += 1
+        toks = sum(len(f.tokens_so_far()) for _, _, f in futs)
+        out = {
+            "tok_s": round(toks / wall, 1),
+            "wall_s": round(wall, 3),
+            "preemptions": snap["preemptions"],
+            "decode_recompiles":
+                engine.decode_compilations - warm_compiles,
+        }
+        for cls, vals in by_class.items():
+            vals.sort()
+            out[f"{cls}_ttft_p50_ms"] = round(
+                vals[len(vals) // 2] * 1e3, 2) if vals else None
+            out[f"{cls}_ttft_p99_ms"] = round(
+                vals[min(len(vals) - 1,
+                         int(len(vals) * 0.99))] * 1e3, 2) \
+                if vals else None
+        if slo:
+            out["oracle_identical"] = oracle_ok
+            out["oracle_mismatched"] = oracle_bad
+        return out
+
+    fcfs = run(slo=False)
+    slo = run(slo=True)
+    ratio = (fcfs["interactive_ttft_p99_ms"]
+             / slo["interactive_ttft_p99_ms"]
+             if slo["interactive_ttft_p99_ms"] else None)
+    tput_ratio = (slo["tok_s"] / fcfs["tok_s"]
+                  if fcfs["tok_s"] else None)
+    result = {
+        "metric": f"slo scheduling: interactive TTFT p99 improvement "
+                  f"(chunk={chunk} prio+preempt vs FCFS whole-prefill; "
+                  f"bimodal {long_len}-token batch stream + "
+                  f"interactive bursts, S=4, {len(work)} reqs x "
+                  f"{steps} toks)",
+        "value": round(ratio, 2) if ratio else None,
+        "unit": "x (fcfs_p99 / slo_p99; >= 2 is the acceptance bar)",
+        "throughput_ratio": round(tput_ratio, 3) if tput_ratio else None,
+        "prefill_chunk_tokens": chunk,
+        "decode_recompiles": slo["decode_recompiles"],
+        "slo": slo,
+        "fcfs": fcfs,
+        "chip": jax.devices()[0].device_kind,
+    }
+    print(f"slo      interactive TTFT p99 {slo['interactive_ttft_p99_ms']}ms "
+          f"(chunked+prio) vs {fcfs['interactive_ttft_p99_ms']}ms (fcfs) "
+          f"= {result['value']}x | tok/s {slo['tok_s']} vs "
+          f"{fcfs['tok_s']} ({result['throughput_ratio']}x) | "
+          f"{slo['preemptions']} preemptions, "
+          f"{slo['decode_recompiles']} decode recompiles")
+    print(json.dumps(result))
+
+
 def _engine_mode(args, T, cfg, params) -> None:
     """Open-loop continuous-batching benchmark: Poisson arrivals at
     ``--arrival-rate`` req/s with prompt lengths mixed over
@@ -1127,6 +1271,13 @@ def main() -> None:
                          "mid-decode (restart-resume on); reports "
                          "resumed-vs-restarted counts, wasted-token "
                          "ratio, and per-request oracle identity")
+    ap.add_argument("--slo", action="store_true",
+                    help="SLO-scheduling benchmark: bursty bimodal "
+                         "mixed-class workload served with chunked "
+                         "prefill + priorities + preemption vs the "
+                         "FCFS whole-prefill baseline; reports "
+                         "per-class TTFT p50/p99, the interactive p99 "
+                         "ratio, throughput, and oracle identity")
     ap.add_argument("--slots", type=int, default=8,
                     help="engine mode: cache slots S")
     ap.add_argument("--max-prefills-per-tick", type=int, default=2,
@@ -1189,6 +1340,10 @@ def main() -> None:
     print(f"chip={kind} d{args.d_model} L{args.n_layers} "
           f"h{args.n_heads} d_ff{args.d_ff} vocab{args.vocab} "
           f"{jnp.dtype(dtype).name}")
+
+    if args.slo:
+        _slo_mode(args, T)
+        return
 
     if args.router:
         kv = args.kv_heads[-1] if args.kv_heads else 0

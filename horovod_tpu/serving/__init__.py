@@ -67,6 +67,7 @@ from horovod_tpu.serving.sse import (
     event_bytes,
 )
 from horovod_tpu.serving.scheduler import (
+    PRIORITY_CLASSES,
     CacheOutOfPagesError,
     DeadlineExceededError,
     DrainingError,
@@ -77,6 +78,7 @@ from horovod_tpu.serving.scheduler import (
     RequestTooLongError,
     Scheduler,
     ServingError,
+    priority_rank,
 )
 from horovod_tpu.serving.server import ServingServer
 # The replicated front tier (router subpackage) — imported last: it

@@ -123,6 +123,7 @@ from horovod_tpu.serving.scheduler import (
     RequestTooLongError,
     Scheduler,
     ServingError,
+    priority_rank,
 )
 
 __all__ = [
@@ -377,6 +378,24 @@ class EngineConfig:
     page_size: int = 16
     n_pages: int = 0
     kv_dtype: Optional[str] = None
+    # Chunked prefill (docs/serving.md "Scheduling"): cap the prompt
+    # tokens one tick may spend on ingestion.  A prompt whose
+    # (post-prefix-match) length exceeds the budget is admitted into a
+    # slot but INGESTED chunk by chunk, one chunk riding each decode
+    # tick: every chunk runs through the same ``prefill_with_prefix``
+    # executable the prefix registry uses, attending the
+    # already-landed pages gathered back through the slot's page table
+    # — chunk boundaries are DATA (page lists + a traced prefix
+    # length), so the compile set stays bounded by (page-count
+    # buckets) x (chunk buckets) and the decode executable never
+    # recompiles.  Decode for every OTHER slot proceeds between
+    # chunks, which is the whole point: one long prompt no longer
+    # stalls the batch for a full prefill (the Sarathi-Serve move).
+    # The final chunk's last-position logits are bit-identical to a
+    # whole-prompt prefill's, so greedy AND sampled output is
+    # token-identical to the un-chunked oracle.  0 disables (whole
+    # prompts, the historical behavior); requires ``paged=True``.
+    prefill_chunk_tokens: int = 0
     # Speculative decoding (docs/serving.md "Speculative decoding"):
     # draft spec_k tokens per active slot inside the compiled tick,
     # verify them all in ONE batched target forward, emit the agreeing
@@ -432,6 +451,23 @@ class _SlotState:
     request: Request
     last_token: int
     n_generated: int
+
+
+@dataclasses.dataclass
+class _IngestState:
+    """One slot mid-way through CHUNKED prompt ingestion
+    (``EngineConfig.prefill_chunk_tokens``): the request, and how many
+    prompt tokens are already landed in its pages (``landed`` counts
+    attached shared-prefix tokens too — the next chunk starts there).
+    The slot is excluded from the decode mask until the last chunk
+    lands and yields the first token.  ``started`` is where ingestion
+    began (the attached-prefix length) — ``landed - started`` is the
+    prefill compute a suspension throws away, the honest
+    wasted-token count for a preempted mid-ingest victim."""
+
+    request: Request
+    landed: int
+    started: int = 0
 
 
 @dataclasses.dataclass
@@ -504,15 +540,39 @@ class InferenceEngine:
                         f"draft model must share the tokenizer: vocab "
                         f"{draft_cfg.vocab_size} != {cfg.vocab_size}")
             self._spec_model = mode == "model"
+        if engine_cfg.prefill_chunk_tokens:
+            if not engine_cfg.paged:
+                raise ValueError(
+                    "EngineConfig.prefill_chunk_tokens requires "
+                    "paged=True (chunks attend the already-landed "
+                    "pages through prefill_with_prefix)")
+            if engine_cfg.prefill_chunk_tokens < 1:
+                raise ValueError(
+                    f"prefill_chunk_tokens must be >= 1 (or 0 to "
+                    f"disable), got {engine_cfg.prefill_chunk_tokens}")
         self.slots = self._make_slots()
         self.metrics = ServingMetrics()
         self.scheduler = Scheduler(
             max_queue_depth=engine_cfg.max_queue_depth,
             max_prefills_per_tick=engine_cfg.max_prefills_per_tick,
             on_reject=lambda req, err: self.metrics.rejected.inc(),
-            on_cancel=lambda req: self.metrics.cancelled.inc())
+            on_cancel=lambda req: self.metrics.cancelled.inc(),
+            # A requeued (preempted/resumed) request whose deadline
+            # lapses before re-admission RETIRES with its partial
+            # tokens — that is a completion, not shed load.
+            on_expire=lambda req: self.metrics.completed.inc())
         self._states: List[Optional[_SlotState]] = \
             [None] * engine_cfg.n_slots
+        # Chunked-prefill ingestion state (prefill_chunk_tokens): slot
+        # -> _IngestState for every slot whose prompt is still landing
+        # chunk by chunk; such slots are allocated (pages, occupancy)
+        # but excluded from the decode mask until the last chunk's
+        # logits yield their first token.  _tick_prefill_spent is the
+        # per-tick ingestion-token ledger the admission admit_fn and
+        # _advance_ingest share.
+        self._ingest: Dict[int, _IngestState] = {}
+        self._tick_prefill_spent = 0
+        self._tick_ingested: set = set()  # slots advanced this tick
         # Requests popped from the queue but not yet landed in a slot —
         # a tick failing mid-admission must fail these futures too.
         self._taken: List[Request] = []
@@ -888,8 +948,20 @@ class InferenceEngine:
                temperature: float = 0.0,
                top_k: int = 0,
                top_p: float = 0.0,
-               seed: Optional[int] = None) -> GenerationFuture:
+               seed: Optional[int] = None,
+               priority: str = "interactive") -> GenerationFuture:
         """Queue a generation request; returns its future.
+
+        ``priority`` selects the request's SLO class
+        (:data:`~horovod_tpu.serving.scheduler.PRIORITY_CLASSES`;
+        validated here — unknown classes are a typed
+        :class:`ServingError`, HTTP 400).  The scheduler serves
+        classes strictly in order (``interactive`` before ``batch``)
+        with EDF inside each class, and under slot/page pressure the
+        engine may SUSPEND a strictly-worse-class victim (journal
+        frontier kept, re-admitted later, output byte-identical) to
+        bound the better class's wait — docs/serving.md
+        "Scheduling".
 
         ``temperature`` / ``top_k`` / ``top_p`` / ``seed`` select
         per-request SAMPLING (serving/sampling.py; validated here,
@@ -947,6 +1019,7 @@ class InferenceEngine:
                  else self.engine_cfg.default_max_new_tokens)
         temperature, top_k, top_p, seed = validate_sampling(
             temperature, top_k, top_p, seed)
+        priority_rank(priority)  # typed ServingError on unknown class
         if not prompt:
             raise ServingError("empty prompt")
         if n_new < 1:
@@ -979,7 +1052,8 @@ class InferenceEngine:
         req = Request(prompt=prompt, max_new_tokens=n_new, future=fut,
                       eos_id=eos_id, deadline=deadline, trace=fut.trace,
                       speculative=speculative, temperature=temperature,
-                      top_k=top_k, top_p=top_p, seed=seed)
+                      top_k=top_k, top_p=top_p, seed=seed,
+                      priority=priority)
         if self.journal is not None:
             # Journal BEFORE the enqueue, purge-on-resolve wired first:
             # every resolution path (retire, typed error, cancel,
@@ -1173,16 +1247,47 @@ class InferenceEngine:
         entry.pages = pages
         entry.epoch = self._cache_epoch
 
-    def _plan_pages(self, req: Request) -> int:
-        """Pages an admission would consume (private grants + one COW/
-        growth margin page) — the scheduler back-pressure budget.
-        Shared prefix pages cost nothing: attaching is a refcount."""
-        ps = self.slots.page_size
-        n_idx = (len(req.prompt) - 1) // ps + 1
+    def _prefix_landed(self, req: Request) -> int:
+        """Tokens a matched, CURRENT-epoch prefix would pre-land for
+        this request (0 without one) — what chunking and page planning
+        subtract from the prompt."""
         entry = self._matched_prefix(req)
         if (entry is not None and entry.pages is not None
                 and entry.epoch == self._cache_epoch):
-            p0 = len(entry.tokens)
+            return len(entry.tokens)
+        return 0
+
+    def _chunked(self, req: Request) -> bool:
+        """Does this request's prompt ingest CHUNK BY CHUNK?  Yes when
+        chunking is on and the prompt tokens that actually need
+        prefill (past any matched shared prefix) exceed the per-tick
+        budget."""
+        chunk = self.engine_cfg.prefill_chunk_tokens
+        return bool(chunk) and (len(req.prompt)
+                                - self._prefix_landed(req)) > chunk
+
+    def _prefill_cost(self, req: Request) -> int:
+        """Prompt tokens admitting this request costs THIS tick: the
+        un-prefixed suffix, capped at one chunk for a chunked
+        ingestion (later chunks ride later ticks)."""
+        suf = len(req.prompt) - self._prefix_landed(req)
+        chunk = self.engine_cfg.prefill_chunk_tokens
+        return min(suf, chunk) if chunk else suf
+
+    def _plan_pages(self, req: Request) -> int:
+        """Pages an admission would consume (private grants + one COW/
+        growth margin page) — the scheduler back-pressure budget.
+        Shared prefix pages cost nothing: attaching is a refcount.  A
+        CHUNKED admission plans only its first chunk's span (later
+        chunks grant on demand at their tick, preempting or waiting
+        like decode growth does)."""
+        ps = self.slots.page_size
+        p0 = self._prefix_landed(req)
+        upto = len(req.prompt)
+        if self._chunked(req):
+            upto = p0 + self.engine_cfg.prefill_chunk_tokens
+        n_idx = (upto - 1) // ps + 1
+        if p0 > 0:
             if len(req.prompt) == p0:
                 return 1  # attach-only; margin covers the first COW/grant
             return n_idx - p0 // ps + 1
@@ -1192,9 +1297,14 @@ class InferenceEngine:
         """Admission-group key for :meth:`Scheduler.take`: groups must
         share one prefill executable, so the key is the prompt bucket —
         and, when paged, the matched prefix (one shared-prefix gather +
-        suffix prefill serves the whole group) with the SUFFIX bucket."""
+        suffix prefill serves the whole group) with the SUFFIX bucket.
+        A CHUNKED request is taken ALONE (singleton key): its
+        ingestion spans many ticks and shares no prefill shape with
+        anyone."""
         if not self.engine_cfg.paged:
             return self._bucket(len(req.prompt))
+        if self._chunked(req):
+            return ("chunk", req.id)
         entry = self._matched_prefix(req)
         if entry is None:
             return ("full", self._bucket(len(req.prompt)))
@@ -1203,41 +1313,157 @@ class InferenceEngine:
             return ("attach", entry.tokens)
         return ("suffix", entry.tokens, self._bucket(suf))
 
-    def _evict_for_pages(self) -> bool:
-        """Preempt the YOUNGEST admitted request (highest request id —
-        oldest work keeps its progress, FCFS-fairly) to reclaim pages;
-        its future resolves with the typed
-        :class:`CacheOutOfPagesError`.  False when nothing is left to
-        evict."""
-        victims = [(st.request.id, s)
-                   for s, st in enumerate(self._states) if st is not None]
-        if not victims:
+    def _occupants(self) -> List:
+        """Every occupied slot as ``(priority rank, request id, slot,
+        request)`` — decoding slots and mid-ingestion slots alike (an
+        ingesting slot holds pages too)."""
+        occ = [(st.request.priority_rank, st.request.id, s, st.request)
+               for s, st in enumerate(self._states) if st is not None]
+        occ += [(ing.request.priority_rank, ing.request.id, s,
+                 ing.request)
+                for s, ing in self._ingest.items()]
+        return occ
+
+    def _build_resume(self, req: Request) -> Optional[Request]:
+        """A RESUME request for ``req`` from its journal frontier —
+        prompt + emitted tokens as the new prompt, the remaining
+        decode budget, and the ORIGINAL id/deadline/trace/class/
+        sampling/future — or None when no trustworthy frontier exists
+        (no journal entry, resume off, or nothing left to decode).
+        Shared by the restart-resume path (:meth:`_resume_or_fail`)
+        and preemption (:meth:`_preempt`): both re-admissions are the
+        same re-prefill-and-continue operation, so their output is
+        byte-identical to an uninterrupted run by the same argument."""
+        if not self.engine_cfg.resume or self.journal is None:
+            return None
+        entry = self.journal.get(req.id)
+        if entry is None or entry.remaining < 1:
+            return None
+        new = Request(prompt=list(entry.prompt) + list(entry.emitted),
+                      max_new_tokens=entry.remaining, future=req.future,
+                      eos_id=entry.eos_id, deadline=req.deadline,
+                      trace=req.trace, speculative=req.speculative,
+                      # Sampling params survive verbatim: the key
+                      # schedule is position-based, so the re-prefill
+                      # of prompt + emitted continues the exact stream.
+                      temperature=entry.temperature, top_k=entry.top_k,
+                      top_p=entry.top_p, seed=entry.seed,
+                      priority=req.priority)
+        # The ORIGINAL id is kept: it is the journal key, and it
+        # preserves the request's age in the scheduling order
+        # (preemption picks victims by id — surviving a crash or a
+        # preemption must not mark old work as young).
+        new.id = req.id
+        new.submitted_at = req.submitted_at
+        # Wasted work = tokens RE-prefilled that were already computed
+        # once.  A request that never landed a prefill (no emitted
+        # tokens) re-queues for free.
+        new._resume_wasted = len(new.prompt) if entry.emitted else 0
+        return new
+
+    def _preempt(self, slot: int, reason: str) -> bool:
+        """SUSPEND the request occupying ``slot`` — journal frontier
+        kept, pages and slot freed, request requeued for ordinary
+        re-admission with its future still live (output byte-identical
+        to an uninterrupted run: the re-prefill of prompt + emitted
+        continues the exact token stream, greedy or sampled).  Falls
+        back to the legacy typed :class:`CacheOutOfPagesError` when no
+        resume frontier exists (``resume=False``).  Returns True if
+        the slot was vacated."""
+        st = self._states[slot]
+        ing = self._ingest.get(slot)
+        if st is None and ing is None:
             return False
-        _, s = max(victims)
-        st = self._states[s]
+        req = st.request if st is not None else ing.request
+        fut = req.future
         # The SUBMIT-TIME recorder handle (not the global): begin and
         # finish went through fut._spans, so events must too — a
         # recorder swapped mid-request (the A/B seam) must not orphan
         # an event onto a stream that never saw the span start.
-        srec = st.request.future._spans
-        if srec is not None and st.request.trace is not None:
+        srec = fut._spans
+        if srec is not None and req.trace is not None:
             try:
-                srec.request_event(st.request.trace, "eviction",
-                                   {"slot": s, "reason": "out_of_pages"})
+                srec.request_event(req.trace, "eviction",
+                                   {"slot": slot, "reason": reason})
             except Exception:  # pragma: no cover - spans must not fail
                 pass
-        st.request.future.set_exception(CacheOutOfPagesError(
-            "preempted: page pool exhausted mid-decode "
-            "(older requests keep their pages)"))
-        self.metrics.rejected.inc()
-        self._states[s] = None
-        self._release_slot(s)
+        self._states[slot] = None
+        self._ingest.pop(slot, None)
+        self._release_slot(slot)
+        if fut.done():
+            return True
+        if fut.cancel_requested:
+            fut._finish("cancelled")
+            self.metrics.cancelled.inc()
+            return True
+        new = self._build_resume(req)
+        if new is None:
+            fut.set_exception(CacheOutOfPagesError(
+                f"preempted ({reason}); no resume frontier — retry "
+                f"with backoff"))
+            self.metrics.rejected.inc()
+            return True
+        if ing is not None:
+            # A mid-ingestion victim emitted nothing, but its landed
+            # chunks were real prefill compute the re-ingestion
+            # repeats — count them (the journal alone cannot see
+            # them).
+            new._resume_wasted = max(getattr(new, "_resume_wasted", 0),
+                                     ing.landed - ing.started)
+        self.metrics.preemptions.inc()
+        wasted = getattr(new, "_resume_wasted", 0)
+        if wasted:
+            self.metrics.resume_wasted_tokens.inc(wasted)
+        self.journal.note_resume(req.id)
+        # Back into the queue (depth-exempt — the caller is still
+        # waiting on a live future); the scheduling order places it by
+        # class/EDF/id, and the paged admit_fn keeps it waiting until
+        # the pressure that evicted it clears.
+        self.scheduler.requeue_front([new])
+        self.metrics.queue_depth.set(self.scheduler.depth)
         return True
+
+    def _evict_for_pages(self) -> bool:
+        """Preempt one victim to reclaim pages: the WORST class first,
+        youngest within it (highest request id — oldest work keeps
+        its progress; a batch-class slot always pays before an
+        interactive one).  The victim SUSPENDS through the resume path
+        (see :meth:`_preempt`) rather than failing, so its output
+        stays byte-identical.  False when nothing is left to evict."""
+        occ = self._occupants()
+        if not occ:
+            return False
+        _, _, s, _ = max(occ)
+        return self._preempt(s, "out_of_pages")
+
+    def _preempt_for_slots(self) -> bool:
+        """SLOT-pressure preemption: when every slot is busy and a
+        STRICTLY better-class request waits, suspend the worst
+        occupant (worst class, youngest within it) so the winner
+        admits this tick — bounded wait for the winner, suspended (not
+        lost) work for the victim.  Never fires within a class (equal
+        peers wait FCFS, as ever) and never without a resume frontier
+        to suspend onto."""
+        if not (self.engine_cfg.resume and self.journal is not None):
+            return False
+        if self.slots.free_count > 0 or self.scheduler.depth == 0:
+            return False
+        best = self.scheduler.peek_best_rank()
+        if best is None:
+            return False
+        occ = self._occupants()
+        if not occ:
+            return False
+        worst = max(occ)
+        if worst[0] <= best:
+            return False  # nothing strictly better is waiting
+        return self._preempt(worst[2], "slot_pressure")
 
     def _ensure_write_page(self, s: int) -> bool:
         """Grant (or copy-on-write) slot ``s``'s write page for the
         next dispatch — the one-token point case of
-        :meth:`_ensure_write_range` (ONE copy of the grant/COW/evict
+        :meth:`_ensure_write_range` (which, like chunk ingestion,
+        routes through the ONE :meth:`_claim_page` grant/COW/evict
         protocol).  Returns False if ``s`` itself was evicted paying
         for its page."""
         wp = int(self._page_pos[s])
@@ -1275,21 +1501,31 @@ class InferenceEngine:
             return True
         ps = self.slots.page_size
         for idx in range(max(lo, 0) // ps, hi // ps + 1):
-            while True:
-                try:
-                    if self.slots.table[s, idx] == NULL_PAGE:
-                        self.slots.grant(s, idx)
-                    else:
-                        # Present but possibly shared (COW prefix):
-                        # make it private before any window write can
-                        # target it.  No-op when already private.
-                        self.slots.cow(s, idx)
-                    break
-                except CacheOutOfPagesError:
-                    self._evict_for_pages()
-                    if self._states[s] is None:
-                        return False  # s was the youngest — it paid
+            if not self._claim_page(
+                    s, idx, lambda: self._states[s] is not None):
+                return False  # s itself was the victim — it paid
         return True
+
+    def _claim_page(self, slot: int, idx: int, still_mine) -> bool:
+        """THE grant/COW/evict protocol, in one copy (decode growth,
+        speculative windows, and chunk ingestion all route here):
+        ensure ``slot`` owns a PRIVATE page at table index ``idx`` —
+        grant when unmapped, copy-on-write when present-but-shared
+        (no-op when already private) — preempting victims on
+        exhaustion.  ``still_mine()`` is the caller's occupancy check;
+        returns False when the caller itself was evicted paying for
+        its page."""
+        while True:
+            try:
+                if self.slots.table[slot, idx] == NULL_PAGE:
+                    self.slots.grant(slot, idx)
+                else:
+                    self.slots.cow(slot, idx)
+                return True
+            except CacheOutOfPagesError:
+                self._evict_for_pages()
+                if not still_mine():
+                    return False
 
     def _ensure_draft_range(self, s: int, lo: int, hi: int) -> None:
         """Draft-pool companion of :meth:`_ensure_write_range`.  Draft
@@ -1350,7 +1586,7 @@ class InferenceEngine:
                 self._dev_dtable = jnp.asarray(d.table)
                 self._dtable_uploaded = d.table_version
         spec = (self._spec_host & self._spec_live
-                & self.slots.active_mask())
+                & self._decode_mask())
         if (self._dev_spec_host is None
                 or not np.array_equal(spec, self._dev_spec_host)):
             self._dev_spec = jnp.asarray(spec)
@@ -1726,15 +1962,48 @@ class InferenceEngine:
                 self._states[s] = None
                 self._release_slot(s)
                 worked = True
+        for s in list(self._ingest):
+            worked = self._reap_ingest(s) or worked
         return worked
 
+    def _reap_ingest(self, slot: int) -> bool:
+        """Release an ingesting slot whose request can no longer run
+        — future already resolved (raced a drain) or cancellation
+        pending — in ONE copy (shared by the per-tick reclaim sweep
+        and the chunk step's entry check).  Returns True if the slot
+        was reaped."""
+        ing = self._ingest.get(slot)
+        if ing is None:
+            return False
+        fut = ing.request.future
+        if not (fut.done() or fut.cancel_requested):
+            return False
+        if not fut.done():
+            fut._finish("cancelled")
+            self.metrics.cancelled.inc()
+        self._ingest.pop(slot, None)
+        self._release_slot(slot)
+        return True
+
     def _admit_pending(self) -> bool:
-        admit_fn = None
+        # Tick-boundary deadline sweep: resolve EVERY dead queued
+        # request (lapsed deadline, cancel, raced drain) wherever it
+        # sits — a doomed request's 504 must not wait behind a long
+        # admission stall for take() to reach it.
+        swept = self.scheduler.sweep()
+        self._tick_prefill_spent = 0
+        self._tick_ingested = set()
+        # Slot-pressure preemption BEFORE the take: a strictly
+        # better-class arrival claims a slot from the worst occupant
+        # (suspended, never lost) instead of waiting out its decode.
+        preempted = self._preempt_for_slots()
+        pages_fn = None
         if self.engine_cfg.paged:
-            # Page back-pressure: the take stops (requests WAIT, FCFS
-            # order intact) when the next admission's private pages
-            # would overdraw the free heap — typed starvation-free
-            # admission control instead of silent over-allocation.
+            # Page back-pressure: the take stops (requests WAIT,
+            # scheduling order intact) when the next admission's
+            # private pages would overdraw the free heap — typed
+            # starvation-free admission control instead of silent
+            # over-allocation.
             budget = self.slots.free_pages
             # Clamp the plan to the deepest the free heap can ever get
             # (pool minus registry-pinned prefix pages): the plan's
@@ -1749,7 +2018,7 @@ class InferenceEngine:
             attainable = max(self.slots.n_pages - pinned, 1)
             reserved = 0
 
-            def admit_fn(req):
+            def pages_fn(req):
                 nonlocal reserved
                 need = min(self._plan_pages(req), attainable)
                 if reserved + need > budget:
@@ -1757,9 +2026,51 @@ class InferenceEngine:
                 reserved += need
                 return True
 
+        # Per-tick prefill TOKEN budget (chunked prefill): admissions
+        # past the first stop once the tick's ingestion budget is
+        # spent — they wait one tick, bounding how long the decode
+        # batch stalls on prompt ingestion.  The FIRST admission is
+        # always allowed (liveness: a chunked one costs <= one chunk
+        # by construction, and a short over-budget prompt must not
+        # park forever).
+        tok_budget = self.engine_cfg.prefill_chunk_tokens
+        n_admit = 0
+
+        def admit_fn(req):
+            nonlocal n_admit
+            if pages_fn is not None and not pages_fn(req):
+                return False
+            if tok_budget:
+                cost = self._prefill_cost(req)
+                if n_admit and self._tick_prefill_spent + cost \
+                        > tok_budget:
+                    return False
+                if not self._chunked(req):
+                    # A chunked admission's spend is counted by its
+                    # _ingest_step — counting it here too would
+                    # double-charge the tick.
+                    self._tick_prefill_spent += cost
+            n_admit += 1
+            return True
+
         reqs = self.scheduler.take(
             self.slots.free_count, bucket_fn=self._group_key,
-            admit_fn=admit_fn)
+            admit_fn=admit_fn if (pages_fn or tok_budget) else None)
+        if not reqs and self.scheduler.depth \
+                and self.engine_cfg.resume and self.journal is not None:
+            # PAGE-pressure preemption: an empty take with a non-empty
+            # queue means the scheduling-order head was blocked — by
+            # the page budget (slot pressure already ran pre-take; the
+            # token budget and bucket truncation never block the FIRST
+            # candidate).  If the head outranks the worst occupant,
+            # suspend that occupant so its pages free the head next
+            # tick; within a class the head keeps waiting, as ever.
+            best = self.scheduler.peek_best_rank()
+            occ = self._occupants()
+            if best is not None and occ:
+                worst = max(occ)
+                if worst[0] > best:
+                    self._preempt(worst[2], "page_pressure")
         self._taken = list(reqs)
         live: List[Request] = []
         for req in reqs:
@@ -1775,7 +2086,8 @@ class InferenceEngine:
         if live:
             self._admit_batch(live)
         self._taken = []
-        return bool(reqs)
+        advanced = self._advance_ingest()
+        return bool(reqs) or advanced or bool(swept) or preempted
 
     def _prefill_fn(self, bucket: int, k: int) -> Callable:
         fn = self._prefill_fns.get((bucket, k))
@@ -1825,6 +2137,13 @@ class InferenceEngine:
         fetch yields the K first tokens (prefill logits ARE the first
         greedy step).  The scheduler's bucket-uniform take keeps the
         group on one bucket, so the compile set is buckets x K."""
+        if (self.engine_cfg.paged and len(reqs) == 1
+                and self._chunked(reqs[0])):
+            # Long prompt: chunked ingestion (singleton group by
+            # construction of _group_key) — it rides the tick, it
+            # does not stall it.
+            self._admit_chunked(reqs[0])
+            return
         faults = self.engine_cfg.faults
         if faults is not None:
             faults.probe("prefill")
@@ -1835,6 +2154,8 @@ class InferenceEngine:
                 # its first life's stamps (prefill_s would otherwise
                 # go negative against the original first_token_at)
                 req.trace.admitted_at = t_adm
+                self.metrics.observe_queue_wait(
+                    req.priority, t_adm - req.submitted_at)
         if self.engine_cfg.paged:
             slots, reqs, firsts, synced = self._admit_paged(reqs)
             if not reqs:
@@ -1854,7 +2175,7 @@ class InferenceEngine:
                 # be rewritten by the re-admission.
                 ttft = now - req.submitted_at
                 req.future.ttft = ttft
-                self.metrics.ttft.observe(ttft)
+                self.metrics.observe_ttft(req.priority, ttft)
             if req.trace is not None:
                 req.trace.slot = slot
                 if req.trace.first_token_at is None:
@@ -2015,6 +2336,227 @@ class InferenceEngine:
         self._spec_admit(slots, live)
         return slots, live, firsts, synced
 
+    # -- chunked prefill (EngineConfig.prefill_chunk_tokens) ---------------
+
+    def _decode_mask(self) -> np.ndarray:
+        """Active mask for the DECODE tick: allocated slots minus
+        those still ingesting their prompt chunk by chunk — an
+        ingesting slot holds pages and occupancy but has no token
+        stream to decode yet."""
+        active = self.slots.active_mask()
+        if self._ingest:
+            active = active.copy()
+            for s in self._ingest:
+                active[s] = False
+        return active
+
+    def _admit_chunked(self, req: Request) -> None:
+        """Admit ONE long-prompt request into a slot for CHUNKED
+        ingestion: attach any matched shared prefix (refcount, no
+        compute), open the ingest state, and land the first chunk on
+        this tick's budget.  The slot decodes nothing until the last
+        chunk's logits yield the first token
+        (:meth:`_finish_ingest`)."""
+        t_adm = time.monotonic()
+        if req.trace is not None and req.trace.admitted_at is None:
+            req.trace.admitted_at = t_adm
+            self.metrics.observe_queue_wait(
+                req.priority, t_adm - req.submitted_at)
+        entry = self._matched_prefix(req)
+        if entry is not None:
+            try:
+                self._ensure_prefix(entry)
+            except CacheOutOfPagesError:
+                entry = None  # degrade: chunk the whole prompt
+        slot = self.slots.alloc()
+        assert slot is not None  # take() is bounded by free_count
+        p0 = 0
+        if entry is not None:
+            self.slots.attach(slot, entry.pages)
+            p0 = len(entry.tokens)
+        self._ingest[slot] = _IngestState(request=req, landed=p0,
+                                          started=p0)
+        self._page_pos[slot] = p0
+        self.metrics.admitted.inc()
+        self._taken.remove(req)  # the ingest state owns it now
+        self._ingest_step(slot)
+
+    def _ensure_ingest_pages(self, slot: int, lo: int, hi: int) -> bool:
+        """Grant/COW the pages a chunk landing on ``[lo, hi]`` will
+        write — the ingestion face of the ONE :meth:`_claim_page`
+        protocol (COW covers the partially-filled last page of an
+        attached prefix; grants cover the fresh chunk span).  Evicts
+        through the preemption policy on exhaustion; returns False if
+        ``slot`` itself was the victim."""
+        ps = self.slots.page_size
+        for idx in range(max(lo, 0) // ps, hi // ps + 1):
+            if not self._claim_page(
+                    slot, idx, lambda: slot in self._ingest):
+                return False  # we were the youngest — we paid
+        return True
+
+    def _gather_landed(self, slot: int, lo: int):
+        """The slot's already-landed K/V as a PREFIX block for its
+        next chunk: the first ``pages_for(lo)`` table pages, padded to
+        a power-of-two page count with NULL pages (their junk is
+        masked out by the traced prefix length ``lo``), so the gather
+        + suffix-prefill compile set is bounded by page-count buckets
+        — chunk boundaries stay pure data."""
+        n_pg = self.slots.pages_for(lo)
+        pages = [int(self.slots.table[slot, i]) for i in range(n_pg)]
+        padded = 1
+        while padded < n_pg:
+            padded *= 2
+        pages += [NULL_PAGE] * (padded - n_pg)
+        return self.slots.gather_prefix(pages)
+
+    def _ingest_step(self, slot: int) -> bool:
+        """Land ONE chunk of ``slot``'s prompt: grant/COW the chunk's
+        pages, run the chunk through ``prefill_with_prefix`` attending
+        the already-landed pages (position-wise bit-identical to a
+        whole-prompt prefill), and scatter the chunk K/V into the
+        slot's pages.  The final chunk's logits ARE the whole-prompt
+        logits — :meth:`_finish_ingest` turns them into the first
+        token.  Returns True if any work was done."""
+        ing = self._ingest.get(slot)
+        if ing is None:
+            return False
+        if self._reap_ingest(slot):
+            return True
+        req = ing.request
+        fut = req.future
+        if req.deadline is not None and time.monotonic() > req.deadline:
+            # The caller is gone (504/timeout): retire with whatever a
+            # previous life emitted instead of finishing an ingestion
+            # nobody reads.
+            fut._finish("deadline")
+            self.metrics.completed.inc()
+            self._ingest.pop(slot, None)
+            self._release_slot(slot)
+            return True
+        faults = self.engine_cfg.faults
+        if faults is not None:
+            faults.probe("prefill_chunk")
+        lo = ing.landed
+        n = min(len(req.prompt) - lo,
+                self.engine_cfg.prefill_chunk_tokens)
+        if not self._ensure_ingest_pages(slot, lo, lo + n - 1):
+            return True  # preempted paying for its own chunk
+        # ONE bucket for every chunk — the full chunk width, with the
+        # tail chunk right-padded and its real length as data
+        # (true_len): a partial last chunk must not mint its own
+        # compile shape mid-serving.
+        bucket = self._bucket(self.engine_cfg.prefill_chunk_tokens)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = req.prompt[lo:lo + n]
+        lens = jnp.asarray([n], jnp.int32)
+        if lo == 0:
+            logits, pre = self._prefill_fn(bucket, 1)(
+                self.params, jnp.asarray(padded), lens)
+            self._prefill_calls += 1
+            self.slots.land([slot], pre, np.asarray([n]), start=0)
+        else:
+            pk, pv = self._gather_landed(slot, lo)
+            logits, suf = self._suffix_prefill(
+                self.params, jnp.asarray(padded), lens, pk, pv,
+                jnp.int32(lo))
+            self._prefill_calls += 1
+            self.slots.land([slot], suf, np.asarray([n]), start=lo)
+        self._tick_prefill_spent += n
+        self._tick_ingested.add(slot)
+        ing.landed = lo + n
+        self._page_pos[slot] = ing.landed
+        if ing.landed >= len(req.prompt):
+            # Non-final chunks never fetch their logits (no host
+            # sync); only this last one pays the first-token fetch.
+            self._finish_ingest(slot, ing, logits)
+        return True
+
+    def _finish_ingest(self, slot: int, ing: _IngestState,
+                       logits) -> None:
+        """The last chunk landed: the chunk logits are the
+        whole-prompt last-position logits, so the first token (greedy
+        argmax or the sampled draw at key index ``len(prompt)``) is
+        token-identical to an un-chunked admission's — from here the
+        slot joins the decode mask like any other."""
+        req = ing.request
+        self._ingest.pop(slot, None)
+        firsts = self._first_tokens([req], logits)
+        self.metrics.host_syncs.inc()  # the first-token fetch blocks
+        now = time.monotonic()
+        first = int(firsts[0])
+        if req.future.ttft is None:
+            # A RESUMED request already served its first token in a
+            # previous life — its TTFT was honest then.
+            ttft = now - req.submitted_at
+            req.future.ttft = ttft
+            self.metrics.observe_ttft(req.priority, ttft)
+        if req.trace is not None:
+            req.trace.slot = slot
+            if req.trace.first_token_at is None:
+                req.trace.first_token_at = now
+        self._samp.set(slot, temperature=req.temperature,
+                       top_k=req.top_k, top_p=req.top_p, seed=req.seed)
+        self._states[slot] = _SlotState(request=req, last_token=first,
+                                        n_generated=0)
+        self._page_pos[slot] = len(req.prompt)
+        # Speculative bookkeeping BEFORE the emit — the same order as
+        # the batch path (_spec_admit inside _admit_paged precedes
+        # _emit): the first token may retire the request (max_new 1,
+        # EOS) and free the slot, and acquiring a draft slot AFTER
+        # that would re-activate a freed slot with no owner.
+        if self._spec and self._spec_model:
+            # A MODEL draft would prefill the entire long prompt in
+            # one tick (and mint a draft compile shape per long-prompt
+            # bucket) — exactly the stall chunking removes.  Degrade
+            # the SLOT to plain greedy instead (output identical; the
+            # n-gram draft keeps speculating — its history landing is
+            # one cheap full-width scatter).
+            self._spec_host[slot] = False
+        else:
+            self._spec_admit([slot], [req])
+        self._emit(slot, first)
+        if self._dev_tokens is not None:
+            # Land the first token in the device-resident token vector
+            # (a slot retired by its own first token is inactive in
+            # the mask; its value is a don't-care).
+            vals = np.zeros(self.engine_cfg.n_slots, np.int32)
+            mask = np.zeros(self.engine_cfg.n_slots, bool)
+            vals[slot] = first
+            mask[slot] = True
+            self._dev_tokens = self._merge_tokens(
+                self._dev_tokens, jnp.asarray(vals), jnp.asarray(mask))
+
+    def _advance_ingest(self) -> bool:
+        """Advance in-progress chunked ingestions with this tick's
+        remaining prefill-token budget, oldest request first.  The
+        oldest ingestion gets a STARVATION GUARD: it advances one
+        chunk even on a tick whose budget admissions already spent —
+        unless a strictly better class is waiting for next tick's
+        budget (per-tick prefill work then stays <= 2x the budget in
+        the worst case, and ingestion can never be starved by
+        equal-or-worse-class arrivals)."""
+        if not self._ingest:
+            return False
+        chunk = self.engine_cfg.prefill_chunk_tokens
+        worked = False
+        oldest = True
+        for slot in sorted(self._ingest,
+                           key=lambda s: self._ingest[s].request.id):
+            ing = self._ingest.get(slot)
+            if ing is None:
+                continue  # evicted by an earlier step's grant
+            if self._tick_prefill_spent >= chunk:
+                if not oldest or slot in self._tick_ingested:
+                    break  # one chunk per slot per tick, budget spent
+                best = self.scheduler.peek_best_rank()
+                if (best is not None
+                        and best < ing.request.priority_rank):
+                    break  # yield the next tick's budget to the winner
+            worked = self._ingest_step(slot) or worked
+            oldest = False
+        return worked
+
     def _emit(self, slot: int, tok: int) -> None:
         """Stream one token to the slot's future; retire on EOS,
         max-token, or cache-capacity exhaustion."""
@@ -2072,7 +2614,7 @@ class InferenceEngine:
                 self._prepare_spec_tick()  # window grants; may preempt
             else:
                 self._prepare_paged_tick()  # grants/COWs; may preempt
-        active = self.slots.active_mask()
+        active = self._decode_mask()
         if not active.any():
             return False
         faults = self.engine_cfg.faults
@@ -2121,7 +2663,7 @@ class InferenceEngine:
                 self._prepare_spec_tick()
             else:
                 self._prepare_paged_tick()
-        active = self.slots.active_mask()
+        active = self._decode_mask()
         new_pending: Optional[Dict] = None
         if active.any():
             kind = (faults.probe("decode_tick")
@@ -2305,6 +2847,8 @@ class InferenceEngine:
                 st.request.future.set_exception(exc)
         for req in self._taken:
             req.future.set_exception(exc)
+        for ing in self._ingest.values():
+            ing.request.future.set_exception(exc)
         self._clear_inflight_state()
 
     def _suspend_inflight(self, exc: BaseException) -> List[Request]:
@@ -2321,6 +2865,16 @@ class InferenceEngine:
         resumed: List[Request] = []
         pending = [st.request for st in self._states if st is not None]
         pending += list(self._taken)
+        # Mid-ingestion requests suspend too: no tokens were emitted
+        # yet, so their journal frontier is the original prompt — the
+        # resume re-ingests from scratch, oracle-exact (the chunk
+        # boundary a crash interrupted is not observable in the
+        # output).  Their landed chunks were real prefill compute the
+        # re-ingestion repeats — record the honest wasted count
+        # before the ingest map is cleared.
+        pending += [ing.request for ing in self._ingest.values()]
+        ingest_wasted = {ing.request.id: ing.landed - ing.started
+                         for ing in self._ingest.values()}
         for req in pending:
             # The typed engine_restart edge on every interrupted
             # request's span, BEFORE its resolution/suspension is
@@ -2336,6 +2890,10 @@ class InferenceEngine:
                     pass
             r = self._resume_or_fail(req, exc)
             if r is not None:
+                if r.id in ingest_wasted:
+                    r._resume_wasted = max(
+                        getattr(r, "_resume_wasted", 0),
+                        ingest_wasted[r.id])
                 resumed.append(r)
         self._clear_inflight_state()
         resumed.sort(key=lambda r: r.id)
@@ -2353,40 +2911,21 @@ class InferenceEngine:
             return None
         entry = self.journal.get(req.id) if self.journal is not None \
             else None
-        if entry is None or not self.engine_cfg.resume:
-            fut.set_exception(exc)
-            return None
-        if entry.remaining < 1:  # fully emitted: only the retirement
-            fut._finish("length")  # bookkeeping was lost — finish now
+        if entry is not None and self.engine_cfg.resume \
+                and entry.remaining < 1:
+            # Fully emitted: only the retirement bookkeeping was lost
+            # — finish now.
+            fut._finish("length")
             self.metrics.completed.inc()
             return None
         # Decode — greedy AND sampled (the PRNG key schedule is a pure
         # function of seed + token position) — is a pure function of
         # the token sequence, so prefilling prompt + emitted and
         # continuing yields output token-identical to an uninterrupted
-        # run.  The ORIGINAL id is
-        # kept: it is the journal key, and it preserves the request's
-        # FCFS age (preemption picks victims by id — surviving a crash
-        # must not mark old work as young).
-        new = Request(prompt=list(entry.prompt) + list(entry.emitted),
-                      max_new_tokens=entry.remaining, future=fut,
-                      eos_id=entry.eos_id, deadline=req.deadline,
-                      trace=req.trace, speculative=req.speculative,
-                      # Sampling params survive verbatim: the key
-                      # schedule is position-based, so the re-prefill
-                      # of prompt + emitted continues the exact stream
-                      # (first resumed token draws at key index
-                      # len(prompt + emitted) — the next unwritten
-                      # position).
-                      temperature=entry.temperature, top_k=entry.top_k,
-                      top_p=entry.top_p, seed=entry.seed)
-        new.id = req.id
-        new.submitted_at = req.submitted_at
-        # Wasted work = tokens RE-prefilled that were already computed
-        # once.  A taken-but-never-landed request (no emitted tokens,
-        # its first prefill never ran) re-queues for free — counting
-        # its prompt would inflate the chaos benchmark's ratio.
-        new._resume_wasted = len(new.prompt) if entry.emitted else 0
+        # run (_build_resume, shared with preemption).
+        new = self._build_resume(req)
+        if new is None:
+            fut.set_exception(exc)
         return new
 
     def _clear_inflight_state(self) -> None:
@@ -2395,6 +2934,7 @@ class InferenceEngine:
         report phantom occupancy forever."""
         self._taken = []
         self._states = [None] * self.engine_cfg.n_slots
+        self._ingest = {}
         self.slots.release_all()
         if self.draft_slots is not None:
             self.draft_slots.release_all()
@@ -2630,6 +3170,8 @@ class InferenceEngine:
                 st.request.future.set_exception(exc)
         for req in list(self._taken):
             req.future.set_exception(exc)
+        for ing in list(self._ingest.values()):
+            ing.request.future.set_exception(exc)
         self._fail_queue(exc)
 
     def _stall_hard_fail(self, epoch: int, started: float) -> None:
@@ -2655,6 +3197,8 @@ class InferenceEngine:
                 st.request.future.set_exception(exc)
         for req in list(self._taken):
             req.future.set_exception(exc)
+        for ing in list(self._ingest.values()):
+            ing.request.future.set_exception(exc)
         self._fail_queue(exc)
 
     # -- background loop ---------------------------------------------------
@@ -2904,6 +3448,12 @@ class InferenceEngine:
             # — bounded by buckets x max_prefills_per_tick.
             "prefill_buckets": sorted(self._prefill_fns),
             "paged": self.engine_cfg.paged,
+            # SLO scheduling (docs/serving.md "Scheduling"): the chunk
+            # budget (0 = whole-prompt prefill) and how many slots are
+            # mid-ingestion right now; per-class TTFT/queue-wait and
+            # the preemption counter ride the metrics snapshot above.
+            "prefill_chunk_tokens": self.engine_cfg.prefill_chunk_tokens,
+            "slots_ingesting": len(self._ingest),
             "speculative": self._spec,
             **({
                 "spec_k": self.engine_cfg.spec_k,

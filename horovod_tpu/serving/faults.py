@@ -30,6 +30,13 @@ Sites (``FaultInjector.SITES``):
 * ``"watchdog"`` — probed at the top of ``InferenceEngine.step``; a
   ``"hang"`` here stalls the whole tick outside any device call,
   which is exactly what the watchdog thread exists to catch.
+* ``"prefill_chunk"`` — probed in ``InferenceEngine._ingest_step``
+  immediately before each CHUNK of a chunked prompt ingestion is
+  dispatched (docs/serving.md "Scheduling"), so the chaos invariant
+  covers a crash at every chunk boundary: the partially-ingested
+  request suspends through the resume path (no tokens were emitted
+  yet — the journal frontier is the original prompt) and re-ingests
+  oracle-exact after the supervised restart.
 * ``"restart_resume"`` — probed in ``InferenceEngine._recover`` at
   the point where a non-terminal restart would SUSPEND in-flight
   requests for resume (the ISSUE 9 durability path).  A ``"raise"``
@@ -107,8 +114,8 @@ class FaultInjector:
     raises, the tenth hangs 0.5 s, everything else runs clean.
     """
 
-    SITES = ("prefill", "decode_tick", "decode_fetch", "watchdog",
-             "restart_resume")
+    SITES = ("prefill", "prefill_chunk", "decode_tick", "decode_fetch",
+             "watchdog", "restart_resume")
     KINDS = ("raise", "hang", "nonfinite")
 
     def __init__(self, specs: Sequence[FaultSpec] = (), seed: int = 0):

@@ -91,6 +91,11 @@ class JournalEntry:
     top_k: int = 0
     top_p: float = 0.0
     seed: int = 0
+    #: SLO priority class (docs/serving.md "Scheduling") — a resume
+    #: (restart, preemption, or router failover) re-admits at the
+    #: ORIGINAL class: surviving a crash must neither promote nor
+    #: demote a request.
+    priority: str = "interactive"
     emitted: List[int] = dataclasses.field(default_factory=list)
     resumes: int = 0
 
@@ -159,7 +164,8 @@ class RequestJournal:
             temperature=getattr(req, "temperature", 0.0),
             top_k=getattr(req, "top_k", 0),
             top_p=getattr(req, "top_p", 0.0),
-            seed=getattr(req, "seed", 0))
+            seed=getattr(req, "seed", 0),
+            priority=getattr(req, "priority", "interactive"))
         with self._lock:
             self._entries[req.id] = entry
             self._write(self._begin_line(entry))
@@ -180,6 +186,10 @@ class RequestJournal:
         if entry.temperature > 0.0:
             line["samp"] = [entry.temperature, entry.top_k,
                             entry.top_p, entry.seed]
+        if entry.priority != "interactive":
+            # Written only when non-default, like "samp": default-class
+            # journals stay byte-compatible with pre-priority readers.
+            line["pri"] = entry.priority
         return line
 
     def append(self, rid: int, tok: int) -> None:
@@ -305,7 +315,8 @@ class RequestJournal:
                     trace_id=ev.get("trace"),
                     span_id=ev.get("span"),
                     temperature=float(samp[0]), top_k=int(samp[1]),
-                    top_p=float(samp[2]), seed=int(samp[3]))
+                    top_p=float(samp[2]), seed=int(samp[3]),
+                    priority=ev.get("pri") or "interactive")
             elif e == "t" and rid in live:
                 live[rid].emitted.append(int(ev["t"]))
             elif e == "r" and rid in live:
@@ -327,5 +338,9 @@ class RequestJournal:
                 # key schedule makes the continuation automatic.
                 "temperature": entry.temperature,
                 "seed": entry.seed,
+                # The router's scratch-rebuild failover path (no
+                # original body survived) re-submits at the ORIGINAL
+                # class; body-based failovers carry it in the body.
+                "priority": entry.priority,
             }
         return out

@@ -38,7 +38,19 @@ class ServingMetrics:
     """The engine's instrument panel, surfaced verbatim through /stats
     and as Prometheus families through /metrics.
 
-    * ``ttft`` — submit-to-first-token latency (prefill + queueing).
+    * ``ttft`` — submit-to-first-token latency (prefill + queueing),
+      a ``{class=}``-labeled family (one child histogram per SLO
+      priority class) so the per-class tail the scheduler orders on
+      is observable per class; ``/stats`` serves both the merged
+      population (``ttft_seconds``, the historical key) and the
+      per-class split (``ttft_seconds_by_class``).
+    * ``queue_wait`` — submit-to-admission latency, same ``{class=}``
+      labeling: the share of TTFT the SLO scheduler can actually move
+      (prefill cost is the model's).
+    * ``preemptions`` — admitted requests suspended under slot/page
+      pressure (journal frontier kept, re-admitted later, output
+      byte-identical); the victim count the preemption policy pays
+      for bounded winner wait.
     * ``token_latency`` — per-token decode-tick latency.
     * ``queue_depth`` / ``slot_occupancy`` — gauges sampled every tick.
     * ``admitted`` / ``rejected`` / ``completed`` / ``cancelled`` —
@@ -87,7 +99,19 @@ class ServingMetrics:
         self.registry = r
         self.ttft = r.histogram(
             "serving_ttft_seconds",
-            "Submit-to-first-token latency (queueing + prefill)")
+            "Submit-to-first-token latency (queueing + prefill), "
+            "labeled by SLO priority class",
+            labels=("class",))
+        self.queue_wait = r.histogram(
+            "serving_queue_wait_seconds",
+            "Submit-to-admission latency, labeled by SLO priority "
+            "class — the share of TTFT scheduling policy can move",
+            labels=("class",))
+        self.preemptions = r.counter(
+            "serving_preemptions_total",
+            "Admitted requests suspended under slot/page pressure "
+            "(requeued with their journal frontier; output stays "
+            "byte-identical)")
         self.token_latency = r.histogram(
             "serving_token_latency_seconds",
             "Per-token decode-tick latency (dispatch to host fetch)")
@@ -206,10 +230,40 @@ class ServingMetrics:
             "(tokens/sec x model_flops_per_token; 0 until configured "
             "and two samples apart)")
 
+    # -- per-class observation hooks ---------------------------------------
+
+    def observe_ttft(self, priority: str, v: float) -> None:
+        self.ttft.labels(**{"class": priority}).observe(v)
+
+    def observe_queue_wait(self, priority: str, v: float) -> None:
+        self.queue_wait.labels(**{"class": priority}).observe(v)
+
+    @staticmethod
+    def _merged(family) -> Dict:
+        """Class-merged histogram snapshot — the historical /stats
+        shape (count/sum/mean/p50/p99/buckets over the WHOLE
+        population), rebuilt bucket-wise from the labeled children
+        (they all share the default bucket edges)."""
+        h = Histogram()
+        for _, child in family.children():
+            st = child.state()
+            h._counts = [a + b for a, b in zip(h._counts, st["counts"])]
+            h._sum += st["sum"]
+            h._count += st["count"]
+        return h.snapshot()
+
+    @staticmethod
+    def _by_class(family) -> Dict:
+        return {key[0]: child.snapshot()
+                for key, child in family.children()}
+
     def snapshot(self) -> Dict:
         ticks = self.decode_ticks.value
         return {
-            "ttft_seconds": self.ttft.snapshot(),
+            "ttft_seconds": self._merged(self.ttft),
+            "ttft_seconds_by_class": self._by_class(self.ttft),
+            "queue_wait_seconds_by_class": self._by_class(self.queue_wait),
+            "preemptions": self.preemptions.value,
             "token_latency_seconds": self.token_latency.snapshot(),
             "queue_depth": self.queue_depth.value,
             "slot_occupancy": self.slot_occupancy.value,

@@ -35,6 +35,16 @@ deadline that expires mid-failover resolves as the same typed 504 the
 replicas use.  Only the paid-for work moves; nothing is generated
 twice, nothing is dropped.
 
+SLO classes ride failover untouched: the client's ``"priority"`` field
+lives in the request body, and every re-dispatch (``dispatch_body`` /
+the streamed twin) rewrites only ``tokens`` / ``max_new_tokens`` /
+``timeout_ms`` around the original body — so a batch-class request
+resumes as batch on the survivor, and a journal descriptor additionally
+records the class (``priority`` in ``RequestJournal.read_live``) for
+consumers that rebuild a body from scratch.  Deadline budgets compose
+with EDF scheduling: the REMAINING ``timeout_ms`` a failover dispatches
+becomes the replica-side deadline the scheduler orders on.
+
 STREAMING (``"stream": true`` — docs/serving.md "Sampling +
 streaming"): the replica's chunked SSE body is proxied through
 event-by-event with trace headers intact, token indices kept GLOBAL

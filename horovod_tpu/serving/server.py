@@ -10,7 +10,7 @@ Endpoints:
 * ``POST /generate`` — body ``{"tokens": [...], "max_new_tokens": N,
   "eos_id": E?, "timeout_ms": T?, "speculative": bool?,
   "temperature": f?, "top_k": K?, "top_p": p?, "seed": s?,
-  "stream": bool?}`` (or
+  "priority": "interactive"|"batch"?, "stream": bool?}`` (or
   ``{"text": ...}`` when the
   server was built with an ``encode`` callable).  Replies ``{"tokens":
   [...], "finish_reason": ..., "ttft_ms": ...}`` (+ ``"text"`` with a
@@ -274,7 +274,12 @@ class _Handler(BaseHTTPRequestHandler):
                 temperature=req.get("temperature", 0.0),
                 top_k=req.get("top_k", 0),
                 top_p=req.get("top_p", 0.0),
-                seed=req.get("seed"))
+                seed=req.get("seed"),
+                # SLO class (docs/serving.md "Scheduling"): priority-
+                # then-EDF admission order, preemption down the class
+                # order under pressure.  Unknown classes are a typed
+                # ServingError -> 400 below.
+                priority=req.get("priority", "interactive"))
             if stream:
                 # The request is live: from here the response is the
                 # SSE stream (200 + chunked), errors included — it

@@ -574,9 +574,12 @@ class TestBackPressure:
         assert engine.stats()["requests_rejected"] == 1
 
     def test_decode_growth_exhaustion_preempts_youngest(self, model):
-        """Pool exhaustion mid-decode preempts the YOUNGEST request
-        with the typed error; the older request keeps its pages and
-        finishes oracle-exact."""
+        """Pool exhaustion mid-decode preempts the YOUNGEST request;
+        since PR 14 the victim SUSPENDS through the resume path
+        (journal frontier, pages freed, re-admitted once the pool
+        clears) instead of failing typed — the older request keeps its
+        pages and BOTH finish oracle-exact, the victim byte-identical
+        to an uninterrupted run."""
         params, cfg = model
         engine = _engine(params, cfg, n_slots=2, n_pages=4,
                          max_queue_depth=4, max_prefills_per_tick=2,
@@ -586,8 +589,27 @@ class TestBackPressure:
         _run_until_done(engine, [old, young])
         assert old.result(timeout=0) == _ref_greedy(
             params, cfg, [3, 4, 5, 6, 7, 8, 9, 1], 24)
+        assert young.result(timeout=0) == _ref_greedy(
+            params, cfg, [2, 6, 4, 1, 9, 5, 8, 3], 24)
+        assert engine.stats()["preemptions"] >= 1
+        assert engine.slots.active_count == 0  # nothing leaked
+
+    def test_preemption_without_resume_fails_typed(self, model):
+        """``resume=False`` keeps the legacy contract: the preempted
+        victim resolves with the typed :class:`CacheOutOfPagesError`
+        (no journal frontier to suspend onto)."""
+        params, cfg = model
+        engine = _engine(params, cfg, n_slots=2, n_pages=4,
+                         max_queue_depth=4, max_prefills_per_tick=2,
+                         overlap=False, resume=False)
+        old = engine.submit([3, 4, 5, 6, 7, 8, 9, 1], max_new_tokens=24)
+        young = engine.submit([2, 6, 4, 1, 9, 5, 8, 3], max_new_tokens=24)
+        _run_until_done(engine, [old, young])
+        assert old.result(timeout=0) == _ref_greedy(
+            params, cfg, [3, 4, 5, 6, 7, 8, 9, 1], 24)
         with pytest.raises(serving.CacheOutOfPagesError):
             young.result(timeout=0)
+        assert engine.stats()["preemptions"] == 0
         assert engine.slots.active_count == 0  # nothing leaked
 
 

@@ -302,25 +302,28 @@ class TestAdmissionControl:
         assert engine.stats()["requests_rejected"] == 2  # + take-time
 
     def test_requeue_front_restores_fcfs_and_ignores_depth_bound(self):
-        """The resume path's re-admission hook: requeued requests go
-        back to the HEAD in the given order, ahead of everything
-        queued, and are exempt from max_queue_depth (their callers
-        already hold live futures)."""
+        """The resume path's re-admission hook: requeued requests keep
+        their ORIGINAL (older) ids — the real resume/preemption paths
+        preserve them — so the scheduling order places them ahead of
+        everything younger in their class, and they are exempt from
+        max_queue_depth (their callers already hold live futures)."""
         class _F:
             def done(self):
                 return False
             cancel_requested = False
 
         sched = serving.Scheduler(max_queue_depth=2)
-        queued = serving.Request(prompt=[9], max_new_tokens=1, future=_F())
-        sched.submit(queued)
+        # Resumed requests were submitted (and got their ids) BEFORE
+        # the still-queued one, exactly like a real crash window.
         r1 = serving.Request(prompt=[1], max_new_tokens=1, future=_F())
         r2 = serving.Request(prompt=[2], max_new_tokens=1, future=_F())
         r3 = serving.Request(prompt=[3], max_new_tokens=1, future=_F())
+        queued = serving.Request(prompt=[9], max_new_tokens=1, future=_F())
+        sched.submit(queued)
         sched.requeue_front([r1, r2, r3])  # depth 4 > bound 2: allowed
         assert sched.depth == 4
         out = sched.take(free_slots=4)
-        # resumed head first, in given order; the old head after
+        # resumed requests first, in id (original FCFS) order
         assert [r.prompt for r in out[:2]] == [[1], [2]]
         out += sched.take(free_slots=4)
         assert [r.prompt for r in out] == [[1], [2], [3], [9]]
