@@ -50,6 +50,16 @@ line reports resumed-vs-restarted counts, the wasted-token ratio
 byte-identity against the no-fault greedy oracle:
 
     python benchmarks/serving.py --chaos [--slots 8]
+
+``--tp N`` is the TENSOR-PARALLEL A/B (docs/serving.md
+"Tensor-parallel replicas"): a tp=N GSPMD-sharded engine vs the tp=1
+single-device engine on the identical mixed greedy/sampled workload —
+steady-state decode tok/s both ways, ``tp_equal_output_tokens`` (the
+full per-request sequences), and ``decode_recompiles: 0`` in the JSON
+line, under the existing CPU smoke clamp (forced host devices stand in
+for the ICI mesh):
+
+    python benchmarks/serving.py --tp 2 [--slots 8]
 """
 
 from __future__ import annotations
@@ -449,6 +459,100 @@ def _ab_spec(args, T, cfg):
         "spec_equal_output_tokens": equal,
         "spec_decode_compilations": spec_eng.decode_compilations,
     }
+
+
+def _tp_mode(args, T) -> None:
+    """The ``--tp N`` A/B leg (docs/serving.md "Tensor-parallel
+    replicas"): steady-state decode tok/s of a tp=N GSPMD-sharded
+    engine vs the tp=1 single-device engine on the IDENTICAL workload
+    — reps interleaved, per-tick walls compared at the p25 exactly
+    like the overlap A/B — with the benchmark's live token-identity
+    check (``tp_equal_output_tokens``: the full per-request SEQUENCES,
+    greedy and sampled rows both) and the zero-recompile guard in the
+    JSON line.  On a single CPU host the tp engine pays real psum/
+    all-gather collectives between forced host devices for no real
+    memory win, so the ratio is the COORDINATION OVERHEAD floor, not a
+    speedup — the tp win on hardware is serving a model whose params +
+    KV do not fit one chip at all."""
+    import dataclasses as _dc
+
+    from horovod_tpu import serving
+
+    if len(jax.devices()) < args.tp:
+        print(json.dumps({
+            "benchmark": "serving_tp", "skipped": True,
+            "reason": f"{len(jax.devices())} devices < tp={args.tp} "
+                      f"(set XLA_FLAGS="
+                      f"--xla_force_host_platform_device_count="
+                      f"{args.tp} before backend init)"}))
+        return
+
+    dtype = jnp.float32 if jax.devices()[0].platform == "cpu" \
+        else jnp.bfloat16
+    cfg = T.TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model,
+        n_heads=args.n_heads, n_layers=args.n_layers, d_ff=args.d_ff,
+        max_seq=args.prompt_len + args.steps,
+        n_kv_heads=args.kv_heads[-1] if args.kv_heads else 0,
+        attention_impl="reference", dtype=dtype)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    S = args.slots
+    prompt = np.random.default_rng(7).integers(
+        0, cfg.vocab_size, max(args.prompt_len // 2, 1)).tolist()
+    engines = {}
+    warm_compiles = {}
+    for name, tp in (("tp", args.tp), ("tp1", 1)):
+        eng = serving.InferenceEngine(
+            params, cfg, serving.EngineConfig(
+                n_slots=S, max_len=cfg.max_seq, tp=tp,
+                max_prefills_per_tick=args.max_prefills_per_tick,
+                max_queue_depth=max(2 * S, 8)))
+        eng.warmup([len(prompt)])
+        warm_compiles[name] = eng.decode_compilations
+        engines[name] = (eng, [])
+
+    toks = {}
+    steps = max(min(max(args.steps, 24),
+                    cfg.max_seq - len(prompt) + 1), 1)
+    for rep in range(max(args.iters, 4)):
+        for name, (eng, dts) in engines.items():
+            # Half the slots sampled: the A/B's identity check covers
+            # the sampled rows' key schedule under the sharded tick.
+            futs = [eng.submit(prompt, max_new_tokens=steps,
+                               temperature=0.9 if i % 2 else 0.0,
+                               seed=i)
+                    for i in range(S)]
+            while not all(f.done() for f in futs):
+                full = eng.slots.active_count == S
+                t0 = time.perf_counter()
+                eng.step()
+                dt = time.perf_counter() - t0
+                if full and eng.slots.active_count == S:
+                    dts.append(dt)
+            toks.setdefault(name, []).extend(
+                f.tokens_so_far() for f in futs)
+    q = {name: float(np.percentile(dts, 25))
+         for name, (_, dts) in engines.items()}
+    recompiles = {name: eng.decode_compilations - warm_compiles[name]
+                  for name, (eng, _) in engines.items()}
+    result = {
+        "benchmark": "serving_tp",
+        "chip": jax.devices()[0].device_kind,
+        "tp": args.tp,
+        "mesh": engines["tp"][0].stats()["mesh"],
+        "model": _dc.asdict(cfg) | {"dtype": jnp.dtype(dtype).name},
+        "slots": S,
+        "steps_per_request": steps,
+        "decode_tok_s_tp": round(S / q["tp"], 2),
+        "decode_tok_s_tp1": round(S / q["tp1"], 2),
+        "tp_decode_ratio": round(q["tp1"] / q["tp"], 3),
+        "tp_equal_output_tokens": toks["tp"] == toks["tp1"],
+        "decode_recompiles": recompiles["tp"],
+        "decode_recompiles_tp1": recompiles["tp1"],
+        "ab_steps_sampled": {n: len(d)
+                             for n, (_, d) in engines.items()},
+    }
+    print(json.dumps(result))
 
 
 def _ab_tracing(args, cfg, params):
@@ -1285,6 +1389,14 @@ def main() -> None:
     ap.add_argument("--arrival-rate", type=float, default=4.0,
                     help="engine mode: Poisson arrivals per second")
     ap.add_argument("--n-requests", type=int, default=32)
+    ap.add_argument("--tp", type=int, default=0, metavar="N",
+                    help="tensor-parallel A/B: a tp=N GSPMD-sharded "
+                         "engine vs the tp=1 single-device engine on "
+                         "the identical workload — steady-state "
+                         "decode tok/s, full-sequence token-identity "
+                         "check, zero-recompile guard (docs/serving.md "
+                         "'Tensor-parallel replicas').  CPU hosts get "
+                         "N forced host devices automatically")
     ap.add_argument("--spec-k", type=int, default=3,
                     help="speculative A/B: draft tokens per tick "
                          "(verify window is K+1 wide)")
@@ -1307,6 +1419,14 @@ def main() -> None:
                          "<path>.jsonl request log) and report the "
                          "path in the JSON line")
     args = ap.parse_args()
+
+    if args.tp > 1:
+        # Devices must exist before the backend spins up; harmless
+        # when the flag (or a real accelerator topology) is already
+        # there.  This runs before the first jax.devices() call below.
+        from horovod_tpu.serving.sharding import ensure_devices
+
+        ensure_devices(args.tp)
 
     from horovod_tpu.models import transformer as T
 
@@ -1340,6 +1460,10 @@ def main() -> None:
     print(f"chip={kind} d{args.d_model} L{args.n_layers} "
           f"h{args.n_heads} d_ff{args.d_ff} vocab{args.vocab} "
           f"{jnp.dtype(dtype).name}")
+
+    if args.tp:
+        _tp_mode(args, T)
+        return
 
     if args.slo:
         _slo_mode(args, T)

@@ -9,6 +9,14 @@ batching at work.  Runs on any backend, including JAX_PLATFORMS=cpu.
 Run:  python examples/serve.py [--steps 30] [--port 8000] [--keep]
       python examples/serve.py --trace /tmp/serve_trace.json --chaos
       python examples/serve.py --replicas 3
+      python examples/serve.py --tp 2              # one GSPMD-sharded engine
+      python examples/serve.py --replicas 2 --tp 2 # router over tp-2 replicas
+
+``--tp N`` shards the engine (or, with ``--replicas``, every replica's
+engine) over an N-device GSPMD ``tp`` mesh — attention heads and the
+MLP hidden dim split, the paged KV pool head-sharded — serving output
+token-identical to tp=1 (docs/serving.md "Tensor-parallel replicas").
+CPU demos force N host devices automatically.
 
 ``--replicas N`` (N > 1) stands up the REPLICATED front tier instead
 (docs/serving.md "Front tier"): the trained params are pickled once,
@@ -124,6 +132,7 @@ def replicated_demo(args, params, cfg) -> None:
         proc="router", role="router")
     sup = ReplicaSupervisor(
         ReplicaSpec(params_path=params_path, slots=args.slots,
+                    tp=args.tp,
                     warm=[8], tick_timeout=30.0, drain_timeout=10.0),
         args.replicas, registry=registry, unhealthy_grace=3.0,
         journal_dir=journal_dir, span_dir=span_dir)
@@ -140,6 +149,10 @@ def replicated_demo(args, params, cfg) -> None:
         if not sup.wait_ready(timeout=180):
             raise RuntimeError("replicas never became ready")
         print(f"router on {base}  ({args.replicas} replicas in rotation)")
+        if args.tp > 1:
+            print("replica meshes: " + ", ".join(
+                f"{s.endpoint.rid}[{s.mesh}]"
+                for s in registry.in_rotation()))
 
         # Twice the single-engine burst, through the router; replica
         # r0 is SIGKILLed once half the requests are in flight.
@@ -265,6 +278,14 @@ def main() -> None:
                     help="N > 1: serve through the replicated front "
                          "tier (router + supervisor) and SIGKILL one "
                          "replica mid-burst to demo zero-drop failover")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree: shard the engine "
+                         "(each replica, with --replicas) over a "
+                         "tp-device GSPMD mesh — heads + MLP hidden "
+                         "split, paged KV pool head-sharded, output "
+                         "token-identical to tp=1 (docs/serving.md "
+                         "'Tensor-parallel replicas').  CPU demos get "
+                         "forced host devices automatically")
     ap.add_argument("--spans", default="",
                     help="(with --replicas) span-stream directory for "
                          "distributed tracing — the killed request's "
@@ -272,6 +293,15 @@ def main() -> None:
                          "burst and GET /trace/<id> serves it (a tmp "
                          "dir is used when omitted)")
     args = ap.parse_args()
+
+    if args.tp > 1:
+        # Devices must exist before the backend spins up (CPU hosts:
+        # the forced-host-device flag; a real accelerator host already
+        # exposes its topology).  jax has not run an op yet, so the
+        # flag is still read at backend init.
+        from horovod_tpu.serving.sharding import ensure_devices
+
+        ensure_devices(args.tp)
 
     import horovod_tpu as hvd
     from horovod_tpu import obs, serving
@@ -296,11 +326,14 @@ def main() -> None:
         params, cfg,
         serving.EngineConfig(n_slots=args.slots, max_len=cfg.max_seq,
                              restart_backoff=0.05, faults=inj,
+                             tp=args.tp,
                              # turns token counters into achieved
                              # FLOP/s in /stats (docs/observability.md)
                              model_flops_per_token=obs.xprof
                              .transformer_flops_per_token(params)),
         detokenize=lambda t: f" {t}")
+    if args.tp > 1:
+        print(f"engine sharded over {engine.stats()['mesh']}")
     # SIGTERM (k8s/systemd stop) -> graceful drain, same as Ctrl-C —
     # installed for the WHOLE serving lifetime, demo burst included:
     # the load balancer sees 503 on /healthz, admitted requests

@@ -504,12 +504,66 @@ def cache_specs() -> Dict:
     """KV-cache shardings for tp serving: the cache's kv-head dim shards
     over ``tp`` (cache layout ``(L, B, H_kv, T, Dh)``), matching the
     head-sharded K/V projections so no resharding happens on the decode
-    hot path.  Requires ``cfg.kv_heads % tp == 0``."""
+    hot path.  Requires ``cfg.kv_heads % tp == 0``.
+
+    The same specs cover :func:`prefill` / :func:`prefill_with_prefix`
+    OUTPUT blocks (``(L, K, H_kv, bucket, Dh)`` — axis 1 is the
+    admission batch instead of the slot pool, but the sharded axis is
+    the same H_kv dim), so a sharded prefill lands into a sharded page
+    pool with a purely local scatter."""
     return {
         "k": P(None, None, "tp", None, None),
         "v": P(None, None, "tp", None, None),
         "pos": P(),
     }
+
+
+def paged_pool_specs(quantized: bool = False) -> Dict:
+    """Page-pool shardings for tp serving: the pool's kv-head dim
+    shards over ``tp`` (pool layout ``(L, P, H_kv, page, Dh)``) —
+    pages are sharded BY HEAD, never by page id, so the page table
+    stays replicated host data and grants/COW/attach need no
+    sharding awareness at all.  int8 pools' per-vector scales
+    (``(L, P, H_kv, page)``) ride the identical head split.  Per-slot
+    ``pos`` is replicated (tick data, like the table)."""
+    specs = {
+        "k": P(None, None, "tp", None, None),
+        "v": P(None, None, "tp", None, None),
+        "pos": P(),
+    }
+    if quantized:
+        specs["k_scale"] = P(None, None, "tp", None)
+        specs["v_scale"] = P(None, None, "tp", None)
+    return specs
+
+
+def prefix_kv_specs():
+    """Sharding for a gathered shared-prefix block
+    (:func:`~horovod_tpu.serving.cache.gather_prefix_pages` output,
+    ``(L, H_kv, n * page, Dh)``): head dim over ``tp``, matching the
+    pool it was gathered from and the suffix prefill that attends it."""
+    return P(None, "tp", None, None)
+
+
+def shard_params(params: Dict, mesh, cfg: TransformerConfig) -> Dict:
+    """Place a parameter tree on a serving mesh per
+    :func:`serving_param_specs` (heads/ffn/vocab over ``tp``,
+    everything else replicated) — the one-call placement for an engine
+    or a restored checkpoint.  The sharding tree itself comes from
+    :func:`serving_shardings` (the ONE spec→NamedSharding mapping)."""
+    param_sh, _ = serving_shardings(mesh, cfg)
+    return jax.device_put(params, param_sh)
+
+
+def shard_kv_pool(pool: Dict, mesh) -> Dict:
+    """Place a paged KV pool (:func:`~horovod_tpu.serving.cache.
+    init_page_pool`) on a serving mesh per :func:`paged_pool_specs` —
+    head-dim sharded payload (and int8 scales), replicated ``pos``."""
+    from jax.sharding import NamedSharding
+
+    specs = paged_pool_specs(quantized="k_scale" in pool)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in pool.items()}
 
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int = 0) -> Dict:

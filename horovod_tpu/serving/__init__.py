@@ -62,6 +62,10 @@ from horovod_tpu.serving.sampling import (
     SamplingParams,
     SlotSampling,
 )
+from horovod_tpu.serving.sharding import (
+    ServingSharding,
+    ShardingConfigError,
+)
 from horovod_tpu.serving.sse import (
     SSEParser,
     event_bytes,
@@ -95,6 +99,7 @@ __all__ = [
     "JournalEntry", "RequestJournal",
     "Counter", "Gauge", "Histogram", "ServingMetrics",
     "SamplingParams", "SlotSampling", "SSEParser", "event_bytes",
+    "ServingSharding", "ShardingConfigError",
     "CacheOutOfPagesError", "DeadlineExceededError", "DrainingError",
     "EngineFailedError", "EngineStalledError", "QueueFullError",
     "Request", "RequestTooLongError", "Scheduler", "ServingError",

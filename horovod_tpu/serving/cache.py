@@ -324,7 +324,7 @@ class PagedSlotCache:
 
     def __init__(self, cfg: "T.TransformerConfig", n_slots: int,
                  max_len: int = 0, *, page_size: int = 16,
-                 n_pages: int = 0, kv_dtype=None):
+                 n_pages: int = 0, kv_dtype=None, mesh=None):
         if n_slots < 1:
             raise ValueError(f"need at least one slot, got {n_slots}")
         if page_size < 1:
@@ -340,10 +340,20 @@ class PagedSlotCache:
         # admission back-pressure handles the tail.
         self.n_pages = n_pages or n_slots * self.max_pages
         self.kv_dtype = kv_dtype
+        # Tensor-parallel serving (docs/serving.md "Tensor-parallel
+        # replicas"): with a mesh, the pool is allocated with an
+        # EXPLICIT device sharding — payload (and int8 scales) split by
+        # kv head over tp, per-slot pos replicated.  Everything
+        # host-side below (tables, grants, refcounts, COW) is
+        # sharding-OBLIVIOUS: pages are split by head, never by page
+        # id, so the allocator's view of a page is unchanged.
+        self.mesh = mesh
         self._storage_dtype, self.quantized = resolve_kv_dtype(
             cfg, kv_dtype)
         self.cache = init_page_pool(cfg, n_slots, self.n_pages + 1,
                                     page_size, kv_dtype)
+        if mesh is not None:
+            self.cache = T.shard_kv_pool(self.cache, mesh)
         self.table = np.zeros((n_slots, self.max_pages), np.int32)
         self.table_version = 0
         self._ref = np.zeros(self.n_pages + 1, np.int64)

@@ -378,6 +378,21 @@ class EngineConfig:
     page_size: int = 16
     n_pages: int = 0
     kv_dtype: Optional[str] = None
+    # Tensor parallelism (docs/serving.md "Tensor-parallel replicas"):
+    # tp > 1 runs EVERY compiled tick body under GSPMD over a tp mesh
+    # built from parallel/meshes.MeshSpec — params sharded per
+    # serving_param_specs (heads + MLP hidden over tp, embeddings at
+    # the vocab dim, norms replicated), the paged KV pool head-dim
+    # sharded, page tables replicated as data — so one engine serves a
+    # model bigger than one chip and XLA inserts the head-gather/psum
+    # collectives itself.  Sharding is an annotation on the SAME
+    # executables: chunked prefill, speculative verify, sampling
+    # columns, journal/resume, and SSE failover compose unchanged, and
+    # output is token-identical to the tp=1 oracle.  Requires
+    # paged=True, n_heads % tp == 0 and kv_heads % tp == 0 (typed
+    # ShardingConfigError at construction), and tp visible devices
+    # (CPU: XLA_FLAGS=--xla_force_host_platform_device_count=N).
+    tp: int = 1
     # Chunked prefill (docs/serving.md "Scheduling"): cap the prompt
     # tokens one tick may spend on ingestion.  A prompt whose
     # (post-prefix-match) length exceeds the budget is admitted into a
@@ -550,6 +565,32 @@ class InferenceEngine:
                 raise ValueError(
                     f"prefill_chunk_tokens must be >= 1 (or 0 to "
                     f"disable), got {engine_cfg.prefill_chunk_tokens}")
+        # Tensor-parallel mesh (EngineConfig.tp): the engine OWNS the
+        # mesh — built once here, params and the page pool placed on
+        # it, and every executable below jitted with in/out shardings
+        # from it.  All validation is typed and happens NOW, never as
+        # an XLA shape crash inside the first tick.
+        from horovod_tpu.serving.sharding import (
+            ServingSharding, ShardingConfigError)
+        self._shard: Optional[ServingSharding] = None
+        self.mesh = None
+        if engine_cfg.tp < 1:
+            raise ShardingConfigError(
+                f"EngineConfig.tp must be >= 1, got {engine_cfg.tp}")
+        if engine_cfg.tp > 1:
+            if not engine_cfg.paged:
+                raise ShardingConfigError(
+                    "EngineConfig.tp > 1 requires paged=True (the tp "
+                    "mesh shards the paged KV pool by head; the "
+                    "slot-contiguous A/B cache stays single-device)")
+            self._shard = ServingSharding(
+                cfg, engine_cfg.tp,
+                draft_cfg=draft_cfg if self._spec_model else None)
+            self.mesh = self._shard.mesh
+            self.params = self._shard.shard_params(self.params)
+            if self._spec_model:
+                self.draft_params = self._shard.shard_params(
+                    self.draft_params, self.draft_cfg)
         self.slots = self._make_slots()
         self.metrics = ServingMetrics()
         self.scheduler = Scheduler(
@@ -622,6 +663,29 @@ class InferenceEngine:
         # after warmup.
         self._decode_traces = 0
 
+        # Tensor-parallel in/out shardings for every executable below
+        # (all None on a single-device engine).  The placement rule:
+        # params and the page pool carry their head-sharded placements;
+        # EVERYTHING the host uploads or fetches (tokens, masks,
+        # tables, sampling columns, logits, acceptance) is pinned
+        # REPLICATED.  Explicit shardings keep executable signatures
+        # stable — a fed-back committed output and a fresh host upload
+        # hit the same compiled program — so the zero-decode-recompile
+        # guard holds under tp unchanged.
+        shd = self._shard
+        self._sh_R = _R = shd.replicated if shd else None
+        self._sh_params = _psh = shd.param_shardings() if shd else None
+        self._sh_draft_params = _dpsh = (
+            shd.param_shardings(draft_cfg)
+            if shd and self._spec_model else None)
+        _poolsh = shd.pool_shardings(self.slots.quantized) if shd else None
+        _dpoolsh = (shd.pool_shardings(False)
+                    if shd and self._spec_model else None)
+        self._sh_prefill = _kvsh = (shd.prefill_cache_shardings()
+                                    if shd else None)
+        self._sh_prefix = _presh = (shd.prefix_kv_sharding()
+                                    if shd else None)
+
         if engine_cfg.paged and self._spec:
             # The SPECULATIVE tick: draft -> one batched W-position
             # verify -> accepted-prefix select, all device-resident.
@@ -661,7 +725,11 @@ class InferenceEngine:
                     return (jnp.where(active, nxt, 0), t, mx, acc,
                             pool, dpool)
 
-                self._tick_fn = jax.jit(_tick, donate_argnums=(7, 8))
+                self._tick_fn = self._jit(
+                    _tick, donate=(7, 8),
+                    in_s=shd and (_psh, _dpsh, _R, _R, _R, _R, _R,
+                                  _poolsh, _dpoolsh, _R, _R, _R, _R),
+                    out_s=shd and (_R, _R, _R, _R, _poolsh, _dpoolsh))
             else:
                 def _tick(params, tokens, active, spec_on, table, pool,
                           hist, s_t, s_k, s_p, s_key):
@@ -693,7 +761,11 @@ class InferenceEngine:
                     return (jnp.where(active, nxt, 0), t, mx, acc,
                             pool, hist)
 
-                self._tick_fn = jax.jit(_tick, donate_argnums=(5, 6))
+                self._tick_fn = self._jit(
+                    _tick, donate=(5, 6),
+                    in_s=shd and (_psh, _R, _R, _R, _R, _poolsh, _R,
+                                  _R, _R, _R, _R),
+                    out_s=shd and (_R, _R, _R, _R, _poolsh, _R))
 
             # The PLAIN one-token executable rides alongside: a tick
             # where no slot speculates (every request opted out, or
@@ -713,7 +785,10 @@ class InferenceEngine:
                 mx = jnp.max(logits, axis=-1)
                 return jnp.where(active, nxt, 0), mx, pool
 
-            self._plain_tick_fn = jax.jit(_ptick, donate_argnums=(4,))
+            self._plain_tick_fn = self._jit(
+                _ptick, donate=(4,),
+                in_s=shd and (_psh, _R, _R, _R, _poolsh, _R, _R, _R, _R),
+                out_s=shd and (_R, _R, _poolsh))
             donate = None
         elif engine_cfg.paged:
             def _tick(params, tokens, active, table, pool, s_t, s_k,
@@ -761,7 +836,11 @@ class InferenceEngine:
         # active mask.)  The speculative variants jit themselves above
         # (their pool/draft-pool/history argnums differ).
         if donate is not None:
-            self._tick_fn = jax.jit(_tick, donate_argnums=(donate,))
+            self._tick_fn = self._jit(
+                _tick, donate=(donate,),
+                in_s=shd and (_psh, _R, _R, _R, _poolsh,
+                              _R, _R, _R, _R),
+                out_s=shd and (_R, _R, _poolsh))
         self._prefill_fns: Dict[tuple, Callable] = {}
         self._prefill_traces = 0
         self._prefill_calls = 0  # prefill FORWARD PASSES (sharing hook)
@@ -791,7 +870,10 @@ class InferenceEngine:
             # jax.jit caches per (n_prefix_pages, bucket, k) shape; the
             # prefix length p0 is a traced scalar, so prefixes of any
             # length share the page-granular compile set.
-            self._suffix_prefill = jax.jit(_suffix_prefill)
+            self._suffix_prefill = self._jit(
+                _suffix_prefill,
+                in_s=shd and (_psh, _R, _R, _presh, _presh, _R),
+                out_s=shd and (_R, _kvsh))
             self.metrics.kv_pages_total.set(self.slots.n_pages)
             self.metrics.kv_pages_free.set(self.slots.free_pages)
             self.metrics.kv_bytes_per_token.set(self.slots.bytes_per_token)
@@ -823,12 +905,16 @@ class InferenceEngine:
         self._draft_prefill_fns: Dict[tuple, Callable] = {}
         if self._spec and not self._spec_model:
             # One scatter lands an admission group's prompt rows in the
-            # history (jit caches per (k, bucket) shape).
-            self._hist_land = jax.jit(
+            # history (jit caches per (k, bucket) shape).  Replicated
+            # in/out under tp: the history is committed tick data, and
+            # pinning it keeps its placement on the mesh device set the
+            # spec tick expects.
+            self._hist_land = self._jit(
                 lambda hist, slots, padded: hist.at[
                     slots[:, None],
                     jnp.arange(padded.shape[1])[None, :]].set(padded),
-                donate_argnums=(0,))
+                donate=(0,),
+                in_s=shd and (_R, _R, _R), out_s=shd and _R)
 
         # Overlapped-pipeline state (engine_cfg.overlap).  _pending is
         # the ONE in-flight decode tick: its un-fetched device outputs
@@ -844,8 +930,11 @@ class InferenceEngine:
         self._dev_active_host: Optional[np.ndarray] = None
         # where(mask, vals, toks): lands freshly admitted slots' first
         # tokens in the device token vector (one tiny async op).
-        self._merge_tokens = jax.jit(
-            lambda toks, vals, mask: jnp.where(mask, vals, toks))
+        # Replicated in/out under tp — its output IS the next tick's
+        # token input, so the placement must match the tick's.
+        self._merge_tokens = self._jit(
+            lambda toks, vals, mask: jnp.where(mask, vals, toks),
+            in_s=shd and (_R, _R, _R), out_s=shd and _R)
 
         # Per-slot sampling columns (serving/sampling.py): temperature /
         # top_k / top_p / PRNG key rows ride the tick as DATA — one
@@ -864,7 +953,9 @@ class InferenceEngine:
                 logits, s_t, s_k, s_p, s_key, positions,
                 jnp.zeros_like(positions))
 
-        self._first_sample = jax.jit(_first_sample)
+        self._first_sample = self._jit(
+            _first_sample,
+            in_s=shd and (_R, _R, _R, _R, _R, _R), out_s=shd and _R)
 
         # Token-rate window for achieved FLOP/s: (monotonic, tokens)
         # samples taken at each stats() call, pruned to ~60s — the
@@ -877,6 +968,7 @@ class InferenceEngine:
         if engine_cfg.model_flops_per_token:
             self.metrics.model_flops_per_token.set(
                 engine_cfg.model_flops_per_token)
+        self.metrics.tp_degree.set(engine_cfg.tp)
 
     # -- lifecycle / health ------------------------------------------------
 
@@ -1105,12 +1197,24 @@ class InferenceEngine:
 
     # -- paged cache plumbing ----------------------------------------------
 
+    def _jit(self, fn, *, donate=(), in_s=None, out_s=None):
+        """``jax.jit`` with the tp mesh's in/out shardings when the
+        engine is sharded (plain jit on a single-device engine —
+        ``in_s``/``out_s`` are None there by construction, and an
+        EXPLICIT ``in_shardings=None`` would mean replicate-everything,
+        which is not the same as unspecified)."""
+        if self._shard is None or in_s is None:
+            return jax.jit(fn, donate_argnums=donate)
+        return jax.jit(fn, donate_argnums=donate,
+                       in_shardings=in_s, out_shardings=out_s)
+
     def _make_slots(self):
         ec = self.engine_cfg
         if ec.paged:
             return PagedSlotCache(self.cfg, ec.n_slots, ec.max_len,
                                   page_size=ec.page_size,
-                                  n_pages=ec.n_pages, kv_dtype=ec.kv_dtype)
+                                  n_pages=ec.n_pages, kv_dtype=ec.kv_dtype,
+                                  mesh=self.mesh)
         return SlotCache(self.cfg, ec.n_slots, ec.max_len)
 
     def _make_draft_slots(self) -> Optional[PagedSlotCache]:
@@ -1125,7 +1229,8 @@ class InferenceEngine:
         return PagedSlotCache(self.draft_cfg, ec.n_slots,
                               self.slots.max_len,
                               page_size=ec.page_size,
-                              n_pages=ec.draft_n_pages)
+                              n_pages=ec.draft_n_pages,
+                              mesh=self.mesh)
 
     def _release_slot(self, slot: int) -> None:
         """Free a slot in the target pool AND its speculative
@@ -1604,7 +1709,11 @@ class InferenceEngine:
                 return T.prefill(params, padded, cache, dcfg,
                                  true_len=true_lens)
 
-            fn = jax.jit(_prefill)
+            fn = self._jit(
+                _prefill,
+                in_s=self._shard and (self._sh_draft_params, self._sh_R,
+                                      self._sh_R),
+                out_s=self._shard and (self._sh_R, self._sh_prefill))
             self._draft_prefill_fns[(bucket, k)] = fn
         return fn
 
@@ -2099,7 +2208,11 @@ class InferenceEngine:
                 return T.prefill(params, padded, cache, self.cfg,
                                  true_len=true_lens)
 
-            fn = jax.jit(_prefill)
+            fn = self._jit(
+                _prefill,
+                in_s=self._shard and (self._sh_params, self._sh_R,
+                                      self._sh_R),
+                out_s=self._shard and (self._sh_R, self._sh_prefill))
             self._prefill_fns[(bucket, k)] = fn
         return fn
 
@@ -3415,6 +3528,9 @@ class InferenceEngine:
     def stats(self) -> Dict:
         age = self.heartbeat_age
         self._update_achieved_flops()
+        # Re-assert on the CURRENT metrics object: benchmarks swap in a
+        # fresh ServingMetrics after warmup, which would zero the gauge.
+        self.metrics.tp_degree.set(self.engine_cfg.tp)
         return {
             **self.metrics.snapshot(),
             "state": self._health,
@@ -3428,6 +3544,15 @@ class InferenceEngine:
             "occupancy": float(self.slots.occupancy),
             "engine_state": str(self._health),
             "heartbeat_age_s": round(age, 3) if age is not None else -1.0,
+            # Routing-contract additions (docs/serving.md
+            # "Tensor-parallel replicas"): always present, always
+            # typed — tp is the replica's tensor-parallel degree
+            # (int >= 1), mesh its axis/device layout (str; "" on an
+            # unsharded engine) — so the registry and the router's
+            # per-replica fleet view surface serving topology.
+            "tp": int(self.engine_cfg.tp),
+            "mesh": self._shard.describe() if self._shard is not None
+            else "",
             "state_transitions": self.state_transitions,
             "n_slots": self.engine_cfg.n_slots,
             "slots_active": self.slots.active_count,

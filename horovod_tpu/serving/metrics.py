@@ -220,6 +220,14 @@ class ServingMetrics:
             "serving_streamed_ttfb_seconds",
             "Request arrival to first streamed token event on the "
             "wire (the honest user-facing TTFT for stream=true)")
+        # Tensor-parallel serving (docs/serving.md "Tensor-parallel
+        # replicas"): the replica's tp degree as a gauge so a fleet
+        # dashboard can tell a tp=4 replica's tok/s from four tp=1
+        # replicas' at a glance (cataloged in docs/observability.md).
+        self.tp_degree = r.gauge(
+            "serving_tp_degree",
+            "Tensor-parallel degree of this engine's serving mesh "
+            "(1 = unsharded single-device serving)")
         self.model_flops_per_token = r.gauge(
             "serving_model_flops_per_token",
             "Configured model FLOPs per generated token "

@@ -17,6 +17,12 @@ contract keys (docs/serving.md "HTTP API"):
   rotation; a fresh replica that NEVER ticks gets ``startup_grace``
   from the moment it is added before the same judgment.
 
+The contract also carries two INFORMATIONAL typed keys — ``tp`` (the
+replica's tensor-parallel degree) and ``mesh`` (its device layout;
+docs/serving.md "Tensor-parallel replicas") — surfaced per replica in
+the router's ``/stats`` fleet view but never routed on: a tp=K
+replica is one queue like any other.
+
 ``fail_threshold`` consecutive poll failures (connection refused,
 timeout, garbage payload) also evict — a SIGKILL'd replica stops
 answering long before anyone inspects its exit code.  The proxy path
@@ -81,6 +87,14 @@ class ReplicaStatus:
     occupancy: float = 0.0
     engine_state: str = "unknown"
     heartbeat_age_s: float = -1.0
+    # Serving topology (docs/serving.md "Tensor-parallel replicas"):
+    # the replica's tensor-parallel degree and mesh layout, surfaced
+    # from the /stats contract's typed tp/mesh keys so operators (and
+    # capacity planners reading the router's per-replica view) can
+    # tell one tp=K replica from K tp=1 replicas.  Informational —
+    # routing still balances on queue_depth/occupancy alone.
+    tp: int = 1
+    mesh: str = ""
     added_at: float = 0.0
     last_ok: Optional[float] = None     # monotonic time of last good poll
     consecutive_failures: int = 0
@@ -97,6 +111,8 @@ class ReplicaStatus:
             "occupancy": self.occupancy,
             "engine_state": self.engine_state,
             "heartbeat_age_s": self.heartbeat_age_s,
+            "tp": self.tp,
+            "mesh": self.mesh,
             "consecutive_poll_failures": self.consecutive_failures,
             "marked_failed": self.marked_failed,
             "polls": self.polls,
@@ -244,6 +260,10 @@ class ReplicaRegistry:
                 occ = float(snap["occupancy"])
                 state = str(snap["engine_state"])
                 hb = float(snap["heartbeat_age_s"])
+                # tp/mesh joined the contract in PR 15; .get defaults
+                # keep a mixed-version fleet pollable during a rollout.
+                tp = int(snap.get("tp", 1))
+                mesh_desc = str(snap.get("mesh", ""))
             except Exception as e:
                 self.metrics.poll_errors.inc()
                 with self._lock:
@@ -271,6 +291,8 @@ class ReplicaRegistry:
                 st.occupancy = occ
                 st.engine_state = state
                 st.heartbeat_age_s = hb
+                st.tp = tp
+                st.mesh = mesh_desc
                 st.last_ok = time.monotonic()
                 st.consecutive_failures = 0
                 # Clear the proxy-side eviction only if no NEW mark

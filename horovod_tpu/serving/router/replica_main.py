@@ -121,6 +121,14 @@ def main(argv=None) -> int:
     ap.add_argument("--d-ff", type=int, default=64)
     ap.add_argument("--max-seq", type=int, default=48)
     ap.add_argument("--kv-heads", type=int, default=2)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree: shard this replica's "
+                         "engine over a tp-device GSPMD mesh (heads + "
+                         "MLP hidden split, paged KV pool head-"
+                         "sharded; docs/serving.md 'Tensor-parallel "
+                         "replicas').  Needs tp visible devices — on "
+                         "CPU hosts the forced-host-device flag is "
+                         "armed automatically when absent")
     ap.add_argument("--slots", type=int, default=4)
     ap.add_argument("--max-queue-depth", type=int, default=64)
     ap.add_argument("--max-prefills-per-tick", type=int, default=2)
@@ -158,6 +166,15 @@ def main(argv=None) -> int:
                          "tests; repeatable)")
     args = ap.parse_args(argv)
 
+    if args.tp > 1:
+        # Devices must exist BEFORE the backend spins up.  The
+        # supervisor already sets the flag in every tp replica's
+        # spawn env (the reliable path); this covers bare
+        # `python -m ... --tp N` runs on CPU hosts.
+        from horovod_tpu.serving.sharding import ensure_devices
+
+        ensure_devices(args.tp)
+
     from horovod_tpu import serving
     from horovod_tpu.serving.router.supervisor import (
         EXIT_CODE_REPLICA_FAILED,
@@ -191,6 +208,7 @@ def main(argv=None) -> int:
             max_queue_depth=args.max_queue_depth,
             max_prefills_per_tick=args.max_prefills_per_tick,
             tick_timeout=args.tick_timeout,
+            tp=args.tp,
             resume=not args.no_resume,
             journal_path=args.journal or None, faults=inj))
     if args.warm:
@@ -217,7 +235,7 @@ def main(argv=None) -> int:
         request_timeout=args.request_timeout).start()
     host, port = srv.address
     print(f"replica ready on {host}:{port} (slots={args.slots}, "
-          f"pid={os.getpid()})", flush=True)
+          f"tp={args.tp}, pid={os.getpid()})", flush=True)
 
     failed = False
     while not stop_requested.is_set():

@@ -82,6 +82,19 @@ class ReplicaSpec:
     d_ff: int = 64
     max_seq: int = 48
     n_kv_heads: int = 2
+    #: tensor-parallel degree per replica (docs/serving.md
+    #: "Tensor-parallel replicas"): each replica process owns a tp-
+    #: device GSPMD mesh.  The supervisor hands every SLOT a DISJOINT
+    #: device set — accelerator hosts via the visible-devices envs
+    #: (CUDA_VISIBLE_DEVICES / TPU_VISIBLE_DEVICES: slot s gets
+    #: ordinals [s*tp, (s+1)*tp), filled only when the operator has
+    #: not pinned them; multi-host TPU topologies additionally need
+    #: operator-set TPU_PROCESS_BOUNDS — out of scope here), CPU
+    #: hosts via forced host-device partitioning (each process's
+    #: virtual devices are private to it by construction) — so N tp-K
+    #: replicas coexist behind the same router with failover/resume/
+    #: streaming unchanged.
+    tp: int = 1
     slots: int = 4
     max_queue_depth: int = 64
     max_prefills_per_tick: int = 2
@@ -114,6 +127,8 @@ class ReplicaSpec:
                     "--d-ff", str(self.d_ff),
                     "--max-seq", str(self.max_seq),
                     "--kv-heads", str(self.n_kv_heads)]
+        if self.tp > 1:
+            cmd += ["--tp", str(self.tp)]
         for w in self.warm:
             cmd += ["--warm", str(w)]
         for f in self.faults:
@@ -341,6 +356,25 @@ class ReplicaSupervisor:
             os.path.dirname(os.path.abspath(__file__)))))
         env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
                              if env.get("PYTHONPATH") else pkg_root)
+        # Tensor-parallel replicas get a DISJOINT device set per SLOT
+        # (stable across respawns — a respawned generation inherits
+        # its slot's devices, never a survivor's): accelerator hosts
+        # via the visible-devices env, CPU hosts via the forced-host-
+        # device flag (each process's virtual devices are private to
+        # it, so disjointness is by construction).  An operator who
+        # already pinned the env wins — the supervisor only fills
+        # blanks.
+        tp = getattr(self._spec, "tp", 1) if not callable(self._spec) \
+            else 1
+        if tp > 1:
+            flag = "--xla_force_host_platform_device_count"
+            if flag not in env.get("XLA_FLAGS", ""):
+                env["XLA_FLAGS"] = (
+                    f"{env.get('XLA_FLAGS', '')} {flag}={tp}".strip())
+            ordinals = ",".join(str(slot * tp + i) for i in range(tp))
+            for var in ("CUDA_VISIBLE_DEVICES", "TPU_VISIBLE_DEVICES"):
+                if var not in env:
+                    env[var] = ordinals
         prev = self._handles.get(slot)
         restarts = prev.restarts + 1 if prev is not None else 0
         journal_path = self._arm_gen_file(
