@@ -1,0 +1,158 @@
+"""Replay-based perf-regression gate (docs/serving.md "Autotuning").
+
+Replays the COMMITTED miniature journal trace
+(``benchmarks/data/replay_trace.jsonl``) through a freshly built
+engine — same seeded toy model the trace was captured against — and
+fails if either:
+
+* any request's replayed output is not token-identical to what the
+  journal recorded (greedy decode is a pure function of the token
+  sequence, sampled decode of (sequence, seed): a mismatch means the
+  serving oracle broke), or
+* the replay score drops more than ``--tolerance`` (default 20%)
+  below the committed baseline (``benchmarks/data/replay_baseline.
+  json``): a serving-path perf regression.
+
+CPU smoke by design: the committed trace is tiny (toy model, short
+prompts) so the gate runs anywhere tier-1 does.  After an INTENDED
+serving change shifts the score, re-record with::
+
+    python benchmarks/replay_gate.py --record
+
+which regenerates BOTH files — the trace (fresh capture of the fixed
+workload below) and the baseline (score of replaying it).  Commit the
+pair together; a baseline from someone else's machine gates relative
+score, not absolute wall-clock, so the 20% band absorbs host noise
+(score is dominated by tokens-per-tick, which is deterministic for a
+synchronous replay).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+TRACE = os.path.join(DATA, "replay_trace.jsonl")
+BASELINE = os.path.join(DATA, "replay_baseline.json")
+
+
+def _build_engine(journal_path=None):
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu import serving
+    from horovod_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=48, dtype=jnp.float32, attention_impl="reference",
+        n_kv_heads=2)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    return serving.InferenceEngine(
+        params, cfg, serving.EngineConfig(
+            n_slots=4, max_len=48, max_queue_depth=64,
+            max_prefills_per_tick=2, prefill_chunk_tokens=16,
+            tick_timeout=0.0, journal_path=journal_path))
+
+
+def record() -> dict:
+    """Capture the fixed workload into the committed trace, then
+    score a replay of it as the new baseline."""
+    import numpy as np
+
+    from horovod_tpu.tuning.replay import read_trace, replay, warm_lens
+
+    os.makedirs(DATA, exist_ok=True)
+    if os.path.exists(TRACE):
+        os.remove(TRACE)
+    engine = _build_engine(journal_path=TRACE)
+    engine.warmup([6, 16, 30])
+    rng = np.random.RandomState(7)
+    futs = []
+    for i in range(20):
+        n = int(rng.randint(3, 31))
+        prompt = [int(x) for x in rng.randint(1, 60, size=n)]
+        sampled = (i % 4 == 0)
+        futs.append(engine.submit(
+            prompt, max_new_tokens=int(rng.randint(4, 9)),
+            temperature=0.7 if sampled else 0.0,
+            seed=100 + i if sampled else None,
+            priority="interactive" if i % 3 else "batch"))
+    while not all(f.done() for f in futs):
+        engine.step()
+    for f in futs:
+        f.result(timeout=1)
+    engine.stop()
+
+    trace = read_trace(TRACE)
+    engine = _build_engine()
+    engine.warmup(warm_lens(trace, engine))
+    report = replay(engine, trace, timing="afap")
+    engine.stop()
+    assert report.token_identical == report.compared, \
+        f"fresh capture must replay identically: {report.mismatched_ids}"
+    baseline = {"score": report.score,
+                "tokens_per_tick": report.tokens_per_tick,
+                "requests": report.requests,
+                "report": report.to_json()}
+    with open(BASELINE, "w", encoding="utf-8") as f:
+        json.dump(baseline, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"recorded {report.requests} requests -> {TRACE}\n"
+          f"baseline score {report.score} -> {BASELINE}")
+    return baseline
+
+
+def gate(tolerance: float = 0.2) -> dict:
+    """Replay the committed trace; return the verdict dict (and the
+    full report).  Raises SystemExit(1) on failure when run as a
+    script — callers (the slow-marked test) check ``ok`` instead."""
+    from horovod_tpu.tuning.replay import read_trace, replay, warm_lens
+
+    with open(BASELINE, "r", encoding="utf-8") as f:
+        baseline = json.load(f)
+    trace = read_trace(TRACE)
+    engine = _build_engine()
+    engine.warmup(warm_lens(trace, engine))
+    report = replay(engine, trace, timing="afap")
+    engine.stop()
+    floor = baseline["score"] * (1.0 - tolerance)
+    verdict = {
+        "ok": (report.token_identical == report.compared
+               and report.score >= floor
+               and report.decode_recompiles == 0),
+        "token_identical": report.token_identical,
+        "compared": report.compared,
+        "mismatched_ids": report.mismatched_ids,
+        "score": report.score,
+        "baseline_score": baseline["score"],
+        "floor": round(floor, 6),
+        "decode_recompiles": report.decode_recompiles,
+        "report": report.to_json(),
+    }
+    return verdict
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--record", action="store_true",
+                    help="regenerate the committed trace AND baseline "
+                         "(after an intended serving change)")
+    ap.add_argument("--tolerance", type=float, default=0.2,
+                    help="allowed fractional score drop vs baseline")
+    args = ap.parse_args()
+    if args.record:
+        record()
+        return 0
+    verdict = gate(args.tolerance)
+    print(json.dumps({k: v for k, v in verdict.items()
+                      if k != "report"}))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

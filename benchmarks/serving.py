@@ -1164,6 +1164,180 @@ def _slo_mode(args, T) -> None:
     print(json.dumps(result))
 
 
+def _autotune_mode(args, T) -> None:
+    """Autotuning A/B (``--autotune``, docs/serving.md "Autotuning"):
+    TWO CONTRASTING workloads — a short-prompt interactive burst and a
+    long-prompt batch stream (with an interactive trickle whose TTFT
+    constraint the tuner must respect) — each served twice over
+    identical arrivals:
+
+    * **static**: the engine's config defaults, untouched;
+    * **tuned**: the online tuner converges on a separate convergence
+      drive drawn from the same workload distribution, PINS, and then
+      the measured run replays the identical arrivals under the
+      pinned knobs.
+
+    The JSON line carries ``tuned_knobs``, ``tuning_samples``, the
+    objective trajectory, per-class TTFT, throughput, and
+    ``decode_recompiles`` (must be 0: every knob the online tuner may
+    touch maps to an already-warmed executable shape)."""
+    from horovod_tpu import serving
+    from horovod_tpu.tuning import Objective, OnlineTuner
+
+    steps = min(args.steps, 12)
+    long_len, chunk = 160, 32
+    cfg = T.TransformerConfig(
+        vocab_size=args.vocab, d_model=args.d_model,
+        n_heads=args.n_heads, n_layers=args.n_layers, d_ff=args.d_ff,
+        max_seq=long_len + 2 * steps + 32,
+        n_kv_heads=args.kv_heads[-1] if args.kv_heads else 0,
+        attention_impl="reference",
+        dtype=jnp.float32 if jax.devices()[0].platform == "cpu"
+        else jnp.bfloat16)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+
+    def make_workload(name):
+        rng = np.random.default_rng(1)
+        work = []  # (arrival_s, prompt, priority)
+        if name == "interactive_burst":
+            # Bursty waves of short prompts: the tuner should favor
+            # wide admission (high k) — prefill dominates.
+            t = 0.0
+            for wave in range(4):
+                for j in range(6):
+                    n = int(rng.integers(3, 13))
+                    work.append((t + 0.004 * j,
+                                 rng.integers(0, cfg.vocab_size,
+                                              n).tolist(),
+                                 "interactive"))
+                t += 0.08
+        else:  # long_batch
+            # A stream of long batch prompts with an interactive
+            # trickle riding along: throughput tuning must not buy
+            # tokens by starving the trickle past its TTFT SLO.
+            t = 0.0
+            for wave in range(3):
+                for j in range(3):
+                    n = int(rng.integers(long_len - 32, long_len + 1))
+                    work.append((t, rng.integers(0, cfg.vocab_size,
+                                                 n).tolist(), "batch"))
+                for j in range(2):
+                    n = int(rng.integers(3, 13))
+                    work.append((t + 0.02 * (j + 1),
+                                 rng.integers(0, cfg.vocab_size,
+                                              n).tolist(),
+                                 "interactive"))
+                t += 0.15
+        return work
+
+    slo = {"interactive": 0.5}
+
+    def run(work, tuned: bool):
+        engine = serving.InferenceEngine(
+            params, cfg, serving.EngineConfig(
+                n_slots=4, max_len=cfg.max_seq,
+                max_prefills_per_tick=args.max_prefills_per_tick,
+                max_queue_depth=64, prefill_chunk_tokens=chunk))
+        lens = sorted({len(p) for _, p, _ in work})
+        engine.warmup([lens[0], lens[len(lens) // 2], lens[-1]])
+        warm_compiles = engine.decode_compilations
+        tuning = None
+        engine.start()
+        if tuned:
+            # Convergence drive: waves drawn from the same workload
+            # distribution until the tuner pins (cap bounds the run).
+            tuner = OnlineTuner.install(
+                engine, window_ticks=8, bo_samples=6,
+                objective=Objective(ttft_slo=slo))
+            for wave in range(120):
+                if tuner.phase == "pinned":
+                    break
+                futs = [engine.submit(p, max_new_tokens=steps,
+                                      priority=pri)
+                        for _, p, pri in work[:8]]
+                while not all(f.done() for f in futs):
+                    time.sleep(0.002)
+            snap = tuner.snapshot()
+            tuning = {
+                "tuned_knobs": snap["best"]["settings"],
+                "tuning_samples": snap["samples"],
+                "converged": tuner.converged,
+                "trajectory": [
+                    {"sample": e["sample"], "phase": e["phase"],
+                     "settings": e["settings"],
+                     "objective": e["objective"],
+                     "violated": e["violated"]}
+                    for e in snap["trajectory"]],
+            }
+        # The measured leg: identical arrivals for both A/B sides;
+        # fresh metrics so the tuner's convergence traffic (tuned leg)
+        # does not pollute the measurement (the tuner's window resets
+        # on the metrics swap).
+        engine.metrics = serving.ServingMetrics()
+        futs = []
+        t0 = time.monotonic()
+        for arrival, prompt, pri in work:
+            now = time.monotonic() - t0
+            if now < arrival:
+                time.sleep(arrival - now)
+            futs.append((pri, engine.submit(
+                prompt, max_new_tokens=steps, priority=pri)))
+        while not all(f.done() for _, f in futs):
+            time.sleep(0.002)
+        wall = time.monotonic() - t0
+        engine.stop()
+        by_class = {}
+        for pri, f in futs:
+            if f.ttft is not None:
+                by_class.setdefault(pri, []).append(f.ttft)
+        toks = sum(len(f.tokens_so_far()) for _, f in futs)
+        out = {
+            "tok_s": round(toks / wall, 1),
+            "wall_s": round(wall, 3),
+            "decode_recompiles":
+                engine.decode_compilations - warm_compiles,
+        }
+        for cls, vals in sorted(by_class.items()):
+            vals.sort()
+            out[f"{cls}_ttft_p99_ms"] = round(
+                vals[min(len(vals) - 1,
+                         int(len(vals) * 0.99))] * 1e3, 2)
+        if tuning is not None:
+            out.update(tuning)
+        return out
+
+    result = {
+        "metric": "autotuned vs static serving knobs (online tuner, "
+                  f"pinned before measurement; S=4, chunk={chunk}, "
+                  f"{steps} toks/req)",
+        "unit": "tok_s ratio (tuned / static) per workload",
+        "ttft_slo_ms": {k: v * 1e3 for k, v in slo.items()},
+        "chip": jax.devices()[0].device_kind,
+    }
+    for name in ("interactive_burst", "long_batch"):
+        work = make_workload(name)
+        static = run(work, tuned=False)
+        tuned = run(work, tuned=True)
+        ratio = (tuned["tok_s"] / static["tok_s"]
+                 if static["tok_s"] else None)
+        slo_ms = slo["interactive"] * 1e3
+        result[name] = {
+            "ratio": round(ratio, 3) if ratio else None,
+            "interactive_ttft_ok":
+                tuned.get("interactive_ttft_p99_ms") is not None
+                and tuned["interactive_ttft_p99_ms"] <= slo_ms,
+            "static": static,
+            "tuned": tuned,
+        }
+        print(f"autotune {name}: tok/s {tuned['tok_s']} (tuned, "
+              f"{tuned['tuned_knobs']}) vs {static['tok_s']} (static) "
+              f"= {result[name]['ratio']}x | interactive TTFT p99 "
+              f"{tuned.get('interactive_ttft_p99_ms')}ms (SLO "
+              f"{slo_ms:.0f}ms) | {tuned['tuning_samples']} samples, "
+              f"{tuned['decode_recompiles']} decode recompiles")
+    print(json.dumps(result))
+
+
 def _engine_mode(args, T, cfg, params) -> None:
     """Open-loop continuous-batching benchmark: Poisson arrivals at
     ``--arrival-rate`` req/s with prompt lengths mixed over
@@ -1382,6 +1556,13 @@ def main() -> None:
                          "FCFS whole-prefill baseline; reports "
                          "per-class TTFT p50/p99, the interactive p99 "
                          "ratio, throughput, and oracle identity")
+    ap.add_argument("--autotune", action="store_true",
+                    help="autotuning A/B: tuned-then-pinned online "
+                         "knobs vs static defaults on two contrasting "
+                         "workloads (short-prompt interactive burst, "
+                         "long-prompt batch stream); reports tuned "
+                         "knobs, objective trajectory, per-class "
+                         "TTFT, and the zero-recompile guard")
     ap.add_argument("--slots", type=int, default=8,
                     help="engine mode: cache slots S")
     ap.add_argument("--max-prefills-per-tick", type=int, default=2,
@@ -1467,6 +1648,10 @@ def main() -> None:
 
     if args.slo:
         _slo_mode(args, T)
+        return
+
+    if args.autotune:
+        _autotune_mode(args, T)
         return
 
     if args.router:

@@ -286,6 +286,11 @@ def main() -> None:
                          "token-identical to tp=1 (docs/serving.md "
                          "'Tensor-parallel replicas').  CPU demos get "
                          "forced host devices automatically")
+    ap.add_argument("--autotune", action="store_true",
+                    help="install the online autotuner and drive a "
+                         "synthetic load until it converges, printing "
+                         "each sampled knob setting and its objective "
+                         "(docs/serving.md 'Autotuning')")
     ap.add_argument("--spans", default="",
                     help="(with --replicas) span-stream directory for "
                          "distributed tracing — the killed request's "
@@ -334,6 +339,17 @@ def main() -> None:
         detokenize=lambda t: f" {t}")
     if args.tp > 1:
         print(f"engine sharded over {engine.stats()['mesh']}")
+    if args.autotune:
+        # Warm FIRST (the tuner derives its compile-safe knob bounds
+        # from what warmup compiled), then install with demo-friendly
+        # pacing — short scoring windows so convergence is watchable.
+        from horovod_tpu.tuning import OnlineTuner
+
+        engine.warmup([2, 4])
+        tuner = OnlineTuner.install(engine, window_ticks=8,
+                                    bo_samples=5)
+        print(f"autotuner installed: knobs "
+              f"{sorted(tuner.space.defaults())}")
     # SIGTERM (k8s/systemd stop) -> graceful drain, same as Ctrl-C —
     # installed for the WHOLE serving lifetime, demo burst included:
     # the load balancer sees 503 on /healthz, admitted requests
@@ -378,6 +394,46 @@ def main() -> None:
           f"{stats['tokens_generated']} tokens, "
           f"decode compiles {stats['decode_compilations']}, "
           f"TTFT p50 {stats['ttft_seconds']['p50']}s")
+
+    if args.autotune:
+        # Drive waves of mixed traffic until the tuner pins (or a wave
+        # cap), printing each scored sample as it lands — live
+        # convergence, knob by knob.
+        tuner = engine._tuner
+        printed = 0
+        for wave in range(200):
+            if tuner.phase == "pinned":
+                break
+            waves = []
+            for i in range(args.slots * 2):
+                start = int(rng.integers(0, 24))
+                prompt = [(start + j) % 32 for j in range(2 + i % 3)]
+                req = urllib.request.Request(
+                    base + "/generate",
+                    data=json.dumps({"tokens": prompt,
+                                     "max_new_tokens": 6}).encode(),
+                    headers={"Content-Type": "application/json"})
+                t = threading.Thread(
+                    target=lambda r=req: urllib.request.urlopen(
+                        r, timeout=120).read())
+                t.start()
+                waves.append(t)
+            for t in waves:
+                t.join()
+            snap = tuner.snapshot()
+            for entry in snap["trajectory"][printed:]:
+                print(f"  sample {entry['sample']:>2} "
+                      f"[{entry['phase']}] {entry['settings']} -> "
+                      f"objective {entry['objective']:.3f}"
+                      + ("  (SLO violation, rolled back)"
+                         if entry["violated"] else ""))
+            printed = len(snap["trajectory"])
+        snap = tuner.snapshot()
+        print(f"autotune: phase={snap['phase']} after "
+              f"{snap['samples']} samples; best objective "
+              f"{snap['best']['objective']} with "
+              f"{snap['best']['settings']}; GET {base}/tuning "
+              f"serves this snapshot")
 
     if args.chaos:
         # One injected decode fault: the probe request fails typed

@@ -442,6 +442,21 @@ class EngineConfig:
     spec_min_acceptance: float = 0.25
     spec_window: int = 2
     spec_probe_period: int = 256
+    # Paged decode growth: grant this many pages AHEAD of the write
+    # position at each tick boundary (0 = exactly the write page, the
+    # historical behavior).  Pure page-table data — fewer grant calls
+    # per decoded page at the price of earlier page-pressure; its main
+    # role is as a compile-free online-tunable knob (tuning/params.py).
+    # _ensure_write_range caps the span at the request's last real
+    # write, so look-ahead never buys a page nobody keeps.
+    page_grant_ahead: int = 0
+    # Online autotuning (docs/serving.md "Autotuning"): after warmup()
+    # the engine installs a tuning.OnlineTuner over the compile-safe
+    # knob space derived from its warmed state and perturbs/scores/
+    # pins serving knobs from the tick loop.  Never changes emitted
+    # tokens, never compiles (the tuning/params.py contract); state in
+    # /stats["tuning"] and GET /tuning.
+    autotune: bool = False
     max_queue_depth: int = 64
     default_max_new_tokens: int = 64
     min_prefill_bucket: int = 8
@@ -662,6 +677,14 @@ class InferenceEngine:
         # compilations — the acceptance criterion asserts it stays at 1
         # after warmup.
         self._decode_traces = 0
+
+        # Online autotuner (tuning/tuner.py): installed at the END of
+        # warmup() when engine_cfg.autotune — the knob space must be
+        # derived from (and applied to) a fully WARMED engine, and a
+        # tuner live DURING warmup could shrink the admission batch
+        # mid-sweep and leave (bucket, k) shapes uncompiled.
+        self._tuner = None
+        self._warmed = False
 
         # Tensor-parallel in/out shardings for every executable below
         # (all None on a single-device engine).  The placement rule:
@@ -885,6 +908,11 @@ class InferenceEngine:
         # draft's device-resident token history, and the draft model's
         # own prefill compile cache.
         self._spec_host = np.ones(engine_cfg.n_slots, bool)
+        # Runtime speculation gate (tuning/params.py "spec_enabled"):
+        # pure admission-mask data — False routes NEW admissions down
+        # the plain greedy path (both tick executables are warmed, so
+        # the toggle never compiles and never changes emitted tokens).
+        self._spec_runtime_enabled = True
         self._dev_spec = None
         self._dev_spec_host: Optional[np.ndarray] = None
         # Adaptive speculation state (spec_adaptive): _spec_live is the
@@ -1569,10 +1597,15 @@ class InferenceEngine:
         next dispatch — the one-token point case of
         :meth:`_ensure_write_range` (which, like chunk ingestion,
         routes through the ONE :meth:`_claim_page` grant/COW/evict
-        protocol).  Returns False if ``s`` itself was evicted paying
-        for its page."""
+        protocol).  ``page_grant_ahead`` widens the span by that many
+        pages past the write position (capped by the range method at
+        the request's last real write — look-ahead never buys a page
+        nobody keeps).  Returns False if ``s`` itself was evicted
+        paying for its page."""
         wp = int(self._page_pos[s])
-        return self._ensure_write_range(s, wp, wp)
+        ahead = self.engine_cfg.page_grant_ahead
+        hi = wp + ahead * self.slots.page_size if ahead > 0 else wp
+        return self._ensure_write_range(s, wp, hi)
 
     def _prepare_paged_tick(self) -> None:
         """Tick-boundary page maintenance: every active slot gets a
@@ -1734,7 +1767,8 @@ class InferenceEngine:
             # every tick — the kernel also forces its acceptance to 0
             # as defense in depth, this just skips paying for drafts.
             self._spec_host[slot] = (req.speculative is not False
-                                     and req.temperature <= 0.0)
+                                     and req.temperature <= 0.0
+                                     and self._spec_runtime_enabled)
         if not self._spec_model:
             # FULL-WIDTH rows: zero the whole row, not just the prompt
             # bucket — a previous tenant's committed tokens beyond the
@@ -2048,6 +2082,15 @@ class InferenceEngine:
             self._consec_failures = 0
             if self._health == DEGRADED:
                 self._set_health(HEALTHY)
+        # Autotuner hook, OUTSIDE the step lock: a knob apply
+        # re-acquires it, which makes every swap a clean tick-boundary
+        # transaction (tuning/tuner.py).  Clean ticks only — a
+        # recovering tick's window would score restart noise.
+        if self._tuner is not None:
+            try:
+                self._tuner.on_tick(self, worked)
+            except Exception:  # tuning must never take serving down
+                self._tuner = None
         return worked
 
     def _reclaim_cancelled(self) -> bool:
@@ -3360,6 +3403,25 @@ class InferenceEngine:
         the engine's compile-set shape."""
         kmax = min(self.engine_cfg.max_prefills_per_tick,
                    self.engine_cfg.n_slots)
+        # The warm sweep's synthetic prompts are not traffic: keep
+        # them out of the journal so a journaled trace replays real
+        # requests only (tuning/replay.py), then restore it.
+        journal, self.journal = self.journal, None
+        try:
+            self._warm_sweep(prompt_lens, kmax)
+        finally:
+            self.journal = journal
+        self._warmed = True
+        if self.engine_cfg.autotune and self._tuner is None:
+            # Install AFTER the warm sweep: the knob space's compile-
+            # safe bounds are derived from what warmup just compiled,
+            # and a tuner live during warmup could shrink the
+            # admission batch mid-sweep and leave shapes uncompiled.
+            from horovod_tpu.tuning.tuner import OnlineTuner
+
+            OnlineTuner.install(self)
+
+    def _warm_sweep(self, prompt_lens: Sequence[int], kmax: int) -> None:
         prompts = [[0] * max(int(n), 1) for n in prompt_lens]
         # Registered prefixes compile their own executables (suffix
         # prefill per (prefix pages, suffix bucket, k), prefix-page
@@ -3580,6 +3642,13 @@ class InferenceEngine:
             "prefill_chunk_tokens": self.engine_cfg.prefill_chunk_tokens,
             "slots_ingesting": len(self._ingest),
             "speculative": self._spec,
+            # Online autotuning (docs/serving.md "Autotuning"):
+            # enabled flag always present; full tuner state (phase,
+            # current/best knobs, trajectory) rides along — and is
+            # served standalone at GET /tuning — once a tuner exists.
+            "autotune": self._tuner is not None,
+            **({"tuning": self._tuner.snapshot()}
+               if self._tuner is not None else {}),
             **({
                 "spec_k": self.engine_cfg.spec_k,
                 "spec_draft": "model" if self._spec_model else "ngram",

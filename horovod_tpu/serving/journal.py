@@ -96,6 +96,17 @@ class JournalEntry:
     #: ORIGINAL class: surviving a crash must neither promote nor
     #: demote a request.
     priority: str = "interactive"
+    #: ARRIVAL clocks (tuning/replay.py): ``arrival`` is the monotonic
+    #: offset in seconds from journal open — the inter-arrival spacing
+    #: a replay reproduces — and ``arrival_wall`` the absolute wall
+    #: clock of the same instant (the only form another process can
+    #: order against its own records).  Optional: journals written
+    #: before the arrival field replay in file order at zero offset.
+    arrival: Optional[float] = None
+    arrival_wall: Optional[float] = None
+    #: whether the original caller streamed (``on_token`` / SSE) — a
+    #: replay drives streamed requests through the same callback path.
+    stream: bool = False
     emitted: List[int] = dataclasses.field(default_factory=list)
     resumes: int = 0
 
@@ -140,6 +151,12 @@ class RequestJournal:
         self.path = path
         self._f = None
         self._dead_lines = 0
+        # Arrival epoch: begin-lines carry each request's monotonic
+        # offset from THIS instant (plus wall clock), so a replay
+        # (tuning/replay.py) reconstructs true inter-arrival spacing
+        # instead of inferring it from file order.
+        self._opened_mono = time.monotonic()
+        self._opened_wall = time.time()
         if path:
             d = os.path.dirname(os.path.abspath(path))
             os.makedirs(d, exist_ok=True)
@@ -165,7 +182,11 @@ class RequestJournal:
             top_k=getattr(req, "top_k", 0),
             top_p=getattr(req, "top_p", 0.0),
             seed=getattr(req, "seed", 0),
-            priority=getattr(req, "priority", "interactive"))
+            priority=getattr(req, "priority", "interactive"),
+            arrival=round(time.monotonic() - self._opened_mono, 6),
+            arrival_wall=time.time(),
+            stream=getattr(getattr(req, "future", None),
+                           "_on_token", None) is not None)
         with self._lock:
             self._entries[req.id] = entry
             self._write(self._begin_line(entry))
@@ -190,6 +211,13 @@ class RequestJournal:
             # Written only when non-default, like "samp": default-class
             # journals stay byte-compatible with pre-priority readers.
             line["pri"] = entry.priority
+        if entry.arrival is not None:
+            # [monotonic offset from journal open, wall clock] — a
+            # NEW key old readers simply ignore (byte-compatible), and
+            # the replay reader's arrival-spacing source of truth.
+            line["arr"] = [entry.arrival, entry.arrival_wall]
+        if entry.stream:
+            line["stream"] = 1
         return line
 
     def append(self, rid: int, tok: int) -> None:
@@ -307,6 +335,7 @@ class RequestJournal:
             e, rid = ev.get("e"), ev.get("id")
             if e == "b":
                 samp = ev.get("samp") or [0.0, 0, 0.0, 0]
+                arr = ev.get("arr") or [None, None]
                 live[rid] = JournalEntry(
                     id=rid, prompt=tuple(ev.get("prompt") or ()),
                     max_new_tokens=int(ev.get("max_new") or 0),
@@ -316,7 +345,9 @@ class RequestJournal:
                     span_id=ev.get("span"),
                     temperature=float(samp[0]), top_k=int(samp[1]),
                     top_p=float(samp[2]), seed=int(samp[3]),
-                    priority=ev.get("pri") or "interactive")
+                    priority=ev.get("pri") or "interactive",
+                    arrival=arr[0], arrival_wall=arr[1],
+                    stream=bool(ev.get("stream")))
             elif e == "t" and rid in live:
                 live[rid].emitted.append(int(ev["t"]))
             elif e == "r" and rid in live:

@@ -237,6 +237,24 @@ class ServingMetrics:
             "Achieved model FLOP/s over the recent token-rate window "
             "(tokens/sec x model_flops_per_token; 0 until configured "
             "and two samples apart)")
+        # Online autotuning (docs/serving.md "Autotuning"): one sample
+        # = one scored knob setting over one window of worked ticks.
+        # Registered unconditionally (cheap) so the families are
+        # documented and lint-checked whether or not a tuner runs.
+        self.tuning_samples = r.counter(
+            "tuning_samples_total",
+            "Knob settings scored by the online autotuner "
+            "(one per scoring window, warmup/settling discarded)")
+        self.tuning_rollbacks = r.counter(
+            "tuning_rollbacks_total",
+            "Tuning samples rolled back for violating a per-class "
+            "SLO constraint beyond the guard band")
+        self.tuning_objective = r.gauge(
+            "tuning_objective",
+            "Weighted objective of the most recent scored window")
+        self.tuning_best_objective = r.gauge(
+            "tuning_best_objective",
+            "Best constraint-satisfying objective seen this trajectory")
 
     # -- per-class observation hooks ---------------------------------------
 

@@ -160,6 +160,11 @@ def main(argv=None) -> int:
     ap.add_argument("--warm", type=int, action="append", default=[],
                     help="prompt lengths to pre-compile before "
                          "accepting traffic (repeatable)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="install the online autotuner after warmup "
+                         "(GET /tuning exposes its state; needs "
+                         "--warm so a warmed knob space exists — "
+                         "docs/serving.md 'Autotuning')")
     ap.add_argument("--fault", type=parse_fault, action="append",
                     default=[], metavar="SITE:KIND[:SKIP[:DELAY]]",
                     help="deterministic FaultInjector spec (chaos "
@@ -209,14 +214,17 @@ def main(argv=None) -> int:
             max_prefills_per_tick=args.max_prefills_per_tick,
             tick_timeout=args.tick_timeout,
             tp=args.tp,
+            autotune=args.autotune,
             resume=not args.no_resume,
             journal_path=args.journal or None, faults=inj))
-    if args.warm:
+    if args.warm or args.autotune:
         # Pre-compile BEFORE the listener exists: the registry's first
         # successful poll means "routable", and a routable replica must
         # never pay XLA compilation inside a request (or a tight
-        # watchdog budget).
-        engine.warmup(sorted(set(args.warm)))
+        # watchdog budget).  --autotune without --warm still warms the
+        # default length: the tuner installs at the END of warmup and
+        # derives its compile-safe knob bounds from what it compiled.
+        engine.warmup(sorted(set(args.warm)) or [1])
     if inj is not None:
         # --fault skips count from AFTER warmup (the post-warm
         # relative idiom from tests/test_chaos.py): how many probe

@@ -54,6 +54,9 @@ Endpoints:
 * ``GET /metrics`` — Prometheus text exposition (0.0.4): the engine's
   ``serving_*`` families plus the process default registry (training,
   elastic, eager-runtime, timeline families) in one scrape.
+* ``GET /tuning`` — autotuner state when ``EngineConfig.autotune`` is
+  on (phase, current/best knob settings, objective trajectory);
+  ``{"enabled": false}`` otherwise (docs/serving.md "Autotuning").
 
 Tracing (docs/observability.md): every ``/generate`` request gets a
 trace id — the ``X-Trace-Id`` header when present and valid, a minted
@@ -134,6 +137,14 @@ class _Handler(BaseHTTPRequestHandler):
             }, headers=None if code == 200 else {"Retry-After": "1"})
         elif self.path == "/stats":
             self._json(200, engine.stats())
+        elif self.path == "/tuning":
+            # Autotuner state: phase, current/best knob settings, and
+            # the objective trajectory (docs/serving.md "Autotuning").
+            tuner = engine._tuner
+            if tuner is None:
+                self._json(200, {"enabled": False})
+            else:
+                self._json(200, {"enabled": True, **tuner.snapshot()})
         elif self.path == "/metrics":
             # One scrape covers everything: the engine's private
             # serving_* registry plus the process-wide default registry

@@ -1,0 +1,55 @@
+"""Self-tuning serving: Bayesian autotuning over serving knobs plus a
+journaled-trace replay harness (docs/serving.md "Autotuning").
+
+The paper's signature layer-2 subsystem — ``ParameterManager`` scoring
+live throughput and tuning knobs by GP/EI Bayesian optimization —
+re-designed for the serving engine:
+
+* :mod:`~horovod_tpu.tuning.gp` — the ``common/optim/`` math in NumPy
+  (RBF-kernel GP with a conditioning guard, Expected-Improvement
+  acquisition, categorical sweep);
+* :mod:`~horovod_tpu.tuning.params` — the tunable-knob registry with
+  COMPILE-SAFE bounds: every online candidate maps to an
+  already-warmed executable shape, so tuning never triggers a
+  mid-serving XLA compile;
+* :mod:`~horovod_tpu.tuning.tuner` — the online tuner driven from the
+  engine's tick loop (``EngineConfig.autotune``): perturb per scoring
+  window, score against the existing SLO metrics, converge and pin,
+  roll back constraint violations;
+* :mod:`~horovod_tpu.tuning.replay` — reconstruct a journaled traffic
+  trace and re-drive an engine at original arrival spacing (or
+  as-fast-as-possible): the offline tuning backend and the
+  perf-regression gate (``python -m horovod_tpu.tuning.replay``).
+"""
+
+from horovod_tpu.tuning.gp import (
+    BayesianOptimizer,
+    CategoricalSweep,
+    ExpectedImprovement,
+    GaussianProcess,
+)
+from horovod_tpu.tuning.params import (
+    Knob,
+    KnobSpace,
+    apply_settings,
+    online_knob_space,
+)
+from horovod_tpu.tuning.tuner import (
+    Objective,
+    OnlineTuner,
+    WindowStats,
+)
+from horovod_tpu.tuning.replay import (
+    ReplayReport,
+    TraceRequest,
+    read_trace,
+    replay,
+)
+
+__all__ = [
+    "GaussianProcess", "ExpectedImprovement", "BayesianOptimizer",
+    "CategoricalSweep",
+    "Knob", "KnobSpace", "online_knob_space", "apply_settings",
+    "Objective", "OnlineTuner", "WindowStats",
+    "TraceRequest", "ReplayReport", "read_trace", "replay",
+]

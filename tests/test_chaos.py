@@ -549,7 +549,11 @@ class TestRestartResume:
         assert s["requests_resumed"] == 1
         assert s["engine_restarts"] == 1
 
+    @pytest.mark.slow
     def test_nonfinite_crash_resumes(self, model):
+        # Slow (PR 17 budget pass): ~4 s; test_nonfinite_logits_typed_
+        # failure keeps the nonfinite detection tier-1 and the resume
+        # path is exercised by the rest of TestRestartResume.
         """Non-finite logits poison the tick BEFORE emission — nothing
         from the bad tick is journaled, and the resume replays only
         oracle-emitted tokens."""
@@ -863,6 +867,52 @@ class TestJournalDurability:
         assert live["tr-span"]["span_id"] == span_id
         _run_until_done(engine, [fut])
 
+    def test_arrival_and_stream_survive_roundtrip_and_compaction(
+            self, model, tmp_path):
+        """SATELLITE (ISSUE 17): begin lines carry the request's
+        ARRIVAL (monotonic offset from journal open + wall clock) and
+        streaming flag, so a journaled trace replays at original
+        spacing (horovod_tpu/tuning/replay.py).  Both must survive
+        the full round trip — begin record, compaction rewrite,
+        read_live — and stay OPTIONAL for old journals (a begin line
+        without them still parses)."""
+        jp = str(tmp_path / "req.journal.jsonl")
+        engine = _engine(model, journal_path=jp)
+        fut = engine.submit([3, 4, 5], max_new_tokens=12,
+                            trace_id="tr-arr",
+                            on_token=lambda t, p: None)
+        for _ in range(300):
+            if len(fut.tokens_so_far()) >= 2:
+                break
+            engine.step()
+        raw = [json.loads(ln) for ln in open(jp)]
+        begin = [ev for ev in raw if ev["e"] == "b"][0]
+        mono, wall = begin["arr"]
+        assert 0.0 <= mono < 60.0          # offset from journal open
+        assert abs(wall - time.time()) < 60.0
+        assert begin["stream"] == 1        # on_token was set
+        # compaction re-serializes live entries: both fields survive
+        with engine.journal._lock:
+            engine.journal._compact_locked()
+        raw = [json.loads(ln) for ln in open(jp)]
+        begin2 = [ev for ev in raw if ev["e"] == "b"][0]
+        assert begin2["arr"] == [mono, wall]
+        assert begin2["stream"] == 1
+        # ... and through the replay-trace reader
+        from horovod_tpu.tuning.replay import read_trace
+
+        req = read_trace(jp)[0]
+        assert (req.arrival, req.stream) == (mono, True)
+        # byte-compat: a pre-arrival begin line (no arr/stream keys)
+        # still parses, replaying at zero offset, non-streamed
+        with open(jp, "w") as f:
+            f.write('{"e":"b","id":9,"prompt":[1,2],"max_new":4,'
+                    '"trace":"tr-old"}\n')
+        old = read_trace(jp)[0]
+        assert (old.arrival, old.stream) == (0.0, False)
+        assert serving.RequestJournal.read_live(jp)  # old reader path
+        _run_until_done(engine, [fut])
+
     def test_torn_final_line_tolerated(self, model, tmp_path):
         """A SIGKILL can land mid-write: every complete line before
         the torn one still parses."""
@@ -1047,7 +1097,14 @@ class TestChunkedPrefillChaos:
     the ordinary resume path and the re-ingested output is
     token-identical to the no-fault oracle."""
 
-    @pytest.mark.parametrize("chunk_idx", [0, 1, 3])
+    # chunks 1/3 are slow (PR 17 budget pass): chunk 0 keeps the
+    # crash-at-a-chunk-boundary resume path tier-1; the later
+    # boundaries re-run the same site with landed pages to discard.
+    @pytest.mark.parametrize(
+        "chunk_idx",
+        [0,
+         pytest.param(1, marks=pytest.mark.slow),
+         pytest.param(3, marks=pytest.mark.slow)])
     def test_crash_at_each_chunk_boundary_oracle_exact(self, model,
                                                        chunk_idx):
         params, cfg = model
@@ -1071,7 +1128,11 @@ class TestChunkedPrefillChaos:
         assert s["decode_compilations"] <= 1
         assert s["slots_ingesting"] == 0 and s["queue_depth"] == 0
 
+    @pytest.mark.slow
     def test_chunk_hang_trips_watchdog_and_resumes(self, model):
+        # Slow (PR 17 budget pass): hang + watchdog grace is ~8 s;
+        # test_fetch_hang_trips_watchdog keeps the hang-site watchdog
+        # path tier-1 and chunk crashes are covered just above.
         """A HANG inside a chunk dispatch trips the watchdog like any
         stalled tick; the tick returns inside the resume grace, the
         supervised restart re-ingests, and output stays
@@ -1179,7 +1240,12 @@ class TestTraceFailurePaths:
         finally:
             engine.stop()
 
+    @pytest.mark.slow
     def test_trace_survives_http_504(self, model):
+        # Slow (PR 17 budget pass): ~5 s; test_trace_survives_watchdog
+        # _stall keeps the trace-through-failure property tier-1 and
+        # the 504 path itself is covered by test_504_cancels_and_
+        # frees_slot.
         """The 504-timeout path: the client's X-Trace-Id comes back on
         the error payload with the partial breakdown, and the engine's
         cancel keeps the id through slot reclamation."""
@@ -1295,7 +1361,10 @@ class TestServerFaultTolerance:
             assert time.monotonic() - t0 < 1.8
             assert engine.stats()["requests_cancelled"] == 1
 
+    @pytest.mark.slow
     def test_default_deadline_from_request_timeout(self, model):
+        # Slow (PR 17 budget pass): ~5 s; test_deadline_survives_resume
+        # keeps deadline plumbing tier-1 end to end.
         """No client timeout_ms: the engine deadline defaults to the
         server's request_timeout, so the request deadline-retires with
         a partial result instead of running to max_new_tokens."""

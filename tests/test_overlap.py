@@ -147,8 +147,14 @@ class TestOverlapOracle:
         assert outs[True][2] == outs[False][2] == _ref_greedy(
             params, cfg, [5, 6, 7, 8], 6)
 
+    @pytest.mark.slow
     def test_ab_across_restart(self, model):
-        """A mid-decode device fault in each mode: the in-flight
+        """Slow (PR 17 budget pass): both-modes restart pair is
+        ~10 s; test_chaos's TestRestartResume keeps crash-resume
+        oracle-exactness tier-1 (overlap mode) and the sync-mode
+        restart rides the legacy test below's sibling set.
+
+        A mid-decode device fault in each mode: the in-flight
         request RESUMES across the restart (journaled decode state,
         same future) and its output is oracle-exact in both modes —
         the pipeline state (device tokens, in-flight tick) is rebuilt
@@ -174,10 +180,12 @@ class TestOverlapOracle:
             # restarts swap the cache, never the compiled tick
             assert engine.decode_compilations == 1
 
+    @pytest.mark.slow
     def test_ab_across_restart_legacy_fail_typed(self, model):
         """resume=False (the pre-journal contract): the in-flight
         batch fails typed in both modes, and post-restart output is
-        oracle-exact."""
+        oracle-exact.  Slow (PR 17 budget pass): ~7 s; test_chaos's
+        typed-failure tests keep the resume=False contract tier-1."""
         params, cfg = model
         for overlap in (True, False):
             inj = serving.FaultInjector([

@@ -504,11 +504,14 @@ class TestQuantizedPages:
         assert (np.abs(np.asarray(back) - np.asarray(x))
                 <= amax / 254 + 1e-7).all()
 
+    @pytest.mark.slow
     def test_int8_engine_completes_and_matches_oracle(self, model):
         """int8 pages are lossy by design; on this config the per-vector
         scales keep greedy argmax on the oracle path (deterministic —
         verified, not guaranteed at scale), and the byte gauge shows
-        the ~4x payload shrink (+ scale overhead)."""
+        the ~4x payload shrink (+ scale overhead).  Slow (PR 17 budget
+        pass): ~7 s; the int8 quantize/dequantize units above stay
+        tier-1, as does the int8 pool under tp in test_tp_serving."""
         params, cfg = model
         engine = _engine(params, cfg, n_slots=2, kv_dtype="int8",
                          max_queue_depth=8)
@@ -544,8 +547,13 @@ class TestBackPressure:
         for (p, s), f in zip(cases, futs):
             assert f.result(timeout=0) == _ref_greedy(params, cfg, p, s)
 
+    @pytest.mark.slow
     def test_whole_pool_request_admits_eventually(self, model):
-        """REGRESSION: a request whose prompt needs every page the pool
+        """Slow (PR 17 budget pass): drain-the-pool wait is ~6 s;
+        test_decode_growth_exhaustion_preempts_youngest keeps the
+        pool-pressure admission path tier-1.
+
+        REGRESSION: a request whose prompt needs every page the pool
         has — so the admission plan's margin heuristic (prompt pages
         + 1) exceeds n_pages outright — must still admit once the pool
         drains, not park the FCFS head (and everyone behind it)
@@ -633,13 +641,17 @@ class TestPagedObservability:
             assert fam in text
 
     @pytest.mark.perf
+    @pytest.mark.slow
     def test_compile_once_and_one_sync_per_tick_across_sharing(self,
                                                                model):
         """PERF GUARD: across admission churn, page growth, prefix
         attach/COW, and preemption-free steady state, the decode
         executable compiles ONCE and the overlapped loop keeps its
         <= 1 host-sync-per-tick contract — page-table maintenance must
-        never add a blocking fetch."""
+        never add a blocking fetch.  Slow (PR 17 budget pass): the
+        churn soak is ~8 s; test_sched's chunk-compile-set guard and
+        the decode_compilations asserts across the oracle tests keep
+        compile-count regressions tier-1."""
         params, cfg = model
         engine = _engine(params, cfg, n_slots=4, max_queue_depth=16,
                          max_prefills_per_tick=2)

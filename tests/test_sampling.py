@@ -211,11 +211,15 @@ MIX = [
 
 class TestEngineSampling:
     @pytest.mark.perf
+    @pytest.mark.slow
     def test_mixed_batch_matches_oracle_zero_recompiles(self, model):
         """THE acceptance property: one compiled decode executable
         serves mixed greedy/temperature/top-k/top-p traffic, each
         slot's stream token-identical to ``sample_decode`` at its own
-        seed, with zero decode recompiles across churn."""
+        seed, with zero decode recompiles across churn.  Slow (PR 17
+        budget pass): two full waves of the 4-way mix are ~16 s; the
+        sampled-prefix-sharers and restart-resume tests below keep
+        engine-level per-seed oracle identity tier-1."""
         params, cfg = model
         eng = serving.InferenceEngine(params, cfg, serving.EngineConfig(
             n_slots=4, max_len=32, tick_timeout=0))
@@ -232,7 +236,11 @@ class TestEngineSampling:
         assert eng.decode_compilations == base, \
             "sampling parameter mix recompiled the decode tick"
 
+    @pytest.mark.slow
     def test_sync_and_contiguous_modes_match_oracle(self, model):
+        # Slow (PR 17 budget pass): builds two more engine variants,
+        # ~14 s; the default-mode (overlap+paged) oracle tests stay
+        # tier-1 and test_serving covers the sync/contiguous ticks.
         params, cfg = model
         for ec in (serving.EngineConfig(n_slots=4, max_len=32,
                                         overlap=False, tick_timeout=0),
@@ -288,11 +296,15 @@ class TestEngineSampling:
         for (p, kw), f in zip(subs, futs):
             assert f.result(1) == _oracle(params, cfg, p, 10, **kw)
 
+    @pytest.mark.slow
     def test_speculative_mixed_sampled_and_greedy(self, model):
         """On a speculative engine a sampled request emits exactly its
         oracle stream (drafts never accepted for it — acceptance
         forced to 0 as data) while greedy slots keep speculating; the
-        compile count stays at the spec engine's two executables."""
+        compile count stays at the spec engine's two executables.
+        Slow (PR 17 budget pass): the spec engine build is ~11 s;
+        test_speculative's spec_on-mask kernel unit keeps the
+        forced-greedy acceptance path tier-1."""
         params, cfg = model
         eng = serving.InferenceEngine(params, cfg, serving.EngineConfig(
             n_slots=4, max_len=32, speculative=True, spec_k=3,

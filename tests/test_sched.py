@@ -296,10 +296,13 @@ class TestChunkedPrefill:
             serving.InferenceEngine(params, cfg, serving.EngineConfig(
                 paged=False, prefill_chunk_tokens=8))
 
+    @pytest.mark.slow
     def test_chunked_greedy_oracle_overlap(self, model):
         """Mixed long/short greedy traffic, chunked: token-identical
         to the whole-prompt oracle; ONE decode compile (chunk
-        boundaries are data)."""
+        boundaries are data).  Slow (PR 17 budget pass): the 4-prompt
+        mixed-length A/B is ~13 s; the sync-mode single-prompt oracle
+        below keeps the same property tier-1."""
         params, cfg = model
         engine = _engine(params, cfg, prefill_chunk_tokens=8)
         rng = np.random.default_rng(7)
@@ -323,11 +326,14 @@ class TestChunkedPrefill:
         assert fut.result(timeout=0) == _ref_greedy(params, cfg, p, 6)
         assert engine.decode_compilations == 1
 
+    @pytest.mark.slow
     def test_chunked_sampled_oracle(self, model):
         """A SAMPLED long prompt: the final chunk's logits feed the
         first draw at key index len(prompt), so the stream matches
         sample_decode exactly — chunking never touches the PRNG
-        schedule."""
+        schedule.  Slow (PR 17 budget pass): the greedy chunked
+        oracles here plus test_sampling's engine-level PRNG oracles
+        keep both halves of the property tier-1."""
         params, cfg = model
         engine = _engine(params, cfg, prefill_chunk_tokens=8)
         rng = np.random.default_rng(11)
@@ -455,11 +461,14 @@ class TestPreemption:
         assert second.result(timeout=0) == _ref_greedy(
             params, cfg, [4, 5], 2)
 
+    @pytest.mark.slow
     def test_preemption_cow_refcounts_balance(self, model):
         """COMPOSITION: preempting a victim that shares COW prefix
         pages decrefs exactly its references — after everything
         retires the pool is back to the pin, and the prefix stays
-        servable."""
+        servable.  Slow (PR 17 budget pass): ~8 s; test_paged's
+        resume/COW refcount-balance tests keep the refcount invariant
+        tier-1."""
         params, cfg = model
         engine = _engine(params, cfg, n_slots=2)
         prefix = [9, 8, 7, 6, 5, 4, 3, 2]
@@ -483,8 +492,14 @@ class TestPreemption:
         assert engine.slots.free_pages == engine.slots.n_pages - pinned
         assert engine.slots.pages_shared == 0
 
+    @pytest.mark.slow
     def test_preempted_streaming_client_sees_gapless_stream(self, model):
-        """COMPOSITION: a STREAMED batch request that gets preempted
+        """Slow (PR 17 budget pass): HTTP server + live SSE stream is
+        ~6 s; the non-streamed preemption tests here and
+        test_streaming's in-process mid-stream continuation keep both
+        halves of the composition tier-1.
+
+        COMPOSITION: a STREAMED batch request that gets preempted
         resumes on the same engine with the same live future — the
         client's SSE stream pauses, then continues with gapless
         indices and finishes byte-identical to the oracle."""
@@ -551,9 +566,15 @@ class TestPreemption:
         assert victim.result(timeout=0) == _ref_greedy(
             params, cfg, long_p, 4)
 
+    @pytest.mark.slow
     def test_chunked_first_token_retire_on_model_draft_engine(self,
                                                               model):
-        """REGRESSION (review): a chunked request whose FIRST token
+        """Slow (PR 17 budget pass): builds a second (model-draft
+        speculative) engine, ~11 s; the plain-engine preemption and
+        chunked-retire tests above keep the slot-lifecycle invariants
+        tier-1.
+
+        REGRESSION (review): a chunked request whose FIRST token
         retires it (max_new_tokens=1) on a model-draft speculative
         engine — the draft-slot acquire must happen before the emit
         can free the slot, or the freed slot is re-activated with no
@@ -589,8 +610,15 @@ class TestPreemption:
 
 
 class TestChunkedResume:
+    @pytest.mark.slow
     def test_crash_mid_chunk_resumes_oracle_exact(self, model):
-        """A tick failure at a CHUNK boundary suspends the ingesting
+        """Slow (PR 17 budget pass): restart + re-ingest is ~9 s;
+        test_chunked_ingestion_preempted_resumes_exact keeps the
+        suspend-mid-ingestion/re-ingest-exact path tier-1, and
+        tests/test_chaos.py runs this same fault site under the full
+        chaos invariant.
+
+        A tick failure at a CHUNK boundary suspends the ingesting
         request through the ordinary resume path; the restart
         re-ingests from scratch and the output is token-identical to
         an uninterrupted run (tests/test_chaos.py runs the same site

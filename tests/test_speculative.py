@@ -552,10 +552,14 @@ class TestSpeculativeOracle:
         assert eng.metrics.resumed.value > 0
 
     @pytest.mark.paged
+    @pytest.mark.slow
     def test_cow_prefix_sharing_under_speculation(self, model):
         """Registered-prefix sharers (one prefill, refcounted pages,
         COW growth) decode speculatively and stay oracle-identical —
-        including the attach-only admission (prompt == prefix)."""
+        including the attach-only admission (prompt == prefix).
+        Slow (PR 17 budget pass): ~10 s; the plain spec oracle tests
+        here and the COW ladder in test_paged keep each axis
+        tier-1."""
         params, cfg = model
         eng = _engine(model, speculative=True)
         pre = [9, 9, 9, 9, 9, 1, 2]

@@ -291,7 +291,11 @@ def _teardown(servers, rt):
 
 @pytest.mark.router
 class TestRouterStreaming:
+    @pytest.mark.slow
     def test_streamed_proxy_pass_through(self, model):
+        # Slow (PR 17 budget pass): router stack spin-up is ~8 s; the
+        # mid-stream failover test below proxies a live stream through
+        # the same path (a strict superset) and stays tier-1.
         params, cfg, servers, reg, rt = _stack(model, n=1, max_len=48)
         try:
             host, port = rt.address

@@ -1,0 +1,453 @@
+"""Self-tuning serving (horovod_tpu/tuning/): GP/EI math, the
+compile-safe knob registry, the online tuner, and journaled-trace
+replay.
+
+The load-bearing invariants:
+
+* ORACLE SAFETY — every knob the online tuner may touch is
+  admission/batching policy, so tuned output stays token-identical to
+  per-request ``greedy_decode`` (the same oracle as
+  tests/test_serving.py) while the tuner is actively perturbing;
+* COMPILE STABILITY — tuning never triggers a mid-serving XLA
+  compile: ``decode_compilations`` stays at its post-warmup value and
+  every online candidate maps to an already-warmed executable shape;
+* REPLAY FIDELITY — a journaled trace re-driven through a fresh
+  engine reproduces the recorded tokens exactly (greedy AND
+  seeded-sampled), because decode is a pure function of (sequence,
+  seed).
+"""
+
+import json
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import serving
+from horovod_tpu.models import transformer as T
+from horovod_tpu.tuning import (
+    BayesianOptimizer,
+    CategoricalSweep,
+    GaussianProcess,
+    Knob,
+    KnobSpace,
+    Objective,
+    OnlineTuner,
+    apply_settings,
+    online_knob_space,
+    read_trace,
+    replay,
+)
+from horovod_tpu.tuning.replay import warm_lens
+
+pytestmark = [pytest.mark.serving, pytest.mark.tuning]
+
+
+def _cfg():
+    return T.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=48, dtype=jnp.float32, attention_impl="reference",
+        n_kv_heads=2)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return T.init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _ref_greedy(params, cfg, prompt, steps):
+    return np.asarray(T.greedy_decode(
+        params, jnp.asarray([prompt], jnp.int32), steps, cfg))[0].tolist()
+
+
+def _engine(model, **kw):
+    params, cfg = model
+    defaults = dict(n_slots=4, max_len=48, max_queue_depth=64,
+                    max_prefills_per_tick=2, tick_timeout=0.0)
+    defaults.update(kw)
+    return serving.InferenceEngine(
+        params, cfg, serving.EngineConfig(**defaults))
+
+
+class TestGaussianProcess:
+    def test_interpolates_training_points(self):
+        x = np.linspace(0.0, 1.0, 8).reshape(-1, 1)
+        y = np.sin(3.0 * x[:, 0])
+        gp = GaussianProcess()
+        gp.fit(x, y)
+        mu, sigma = gp.predict(x)
+        assert np.allclose(mu, y, atol=1e-2)
+        assert np.all(sigma < 0.1)           # confident at data ...
+        _, far = gp.predict(np.array([[3.0]]))
+        assert far[0] > sigma.max()          # ... not away from it
+
+    def test_conditioning_guard_escalates_jitter(self):
+        # Near-duplicate rows (repeat scores at a pinned knob) make
+        # the Gram matrix numerically singular at base noise: the fit
+        # must escalate jitter and succeed, not raise LinAlgError out
+        # of the serving tick loop.
+        x = np.linspace(0.0, 1e-6, 8).reshape(-1, 1)
+        y = np.sin(np.arange(8.0))
+        gp = GaussianProcess(noise=1e-18)
+        gp.fit(x, y)
+        assert gp.last_jitter > 1e-18        # the guard kicked in
+        mu, _ = gp.predict(x[:1])
+        assert np.isfinite(mu[0])
+
+    def test_ei_finds_1d_argmax(self):
+        bo = BayesianOptimizer(bounds=[(0.0, 1.0)], seed=3)
+        for _ in range(12):
+            x = bo.suggest()
+            bo.register(x, -(x[0] - 0.3) ** 2)
+        best_x, best_y = bo.best
+        assert abs(best_x[0] - 0.3) < 0.12
+        assert best_y > -0.015
+
+    def test_ei_finds_2d_argmax(self):
+        bo = BayesianOptimizer(bounds=[(0.0, 1.0), (0.0, 1.0)], seed=5)
+        for _ in range(18):
+            x = bo.suggest()
+            bo.register(x, -(x[0] - 0.7) ** 2 - (x[1] - 0.2) ** 2)
+        best_x, _ = bo.best
+        assert abs(best_x[0] - 0.7) < 0.2
+        assert abs(best_x[1] - 0.2) < 0.2
+
+    def test_seeded_trajectories_are_deterministic(self):
+        def run():
+            bo = BayesianOptimizer(bounds=[(0.0, 1.0)], seed=11)
+            out = []
+            for _ in range(6):
+                x = bo.suggest()
+                bo.register(x, -(x[0] - 0.5) ** 2)
+                out.append(x[0])
+            return out
+
+        assert run() == run()
+
+
+class TestCategoricalSweep:
+    def test_walks_all_values_and_fixes_best(self):
+        sweep = CategoricalSweep(names=["a", "b"],
+                                 values=[[1, 2], [True, False]])
+        # values[i][0] is what's currently running: the first observe
+        # scores the incumbent.
+        scores = {(1, True): 1.0, (2, True): 3.0,
+                  (2, False): 2.0}
+        seen = []
+        while not sweep.done:
+            cur = sweep.current()
+            seen.append((cur["a"], cur["b"]))
+            sweep.observe(scores.get((cur["a"], cur["b"]), 0.0))
+        assert sweep.fixed == {"a": 2, "b": True}
+        # one observation per candidate value, knob by knob (the
+        # chained sweep re-scores the incumbent when it moves to the
+        # next knob — that repeat is by design)
+        assert len(seen) == 4
+        assert {a for a, _ in seen} == {1, 2}
+        assert {b for _, b in seen} == {True, False}
+
+
+class TestKnobSpace:
+    def test_bo_knob_clamps_and_rounds(self):
+        k = Knob(name="k", default=2, kind="bo", bounds=(1, 4))
+        assert k.clamp(2.6) == 3
+        assert k.clamp(-5) == 1
+        assert k.clamp(99) == 4
+
+    def test_sweep_knob_rejects_non_candidates(self):
+        k = Knob(name="s", default=0, kind="sweep", candidates=(0, 1, 2))
+        assert k.clamp(1) == 1
+        assert k.clamp(7) == 0               # back to default
+
+    def test_space_clamp_drops_unknown_keys(self):
+        space = KnobSpace([Knob(name="k", default=2, kind="bo",
+                                bounds=(1, 4))])
+        out = space.clamp({"k": 9, "stranger": 1})
+        assert out == {"k": 4}
+
+    def test_online_space_derived_from_warmed_engine(self, model):
+        engine = _engine(model, prefill_chunk_tokens=8,
+                         min_prefill_bucket=4)
+        engine.warmup([12])
+        try:
+            space = online_knob_space(engine)
+            by_name = {k.name: k for k in space.knobs}
+            # kmax = min(2 prefills, 4 slots) = 2: BO box is the
+            # warmed admission range.
+            assert by_name["max_prefills_per_tick"].bounds == (1, 2)
+            # chunk knob confined to the WARMED bucket (8): every
+            # candidate pads to the same compile shape.
+            lo, hi = by_name["prefill_chunk_tokens"].bounds
+            assert (lo, hi) == (5, 8)
+            assert by_name["page_grant_ahead"].kind == "sweep"
+            # settings apply at the tick boundary: config swap +
+            # scheduler attribute, no new executables.
+            compiles = engine.decode_compilations
+            applied = apply_settings(engine, {
+                "max_prefills_per_tick": 1, "prefill_chunk_tokens": 6,
+                "page_grant_ahead": 1})
+            assert applied == {"max_prefills_per_tick": 1,
+                               "prefill_chunk_tokens": 6,
+                               "page_grant_ahead": 1}
+            assert engine.engine_cfg.max_prefills_per_tick == 1
+            assert engine.scheduler.max_prefills_per_tick == 1
+            assert engine.engine_cfg.prefill_chunk_tokens == 6
+            assert engine.decode_compilations == compiles
+        finally:
+            engine.stop()
+
+
+class TestOnlineTuner:
+    @pytest.mark.slow
+    def test_oracle_safe_and_compile_stable_while_tuning(self, model):
+        """THE tentpole invariant: with the tuner actively perturbing
+        knobs (chunked-prefill engine, mixed prompt lengths and
+        classes), every request's output is still token-identical to
+        the per-request oracle and no decode executable is ever
+        (re)compiled.  Slow per the one-dot-cost rule (the chunked
+        warmup alone is ~15 s on CPU); the tier-1 sibling is
+        test_rollback_on_constraint_violation, which asserts the same
+        oracle-identity + compile-stability invariants while the
+        tuner perturbs an unchunked engine."""
+        params, cfg = model
+        engine = _engine(model, prefill_chunk_tokens=8,
+                         min_prefill_bucket=4)
+        engine.warmup([12])
+        warm_compiles = engine.decode_compilations
+        tuner = OnlineTuner.install(engine, window_ticks=3,
+                                    bo_samples=2)
+        rng = np.random.default_rng(2)
+        futs, prompts = [], []
+        for i in range(16):
+            prompt = rng.integers(0, cfg.vocab_size,
+                                  3 + i % 9).tolist()
+            prompts.append(prompt)
+            futs.append(engine.submit(
+                prompt, max_new_tokens=5,
+                priority="interactive" if i % 3 else "batch"))
+        for _ in range(4000):
+            if all(f.done() for f in futs):
+                break
+            engine.step()
+        for prompt, f in zip(prompts, futs):
+            assert f.result(timeout=0) == _ref_greedy(
+                params, cfg, prompt, 5)
+        assert engine.decode_compilations == warm_compiles
+        assert tuner._samples >= 1           # it actually tuned
+        snap = engine.stats()["tuning"]
+        assert snap["phase"] in ("sweep", "bo", "pinned")
+        assert snap["trajectory"]
+        assert engine.metrics.tuning_samples.value == snap["samples"]
+        engine.stop()
+
+    def test_rollback_on_constraint_violation(self, model):
+        """An impossible TTFT SLO makes every scored window a
+        violation: the tuner must roll back each sample (re-applying
+        the defaults — there is no known-good yet) and count it.
+        Doubles as the tier-1 oracle-safety sibling of the slow
+        chunked test above: outputs stay token-identical and decode
+        never recompiles while the tuner perturbs + rolls back."""
+        params, cfg = model
+        engine = _engine(model)
+        engine.warmup([4])
+        warm_compiles = engine.decode_compilations
+        tuner = OnlineTuner.install(
+            engine, window_ticks=3, bo_samples=2, guard_band=0.0,
+            objective=Objective(ttft_slo={"interactive": 1e-9}))
+        futs = [engine.submit([1 + i, 2, 3], max_new_tokens=4)
+                for i in range(10)]
+        for _ in range(2000):
+            if all(f.done() for f in futs) and tuner._samples >= 2:
+                break
+            engine.step()
+            if not all(f.done() for f in futs):
+                continue
+            futs.append(engine.submit([5, 6], max_new_tokens=4))
+        for i, f in enumerate(futs[:10]):
+            assert f.result(timeout=0) == _ref_greedy(
+                params, cfg, [1 + i, 2, 3], 4)
+        assert engine.decode_compilations == warm_compiles
+        assert tuner._rollbacks >= 1
+        assert engine.metrics.tuning_rollbacks.value == tuner._rollbacks
+        # no constraint-satisfying sample ever existed: the tuner is
+        # parked on the defaults, not on a violating setting
+        assert tuner._current == tuner.space.defaults()
+        engine.stop()
+
+    def test_tuner_crash_never_takes_serving_down(self, model):
+        engine = _engine(model)
+        engine.warmup([4])
+
+        class Broken:
+            def on_tick(self, engine, worked):
+                raise RuntimeError("tuner bug")
+
+        engine._tuner = Broken()
+        fut = engine.submit([1, 2, 3], max_new_tokens=4)
+        for _ in range(300):
+            if fut.done():
+                break
+            engine.step()
+        assert fut.result(timeout=0)         # request unharmed
+        assert engine._tuner is None         # broken tuner detached
+        engine.stop()
+
+
+def _capture(model, jp, sampled=False):
+    params, cfg = model
+    engine = _engine(model, journal_path=jp)
+    engine.warmup([4, 12])
+    rng = np.random.default_rng(4)
+    futs = []
+    for i in range(10):
+        prompt = rng.integers(0, cfg.vocab_size, 3 + i % 9).tolist()
+        kw = {}
+        if sampled and i % 2:
+            kw = dict(temperature=0.8, seed=40 + i)
+        futs.append(engine.submit(
+            prompt, max_new_tokens=5,
+            priority="interactive" if i % 2 else "batch", **kw))
+    for _ in range(2000):
+        if all(f.done() for f in futs):
+            break
+        engine.step()
+    outs = [f.result(timeout=0) for f in futs]
+    engine.stop()
+    return outs
+
+
+@pytest.fixture(scope="module")
+def captured(model, tmp_path_factory):
+    """One journal capture (greedy + seeded-sampled mix) shared by
+    every replay test — captures are the expensive part (a full
+    engine warmup each)."""
+    jp = str(tmp_path_factory.mktemp("tuning") / "trace.jsonl")
+    outs = _capture(model, jp, sampled=True)
+    return jp, outs
+
+
+class TestReplay:
+    def test_read_trace_keeps_ended_entries_in_arrival_order(
+            self, captured):
+        jp, outs = captured
+        trace = read_trace(jp)
+        assert len(trace) == 10
+        assert all(r.ended for r in trace)
+        assert sorted(len(r.emitted) for r in trace) \
+            == sorted(len(o) for o in outs)
+        arrivals = [r.arrival for r in trace]
+        assert arrivals == sorted(arrivals)
+        assert {r.priority for r in trace} == {"interactive", "batch"}
+
+    def test_replay_is_token_identical_both_timings(self, model,
+                                                    captured):
+        """Greedy AND seeded-sampled requests reproduce exactly on a
+        fresh engine, at original spacing and saturated (one warmed
+        engine serves both timing legs)."""
+        jp, _ = captured
+        trace = read_trace(jp)
+        assert any(r.temperature > 0 for r in trace)
+        engine = _engine(model)
+        engine.warmup(warm_lens(trace, engine))
+        for timing in ("afap", "original"):
+            report = replay(engine, trace, timing=timing, speed=100.0)
+            assert report.compared == len(trace)
+            assert report.token_identical == report.compared, \
+                (timing, report.mismatched_ids)
+            assert report.decode_recompiles == 0
+            assert report.completed == len(trace)
+            assert report.score > 0
+        engine.stop()
+
+    def test_pre_arrival_journal_replays_in_file_order(self, tmp_path):
+        jp = str(tmp_path / "old.jsonl")
+        with open(jp, "w") as f:
+            f.write('{"e":"b","id":2,"prompt":[5,6],"max_new":3}\n')
+            f.write('{"e":"b","id":1,"prompt":[7],"max_new":3}\n')
+        trace = read_trace(jp)
+        assert [r.id for r in trace] == [2, 1]
+        assert all(r.arrival == 0.0 for r in trace)
+
+    def test_replay_rejects_unknown_timing(self, model):
+        with pytest.raises(ValueError):
+            replay(object(), [], timing="warp")
+
+
+class TestTuningEndpoint:
+    def test_get_tuning_serves_snapshot(self, model):
+        engine = _engine(model, autotune=True)
+        assert engine._tuner is None         # not before warmup
+        engine.warmup([4])
+        # EngineConfig.autotune installs the tuner at the END of
+        # warmup, and /stats carries its snapshot
+        assert engine._tuner is not None
+        assert engine.stats()["autotune"] is True
+        assert "tuning" in engine.stats()
+        with serving.ServingServer(engine, port=0) as srv:
+            host, port = srv.address
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/tuning", timeout=10) as r:
+                out = json.loads(r.read())
+            assert out["enabled"] is True
+            assert out["phase"] in ("warmup", "sweep", "bo", "pinned")
+            assert "best" in out and "space" in out
+            # tuning disabled -> the endpoint says so, still 200
+            engine._tuner = None
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/tuning", timeout=10) as r:
+                assert json.loads(r.read()) == {"enabled": False}
+
+
+@pytest.mark.slow
+class TestOfflineTuning:
+    def test_offline_bo_over_replay_runs(self, model, captured):
+        """The offline backend: BO over whole replay runs, one fresh
+        engine per sample — constructor-level knobs are in scope
+        here (this smoke tunes the admission width)."""
+        from horovod_tpu.tuning.replay import tune
+
+        jp, _ = captured
+        trace = read_trace(jp)
+
+        built = []
+
+        def build(settings):
+            engine = _engine(
+                model,
+                max_prefills_per_tick=settings["max_prefills_per_tick"])
+            engine.warmup(warm_lens(trace, engine))
+            built.append(settings)
+            return engine
+
+        out = tune(build, trace,
+                   bounds={"max_prefills_per_tick": (1, 2)},
+                   samples=3, seed=0)
+        assert len(built) == 3
+        assert len(out["trajectory"]) == 3
+        best = out["best"]
+        assert best["settings"]["max_prefills_per_tick"] in (1, 2)
+        assert best["report"]["token_identical"] \
+            == best["report"]["compared"]
+
+    def test_replay_gate_passes_on_committed_trace(self):
+        """The perf gate holds on the committed miniature trace: the
+        current serving path replays it token-identically and within
+        the score tolerance (benchmarks/replay_gate.py)."""
+        import importlib.util
+        import os
+
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "replay_gate",
+            os.path.join(root, "benchmarks", "replay_gate.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        verdict = mod.gate()
+        assert verdict["ok"], verdict
+        assert verdict["token_identical"] == verdict["compared"]
+        assert verdict["decode_recompiles"] == 0
