@@ -917,6 +917,185 @@ def _router_mode(args, cfg) -> None:
         sup.stop(drain=False)
 
 
+def _rollout_mode(args, cfg) -> None:
+    """Zero-downtime reconfiguration benchmark (``--rollout``): the
+    candidate config comes out of ``tuning.replay.tune()`` (offline BO
+    over replay runs of a synthetic trace — the full tuned-settings
+    path docs/serving.md's rollout runbook deploys), then a 3-replica
+    fleet behind the router serves a continuous closed-loop load while
+    that candidate is rolled out replica-by-replica through the canary
+    gate to full promotion.  The JSON line reports the tuned candidate,
+    the canary/incumbent scores, the per-step durations, the rollback
+    count (the claim is 0) and the number of rollout-attributable 5xx
+    responses (the claim is 0: capacity never drops below N-1 and
+    drains run to completion)."""
+    import json as _json
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from horovod_tpu import serving
+    from horovod_tpu.models import transformer as T
+    from horovod_tpu.serving.router import (
+        ReplicaRegistry,
+        ReplicaSpec,
+        ReplicaSupervisor,
+        RolloutController,
+        RouterServer,
+    )
+    from horovod_tpu.tuning.replay import TraceRequest, tune, warm_lens
+
+    n = args.router if args.router > 1 else 3
+    rng = np.random.default_rng(0)
+    lengths = rng.integers(max(args.prompt_len // 2, 1),
+                           args.prompt_len + 1, 64)
+    prompts = [rng.integers(0, cfg.vocab_size, int(m)).tolist()
+               for m in lengths]
+
+    # --- source the candidate from tuning.replay.tune() -------------
+    # Offline BO over replay runs of a synthetic trace: one fresh
+    # warmed engine per sample, constructor knobs in scope.  The
+    # winner's ``settings`` dict is POSTed to /rollout verbatim — the
+    # tuned-config deployment path end to end.
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    trace = [TraceRequest(
+        id=i,
+        prompt=tuple(int(t) for t in rng.integers(
+            0, cfg.vocab_size,
+            int(lengths[i % len(lengths)]))),
+        max_new_tokens=args.steps) for i in range(8)]
+
+    def build(settings):
+        engine = serving.InferenceEngine(
+            params, cfg, serving.EngineConfig(
+                n_slots=args.slots, max_len=cfg.max_seq,
+                tick_timeout=0.0, **settings))
+        engine.warmup(warm_lens(trace, engine))
+        return engine
+
+    tuned = tune(build, trace,
+                 bounds={"max_prefills_per_tick": (1, 4)},
+                 samples=2, seed=0)
+    candidate = dict(tuned["best"]["settings"])
+    print(f"replay-tuned candidate: {candidate} "
+          f"(score {tuned['best']['score']})")
+
+    spec = ReplicaSpec(
+        seed=0, vocab=cfg.vocab_size, d_model=cfg.d_model,
+        n_heads=cfg.n_heads, n_layers=cfg.n_layers, d_ff=cfg.d_ff,
+        max_seq=cfg.max_seq, n_kv_heads=cfg.n_kv_heads or 0,
+        slots=args.slots,
+        max_prefills_per_tick=args.max_prefills_per_tick,
+        max_queue_depth=64,
+        warm=(max(args.prompt_len // 2, 1), args.prompt_len))
+    registry = ReplicaRegistry(poll_interval=0.2)
+    journal_dir = tempfile.mkdtemp(prefix="bench_rollout_")
+    sup = ReplicaSupervisor(spec, n, registry=registry,
+                            journal_dir=journal_dir)
+    ctl = RolloutController(sup, canary_weight=0.3, canary_windows=2,
+                            window_s=0.5, ready_timeout=600.0)
+    rt = RouterServer(registry, port=0, rollout=ctl)
+    counts = {"200": 0, "5xx": 0, "other": 0, "dropped": 0}
+    counts_lock = threading.Lock()
+    stop = threading.Event()
+
+    def loader(worker):
+        lrng = np.random.default_rng(worker)
+        while not stop.is_set():
+            prompt = prompts[int(lrng.integers(0, len(prompts)))]
+            req = urllib.request.Request(
+                base + "/generate",
+                data=_json.dumps({
+                    "tokens": prompt,
+                    "max_new_tokens": args.steps}).encode(),
+                headers={"Content-Type": "application/json"})
+            key = "dropped"
+            try:
+                with urllib.request.urlopen(req, timeout=300) as r:
+                    key = "200" if r.status == 200 else "other"
+                    r.read()
+            except urllib.error.HTTPError as e:
+                key = "5xx" if e.code >= 500 else "other"
+                e.read()
+            except Exception:
+                pass
+            with counts_lock:
+                counts[key] += 1
+
+    try:
+        sup.start()
+        rt.start()
+        if not sup.wait_ready(timeout=600):
+            raise RuntimeError("replicas never became ready")
+        host, port = rt.address
+        base = f"http://{host}:{port}"
+
+        workers = [threading.Thread(target=loader, args=(w,),
+                                    daemon=True) for w in range(4)]
+        for th in workers:
+            th.start()
+        time.sleep(1.0)  # pre-rollout traffic baseline
+
+        req = urllib.request.Request(
+            base + "/rollout",
+            data=_json.dumps({"candidate": candidate}).encode(),
+            headers={"Content-Type": "application/json"})
+        t0 = time.monotonic()
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert r.status == 202, r.status
+            r.read()
+        if not ctl.wait(timeout=600):
+            raise RuntimeError("rollout never reached a terminal state")
+        wall = time.monotonic() - t0
+        time.sleep(1.0)  # post-rollout traffic on the new config
+        stop.set()
+        for th in workers:
+            th.join(300.0)
+
+        status = ctl.status()
+        gens = {}
+        for st in registry.statuses():
+            with urllib.request.urlopen(st.endpoint.base_url + "/stats",
+                                        timeout=5.0) as r:
+                gens[st.endpoint.rid] = _json.loads(r.read()).get(
+                    "config_generation")
+        snap = registry.metrics.snapshot()
+        result = {
+            "metric": f"fleet rollout wall-clock ({n} replicas x "
+                      f"S={args.slots} slots, candidate {candidate}, "
+                      f"continuous closed-loop load)",
+            "value": round(wall, 2),
+            "unit": "s",
+            "replicas": n,
+            "candidate": candidate,
+            "tune_trajectory": tuned["trajectory"],
+            "terminal_state": status["state"],
+            "trip_reason": status["trip_reason"],
+            "canary_score": status["canary_score"],
+            "incumbent_score": status["incumbent_score"],
+            "step_durations_s": status["step_durations_s"],
+            "rollbacks": int(snap["rollout_rollbacks"]),
+            "promotions": int(snap["rollout_promotions"]),
+            "rollout_steps": int(snap["rollout_steps"]),
+            "requests_200": counts["200"],
+            "http_5xx": counts["5xx"],
+            "dropped": counts["dropped"],
+            "config_generations": gens,
+            "chip": jax.devices()[0].device_kind,
+        }
+        print(f"rollout  {n} replicas promoted in {wall:6.1f}s | "
+              f"canary {status['canary_score']} vs incumbent "
+              f"{status['incumbent_score']} | "
+              f"5xx {counts['5xx']} | rollbacks "
+              f"{int(snap['rollout_rollbacks'])}")
+        print(json.dumps(result))
+    finally:
+        stop.set()
+        rt.stop()
+        sup.stop(drain=False)
+
+
 def _chaos_mode(args, T, cfg, params) -> None:
     """Durability benchmark (``--chaos``): the open-loop workload with
     deterministic engine crashes injected mid-decode, restart-resume
@@ -1543,6 +1722,15 @@ def main() -> None:
                          "front tier: N replica processes behind the "
                          "join-shortest-queue router "
                          "(docs/serving.md 'Front tier')")
+    ap.add_argument("--rollout", action="store_true",
+                    help="zero-downtime reconfiguration benchmark: a "
+                         "3-replica fleet (or --router N) serves a "
+                         "continuous load while a candidate config is "
+                         "canaried and promoted replica-by-replica; "
+                         "reports canary/incumbent scores, per-step "
+                         "durations, rollback count (claim: 0) and "
+                         "rollout-attributable 5xx (claim: 0) "
+                         "(docs/serving.md 'Fleet rollouts')")
     ap.add_argument("--chaos", action="store_true",
                     help="durability benchmark: the open-loop workload "
                          "with deterministic engine crashes injected "
@@ -1654,7 +1842,7 @@ def main() -> None:
         _autotune_mode(args, T)
         return
 
-    if args.router:
+    if args.router or args.rollout:
         kv = args.kv_heads[-1] if args.kv_heads else 0
         cfg = T.TransformerConfig(
             vocab_size=args.vocab, d_model=args.d_model,
@@ -1662,7 +1850,10 @@ def main() -> None:
             max_seq=args.prompt_len + args.steps,
             n_kv_heads=kv, attention_impl="reference", dtype=dtype,
         )
-        _router_mode(args, cfg)
+        if args.rollout:
+            _rollout_mode(args, cfg)
+        else:
+            _router_mode(args, cfg)
         return
 
     if args.engine or args.chaos:

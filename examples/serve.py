@@ -257,6 +257,146 @@ def replicated_demo(args, params, cfg) -> None:
     print("stopped")
 
 
+def rollout_demo(args, params, cfg) -> None:
+    """Zero-downtime fleet reconfiguration end to end (docs/serving.md
+    "Fleet rollouts"): 3 replicas behind the router, a candidate
+    config POSTed to the admin surface, the canary SIGKILLed mid-score
+    — and the controller rolls the fleet back to the incumbent config
+    on its own, with every in-flight request resolving."""
+    import os
+    import signal as _signal
+    import tempfile
+
+    from horovod_tpu.serving.router import (
+        ReplicaRegistry,
+        ReplicaSpec,
+        ReplicaSupervisor,
+        RolloutController,
+        RouterServer,
+    )
+    from horovod_tpu.serving.router.replica_main import dump_model
+
+    n = max(args.replicas, 3)
+    fd, params_path = tempfile.mkstemp(prefix="serve_lm_",
+                                       suffix=".pkl")
+    os.close(fd)
+    dump_model(params_path, params, cfg)
+    registry = ReplicaRegistry(poll_interval=0.2, heartbeat_stale=15.0)
+    journal_dir = tempfile.mkdtemp(prefix="serve_journal_")
+    sup = ReplicaSupervisor(
+        ReplicaSpec(params_path=params_path, slots=args.slots,
+                    warm=[8], tick_timeout=30.0, drain_timeout=10.0),
+        n, registry=registry, unhealthy_grace=3.0,
+        journal_dir=journal_dir)
+    # canary_windows is generous: the demo kills the canary before
+    # scoring ever finishes, proving the crash-trip path.
+    ctl = RolloutController(sup, canary_weight=0.3, canary_windows=60,
+                            window_s=1.0, ready_timeout=240.0)
+    rt = RouterServer(registry, port=args.port,
+                      resume_lookup=sup.resume_lookup, rollout=ctl)
+    stop_load = threading.Event()
+
+    def load_loop(base):
+        rng = np.random.default_rng(5)
+        while not stop_load.is_set():
+            prompt = [int(t) for t in rng.integers(0, 32, 3)]
+            try:
+                req = urllib.request.Request(
+                    base + "/generate",
+                    data=json.dumps({"tokens": prompt,
+                                     "max_new_tokens": 8}).encode(),
+                    headers={"Content-Type": "application/json"})
+                urllib.request.urlopen(req, timeout=60).read()
+            except Exception:
+                pass
+            time.sleep(0.1)
+
+    def post(base, payload):
+        req = urllib.request.Request(
+            base + "/rollout", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    def fleet_gens():
+        gens = {}
+        for st in registry.statuses():
+            try:
+                with urllib.request.urlopen(
+                        st.endpoint.base_url + "/stats",
+                        timeout=2.0) as r:
+                    gens[st.endpoint.rid] = json.loads(r.read()).get(
+                        "config_generation")
+            except Exception:
+                pass
+        return gens
+
+    loader = None
+    try:
+        sup.start()
+        rt.start()
+        host, port = rt.address
+        base = f"http://{host}:{port}"
+        print(f"spawning {n} replicas ...")
+        if not sup.wait_ready(timeout=240):
+            raise RuntimeError("replicas never became ready")
+        print(f"router on {base}  ({n} replicas in rotation, "
+              f"config generations {fleet_gens()})")
+        loader = threading.Thread(target=load_loop, args=(base,),
+                                  daemon=True)
+        loader.start()
+
+        candidate = {"max_prefills_per_tick": 4}
+        print(f"POST /rollout candidate={candidate}")
+        status = post(base, {"candidate": candidate})
+        print(f"  -> rollout started: gen {status['config_generation']}")
+
+        killed = False
+        last_state = None
+        deadline = time.monotonic() + 600.0
+        while time.monotonic() < deadline:
+            st = ctl.status()
+            if st["state"] != last_state:
+                last_state = st["state"]
+                print(f"  state: {last_state}"
+                      + (f"  (trip: {st['trip_reason']})"
+                         if st["trip_reason"] else ""))
+            if st["state"] == "canary" and not killed:
+                h = sup.handle(0)
+                time.sleep(1.0)   # let a scoring window open
+                print(f"  SIGKILL canary {h.rid} (pid {h.pid}) "
+                      f"mid-score ...")
+                os.kill(h.pid, _signal.SIGKILL)
+                killed = True
+            if not st["active"]:
+                break
+            time.sleep(0.1)
+        final = ctl.status()
+        print(f"rollout terminal state: {final['state']} "
+              f"(trip: {final['trip_reason']})")
+        snap = registry.metrics.snapshot()
+        print(f"rollbacks={snap['rollout_rollbacks']:.0f} "
+              f"promotions={snap['rollout_promotions']:.0f} "
+              f"steps={snap['rollout_steps']:.0f}")
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            gens = fleet_gens()
+            if len(gens) >= n and set(gens.values()) == {0}:
+                break
+            time.sleep(0.5)
+        print(f"fleet converged back to the incumbent: {fleet_gens()}")
+        print(f"rollout journal: "
+              f"{os.path.join(journal_dir, 'rollout.journal.jsonl')}")
+    finally:
+        stop_load.set()
+        if loader is not None:
+            loader.join(5.0)
+        rt.stop()
+        sup.stop(drain=True)
+        os.unlink(params_path)
+    print("stopped")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=30, help="train steps")
@@ -291,6 +431,13 @@ def main() -> None:
                          "synthetic load until it converges, printing "
                          "each sampled knob setting and its objective "
                          "(docs/serving.md 'Autotuning')")
+    ap.add_argument("--rollout", action="store_true",
+                    help="fleet-rollout demo (docs/serving.md 'Fleet "
+                         "rollouts'): 3+ replicas behind the router, a "
+                         "candidate config POSTed to /rollout, the "
+                         "canary SIGKILLed mid-score — the controller "
+                         "rolls the whole fleet back to the incumbent "
+                         "on its own (forces --replicas >= 3)")
     ap.add_argument("--spans", default="",
                     help="(with --replicas) span-stream directory for "
                          "distributed tracing — the killed request's "
@@ -315,6 +462,16 @@ def main() -> None:
     if args.trace:
         obs.tracing.start(args.trace, jsonl_path=args.trace + ".jsonl")
     params, cfg = train_toy_lm(args.steps)
+
+    if args.rollout:
+        rollout_demo(args, params, cfg)
+        if args.trace:
+            obs.tracing.stop()
+            print(f"trace written: {args.trace} (open in "
+                  f"https://ui.perfetto.dev); request log: "
+                  f"{args.trace}.jsonl")
+        hvd.shutdown()
+        return
 
     if args.replicas > 1:
         replicated_demo(args, params, cfg)

@@ -469,6 +469,13 @@ class EngineConfig:
     journal_path: Optional[str] = None
     stall_grace: Optional[float] = None
     faults: Optional[FaultInjector] = None
+    # Fleet-rollout label (docs/serving.md "Fleet rollouts"): which
+    # CONFIG GENERATION this engine was built at.  Purely an identity
+    # tag — the RolloutController stamps candidates with
+    # incumbent_gen + 1, the registry surfaces it per replica, and the
+    # chaos suite proves fleet convergence ("every replica reports the
+    # same config_generation") through it.  Never read by the engine.
+    config_generation: int = 0
     # Model FLOPs per generated token (e.g.
     # obs.xprof.transformer_flops_per_token(params)): turns the token
     # counters into achieved FLOP/s in /stats — the honest utilization
@@ -3195,6 +3202,19 @@ class InferenceEngine:
                 return
             self._restart()
             self._resuming = 0
+            # The tuner's scoring window must not straddle the
+            # restart: its baseline predates the crash, so the first
+            # post-restart window would score the dead time + the
+            # resume re-prefills against the knob setting — garbage
+            # that can trip a spurious SLO rollback (and GET /tuning
+            # would serve it).  Drop the baseline; the next worked
+            # tick opens a fresh window.
+            reset = getattr(self._tuner, "reset_window", None)
+            if reset is not None:
+                try:
+                    reset()
+                except Exception:  # pragma: no cover - tuner never
+                    pass           # gates recovery
             if resumed:
                 # Back to the HEAD of the queue in original FCFS order:
                 # the next tick re-prefills prompt + emitted through the
@@ -3615,6 +3635,12 @@ class InferenceEngine:
             "tp": int(self.engine_cfg.tp),
             "mesh": self._shard.describe() if self._shard is not None
             else "",
+            # Fleet-rollout contract addition (docs/serving.md "Fleet
+            # rollouts"): the config generation this engine was built
+            # at — always present, always int, so the registry and the
+            # rollout controller can tell incumbent from candidate
+            # replicas without parsing knobs.
+            "config_generation": int(self.engine_cfg.config_generation),
             "state_transitions": self.state_transitions,
             "n_slots": self.engine_cfg.n_slots,
             "slots_active": self.slots.active_count,

@@ -45,6 +45,16 @@ Sites (``FaultInjector.SITES``):
   restart — in-flight futures resolve with ``EngineFailedError``
   instead of resuming, and nothing is ever replayed from state it
   cannot trust.
+* ``"rollout_drain"`` / ``"rollout_rebuild"`` / ``"rollout_canary"``
+  / ``"rollout_promote"`` — probed by the fleet
+  :class:`~horovod_tpu.serving.router.rollout.RolloutController` (NOT
+  the engine) at each step of a rolling reconfiguration: before a
+  replica is drained for rebuild, before the rebuilt replica is
+  awaited, before the canary is admitted for scoring, and before each
+  post-canary promotion step (docs/serving.md "Fleet rollouts").  A
+  ``"raise"`` at any of them models the controller machinery failing
+  mid-step and must trip the automatic rollback; a ``"hang"`` models
+  a stalled step (the rollback path still converges the fleet).
 
 Kinds:
 
@@ -115,7 +125,11 @@ class FaultInjector:
     """
 
     SITES = ("prefill", "prefill_chunk", "decode_tick", "decode_fetch",
-             "watchdog", "restart_resume")
+             "watchdog", "restart_resume",
+             # Fleet-rollout sites, probed by the RolloutController in
+             # the SUPERVISOR process (never by an engine):
+             "rollout_drain", "rollout_rebuild", "rollout_canary",
+             "rollout_promote")
     KINDS = ("raise", "hang", "nonfinite")
 
     def __init__(self, specs: Sequence[FaultSpec] = (), seed: int = 0):

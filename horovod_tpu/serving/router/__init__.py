@@ -48,6 +48,10 @@ from horovod_tpu.serving.router.registry import (
     ReplicaRegistry,
     ReplicaStatus,
 )
+from horovod_tpu.serving.router.rollout import (
+    RolloutController,
+    RolloutError,
+)
 from horovod_tpu.serving.router.server import RouterServer
 from horovod_tpu.serving.router.supervisor import (
     EXIT_CODE_REPLICA_FAILED,
@@ -59,5 +63,6 @@ from horovod_tpu.serving.router.supervisor import (
 __all__ = [
     "EXIT_CODE_REPLICA_FAILED",
     "ReplicaEndpoint", "ReplicaHandle", "ReplicaRegistry", "ReplicaSpec",
-    "ReplicaStatus", "ReplicaSupervisor", "RouterMetrics", "RouterServer",
+    "ReplicaStatus", "ReplicaSupervisor", "RolloutController",
+    "RolloutError", "RouterMetrics", "RouterServer",
 ]

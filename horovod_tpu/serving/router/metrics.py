@@ -49,8 +49,16 @@ class RouterMetrics:
     * ``poll_errors`` — registry polls that failed (connection refused
       / timeout / bad payload); a burst of these around an eviction is
       the normal failure signature.
+    * ``drain_timeouts`` — drains that blew through ``shutdown_grace``
+      and escalated to SIGKILL (supervisor stop or slot recycle); each
+      one means in-flight requests failed over through the journal
+      instead of finishing locally.
     * ``proxy_latency`` — wall time of one proxy ATTEMPT (connect +
       replica generate + relay), success or failure.
+    * ``rollout_*`` — the fleet-reconfiguration state machine
+      (docs/serving.md "Fleet rollouts"): rollouts started /
+      promoted / rolled back, replica recycle steps executed, whether
+      one is active, and the last canary-vs-incumbent window scores.
     """
 
     def __init__(self, registry: Optional[MetricsRegistry] = None) -> None:
@@ -86,6 +94,35 @@ class RouterMetrics:
         self.poll_errors = r.counter(
             "router_poll_errors_total",
             "Registry health polls that failed")
+        self.drain_timeouts = r.counter(
+            "router_drain_timeouts_total",
+            "Replica drains that exceeded shutdown_grace and were "
+            "escalated to SIGKILL")
+        self.rollouts_started = r.counter(
+            "rollout_started_total",
+            "Fleet rollouts accepted by the controller")
+        self.rollout_promotions = r.counter(
+            "rollout_promotions_total",
+            "Rollouts that promoted the candidate config fleet-wide")
+        self.rollout_rollbacks = r.counter(
+            "rollout_rollbacks_total",
+            "Rollouts rolled back to the incumbent config (canary SLO "
+            "breach, crash loop, drain timeout, eviction, or operator "
+            "abort)")
+        self.rollout_steps = r.counter(
+            "rollout_steps_total",
+            "Replica recycle steps (drain + rebuild of one slot) "
+            "executed by the rollout controller, rollback included")
+        self.rollout_active = r.gauge(
+            "rollout_active",
+            "1 while a rollout (or rollback) is in flight, else 0")
+        self.rollout_canary_score = r.gauge(
+            "rollout_canary_score",
+            "Objective score of the canary's last scoring window")
+        self.rollout_incumbent_score = r.gauge(
+            "rollout_incumbent_score",
+            "Objective score of the incumbent fleet over the same "
+            "window the canary was scored on")
         self.proxy_latency = r.histogram(
             "router_proxy_latency_seconds",
             "Wall time of one proxy attempt (connect through relay)",
@@ -103,5 +140,14 @@ class RouterMetrics:
             "replica_evictions": self.replica_evictions.value,
             "replica_restarts": self.replica_restarts.value,
             "poll_errors": self.poll_errors.value,
+            "drain_timeouts": self.drain_timeouts.value,
+            "rollouts_started": self.rollouts_started.value,
+            "rollout_promotions": self.rollout_promotions.value,
+            "rollout_rollbacks": self.rollout_rollbacks.value,
+            "rollout_steps": self.rollout_steps.value,
+            "rollout_active": self.rollout_active.value,
+            "rollout_canary_score": self.rollout_canary_score.value,
+            "rollout_incumbent_score":
+                self.rollout_incumbent_score.value,
             "proxy_latency_seconds": self.proxy_latency.snapshot(),
         }

@@ -27,13 +27,25 @@ replica is one queue like any other.
 timeout, garbage payload) also evict — a SIGKILL'd replica stops
 answering long before anyone inspects its exit code.  The proxy path
 can evict faster still with :meth:`mark_failed` (a failed ``/generate``
-connection is fresher evidence than the last poll); one successful
-poll re-admits, so a transient drop never strands a healthy replica
-out of rotation.
+connection is fresher evidence than the last poll).  Re-admission has
+HYSTERESIS: an evicted replica needs ``readmit_threshold`` (default 2)
+CONSECUTIVE good polls before it rejoins rotation, so a flapping
+replica — one that answers every other poll — stays out instead of
+oscillating in and out every ``poll_interval``.  A replica that was
+never evicted (failures below threshold, never marked) is unaffected:
+one good poll still clears a transient blip.
+
+The contract's ``config_generation`` key (an opaque int label stamped
+by the supervisor at spawn; docs/serving.md "Fleet rollouts") is
+tracked per replica so the rollout controller can verify fleet
+convergence — like ``tp``/``mesh`` it is never routed on.
 
 :meth:`pick` implements join-shortest-queue: least ``queue_depth``,
 then least ``occupancy``, round-robin among ties so equally idle
-replicas share load instead of dogpiling the lowest id.
+replicas share load instead of dogpiling the lowest id.  During a
+rollout, :meth:`set_canary` overlays a deterministic weighted split:
+the canary replica receives exactly ``weight`` of picks (a credit
+accumulator, no RNG) and everyone else splits the rest by JSQ.
 """
 
 from __future__ import annotations
@@ -95,9 +107,14 @@ class ReplicaStatus:
     # routing still balances on queue_depth/occupancy alone.
     tp: int = 1
     mesh: str = ""
+    # Which config generation this replica was built at (stamped by the
+    # supervisor via --config-gen, echoed through /stats).  The rollout
+    # controller reads it to prove fleet convergence; routing ignores it.
+    config_gen: int = 0
     added_at: float = 0.0
     last_ok: Optional[float] = None     # monotonic time of last good poll
     consecutive_failures: int = 0
+    consecutive_ok: int = 0             # good polls since last failure/mark
     marked_failed: bool = False         # proxy-side eviction flag
     mark_seq: int = 0                   # bumped per mark_failed (race guard)
     ever_routable: bool = False
@@ -113,6 +130,7 @@ class ReplicaStatus:
             "heartbeat_age_s": self.heartbeat_age_s,
             "tp": self.tp,
             "mesh": self.mesh,
+            "config_generation": self.config_gen,
             "consecutive_poll_failures": self.consecutive_failures,
             "marked_failed": self.marked_failed,
             "polls": self.polls,
@@ -132,14 +150,18 @@ class ReplicaRegistry:
     def __init__(self, *, poll_interval: float = 0.25,
                  poll_timeout: float = 2.0,
                  fail_threshold: int = 2,
+                 readmit_threshold: int = 2,
                  heartbeat_stale: float = 60.0,
                  startup_grace: Optional[float] = None,
                  metrics: Optional[RouterMetrics] = None) -> None:
         if fail_threshold < 1:
             raise ValueError("fail_threshold must be >= 1")
+        if readmit_threshold < 1:
+            raise ValueError("readmit_threshold must be >= 1")
         self.poll_interval = poll_interval
         self.poll_timeout = poll_timeout
         self.fail_threshold = fail_threshold
+        self.readmit_threshold = readmit_threshold
         self.heartbeat_stale = heartbeat_stale
         # A cold replica pays imports + XLA compiles before its first
         # tick; give it the stale budget (or more) before calling a
@@ -150,6 +172,12 @@ class ReplicaRegistry:
         self._lock = threading.Lock()
         self._status: Dict[str, ReplicaStatus] = {}
         self._rr = 0  # round-robin tiebreak cursor
+        # Canary overlay (rollout controller): while set, pick() routes
+        # exactly `weight` of requests to the canary rid via a credit
+        # accumulator and JSQ-balances the rest across the incumbents.
+        self._canary_rid: Optional[str] = None
+        self._canary_weight = 0.0
+        self._canary_credit = 0.0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -174,7 +202,7 @@ class ReplicaRegistry:
     def mark_failed(self, rid: str) -> None:
         """Proxy-side eviction: a /generate attempt to this replica
         just failed at the connection level.  Takes effect immediately;
-        the next SUCCESSFUL poll re-admits."""
+        ``readmit_threshold`` consecutive successful polls re-admit."""
         with self._lock:
             st = self._status.get(rid)
             if st is None or st.marked_failed:
@@ -185,9 +213,34 @@ class ReplicaRegistry:
                               {"rid": rid, "reason": "proxy_failure"})
             st.marked_failed = True
             st.mark_seq += 1
+            st.consecutive_ok = 0
             self.metrics.replicas_in_rotation.set(
                 sum(1 for s in self._status.values()
                     if self._routable(s)))
+
+    # -- canary overlay (rollout controller) -------------------------------
+
+    def set_canary(self, rid: str, weight: float) -> None:
+        """Route exactly ``weight`` (0..1) of picks to ``rid`` while it
+        is routable and at least one other replica is too; the rest go
+        through normal JSQ over the incumbents.  Deterministic: a
+        credit accumulator, not a coin flip, so a scoring window of K
+        requests sends ``floor``/``ceil`` of ``weight*K`` to the
+        canary."""
+        with self._lock:
+            self._canary_rid = rid
+            self._canary_weight = max(0.0, min(1.0, float(weight)))
+            self._canary_credit = 0.0
+
+    def clear_canary(self) -> None:
+        with self._lock:
+            self._canary_rid = None
+            self._canary_weight = 0.0
+            self._canary_credit = 0.0
+
+    def canary(self) -> Optional[str]:
+        with self._lock:
+            return self._canary_rid
 
     # -- routing set -------------------------------------------------------
 
@@ -231,6 +284,18 @@ class ReplicaRegistry:
                      if self._routable(s) and s.endpoint.rid not in exclude]
             if not cands:
                 return None
+            if self._canary_rid is not None:
+                canary = next((s for s in cands
+                               if s.endpoint.rid == self._canary_rid), None)
+                others = [s for s in cands if s is not canary]
+                if canary is not None and others:
+                    self._canary_credit += self._canary_weight
+                    if self._canary_credit >= 1.0:
+                        self._canary_credit -= 1.0
+                        return dataclasses.replace(canary)
+                    cands = others
+                # Canary alone in rotation (or gone): fall through to
+                # plain JSQ — availability beats the traffic split.
             best = min((s.queue_depth, s.occupancy) for s in cands)
             ties = sorted(
                 (s for s in cands
@@ -264,6 +329,7 @@ class ReplicaRegistry:
                 # keep a mixed-version fleet pollable during a rollout.
                 tp = int(snap.get("tp", 1))
                 mesh_desc = str(snap.get("mesh", ""))
+                cg = int(snap.get("config_generation", 0))
             except Exception as e:
                 self.metrics.poll_errors.inc()
                 with self._lock:
@@ -272,6 +338,7 @@ class ReplicaRegistry:
                         continue
                     was = self._routable(st)
                     st.consecutive_failures += 1
+                    st.consecutive_ok = 0
                     st.polls += 1
                     if was and not self._routable(st):
                         self.metrics.replica_evictions.inc()
@@ -293,14 +360,27 @@ class ReplicaRegistry:
                 st.heartbeat_age_s = hb
                 st.tp = tp
                 st.mesh = mesh_desc
+                st.config_gen = cg
                 st.last_ok = time.monotonic()
-                st.consecutive_failures = 0
-                # Clear the proxy-side eviction only if no NEW mark
-                # landed while this (lock-free) fetch was in flight —
-                # a mark issued after the snapshot was taken is fresher
-                # evidence than the snapshot.
-                if st.mark_seq == pre_fetch_seq:
-                    st.marked_failed = False
+                st.consecutive_ok += 1
+                # Re-admission hysteresis: an EVICTED replica (failures
+                # at/past threshold, or proxy-marked) must string
+                # together readmit_threshold good polls before its
+                # eviction state clears — a flapper that fails every
+                # other poll never makes it back.  A replica that was
+                # never evicted clears a sub-threshold blip on the
+                # first good poll, as before.
+                evicted = (st.marked_failed
+                           or st.consecutive_failures >= self.fail_threshold)
+                if (not evicted
+                        or st.consecutive_ok >= self.readmit_threshold):
+                    st.consecutive_failures = 0
+                    # Clear the proxy-side eviction only if no NEW mark
+                    # landed while this (lock-free) fetch was in flight —
+                    # a mark issued after the snapshot was taken is
+                    # fresher evidence than the snapshot.
+                    if st.mark_seq == pre_fetch_seq:
+                        st.marked_failed = False
                 st.polls += 1
                 now_routable = self._routable(st)
                 if was and not now_routable:

@@ -89,6 +89,28 @@ def build_model(args):
     return T.init_params(jax.random.PRNGKey(args.seed), cfg), cfg
 
 
+def parse_setting(text: str):
+    """``name=value`` -> (name, typed value), same typing ladder as the
+    replay CLI's settings (int → float → bool/none → str)."""
+    if "=" not in text:
+        raise argparse.ArgumentTypeError(
+            f"--set wants name=value, got {text!r}")
+    name, raw = text.split("=", 1)
+    name = name.strip()
+    raw = raw.strip()
+    for cast in (int, float):
+        try:
+            return name, cast(raw)
+        except ValueError:
+            pass
+    low = raw.lower()
+    if low in ("true", "false"):
+        return name, low == "true"
+    if low in ("none", "null"):
+        return name, None
+    return name, raw
+
+
 def parse_fault(text: str):
     """``site:kind[:skip[:delay]]`` -> FaultSpec."""
     from horovod_tpu.serving.faults import FaultSpec
@@ -169,6 +191,16 @@ def main(argv=None) -> int:
                     default=[], metavar="SITE:KIND[:SKIP[:DELAY]]",
                     help="deterministic FaultInjector spec (chaos "
                          "tests; repeatable)")
+    ap.add_argument("--config-gen", type=int, default=0,
+                    help="config-generation label stamped into the "
+                         "engine's /stats (fleet rollouts; never read "
+                         "by the engine itself)")
+    ap.add_argument("--set", type=parse_setting, action="append",
+                    default=[], dest="settings", metavar="NAME=VALUE",
+                    help="extra EngineConfig field override, typed "
+                         "like the replay CLI's settings (repeatable; "
+                         "how a rollout candidate carries knobs with "
+                         "no dedicated flag)")
     args = ap.parse_args(argv)
 
     if args.tp > 1:
@@ -206,17 +238,21 @@ def main(argv=None) -> int:
     # warmup could fire inside it and burn its budget (or wedge the
     # replica) before the listener even exists.
     inj = serving.FaultInjector() if args.fault else None
+    cfg_kwargs = dict(
+        n_slots=args.slots, max_len=cfg.max_seq,
+        max_queue_depth=args.max_queue_depth,
+        max_prefills_per_tick=args.max_prefills_per_tick,
+        tick_timeout=args.tick_timeout,
+        tp=args.tp,
+        autotune=args.autotune,
+        resume=not args.no_resume,
+        journal_path=args.journal or None, faults=inj,
+        config_generation=args.config_gen)
+    # --set overrides land LAST so a rollout candidate can retarget any
+    # EngineConfig field, dedicated flag or not.
+    cfg_kwargs.update(dict(args.settings))
     engine = serving.InferenceEngine(
-        params, cfg,
-        serving.EngineConfig(
-            n_slots=args.slots, max_len=cfg.max_seq,
-            max_queue_depth=args.max_queue_depth,
-            max_prefills_per_tick=args.max_prefills_per_tick,
-            tick_timeout=args.tick_timeout,
-            tp=args.tp,
-            autotune=args.autotune,
-            resume=not args.no_resume,
-            journal_path=args.journal or None, faults=inj))
+        params, cfg, serving.EngineConfig(**cfg_kwargs))
     if args.warm or args.autotune:
         # Pre-compile BEFORE the listener exists: the registry's first
         # successful poll means "routable", and a routable replica must

@@ -157,6 +157,14 @@ class _RouterHandler(BaseHTTPRequestHandler):
             }, headers=hdrs)
         elif self.path == "/stats":
             self._json(200, router.stats())
+        elif self.path == "/rollout":
+            if router.rollout is None:
+                self._json(503, {
+                    "error": "no rollout controller configured on this "
+                             "router (RouterServer(rollout=...))",
+                    "type": "no_rollout_controller"})
+            else:
+                self._json(200, router.rollout.status())
         elif self.path == "/metrics":
             body = registry.metrics.registry.to_prometheus().encode()
             self.send_response(200)
@@ -193,6 +201,55 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     self._json(200, autopsy)
         else:
             self._json(404, {"error": f"unknown path {self.path}"})
+
+    # -- POST /rollout: the fleet-reconfiguration admin surface ------------
+
+    def _rollout_admin(self, router: "RouterServer", body: bytes) -> None:
+        """``POST /rollout`` (docs/serving.md "Fleet rollouts"):
+        ``{"candidate": {...}}`` starts a rolling reconfiguration (202
+        + status), ``{"abort": true}`` trips the active one into
+        rollback.  409 when one is already in flight, 503 when the
+        router has no controller wired."""
+        from horovod_tpu.serving.router.rollout import RolloutError
+
+        if router.rollout is None:
+            self._json(503, {
+                "error": "no rollout controller configured on this "
+                         "router (RouterServer(rollout=...))",
+                "type": "no_rollout_controller"})
+            return
+        try:
+            obj = json.loads(body or b"{}")
+        except json.JSONDecodeError:
+            self._json(400, {"error": "body is not valid JSON",
+                             "type": "bad_request"})
+            return
+        if not isinstance(obj, dict):
+            self._json(400, {"error": "body must be a JSON object",
+                             "type": "bad_request"})
+            return
+        if obj.get("abort"):
+            self._json(200, router.rollout.abort())
+            return
+        candidate = obj.get("candidate")
+        if not isinstance(candidate, dict) or not candidate:
+            self._json(400, {
+                "error": 'body needs {"candidate": {...config '
+                         'deltas...}} or {"abort": true}',
+                "type": "bad_request"})
+            return
+        try:
+            status = router.rollout.start(
+                candidate,
+                allow_capacity_dip=obj.get("allow_capacity_dip"))
+        except RolloutError as e:
+            active = router.rollout.active
+            self._json(409 if active else 400,
+                       {"error": str(e),
+                        "type": "rollout_active" if active
+                        else "bad_candidate"})
+            return
+        self._json(202, status)
 
     # -- POST /generate: proxy with failover -------------------------------
 
@@ -267,6 +324,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
             body = self.rfile.read(n)
         except ValueError:
             self._json(400, {"error": "bad Content-Length"})
+            return
+        if self.path == "/rollout":
+            self._rollout_admin(router, body)
             return
         if self.path != "/generate":
             self._json(404, {"error": f"unknown path {self.path}"})
@@ -1124,11 +1184,15 @@ class RouterServer:
                  retry_after: int = 1,
                  resume_lookup=None,
                  span_dir: Optional[str] = None,
+                 rollout=None,
                  own_registry_thread: bool = True) -> None:
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         self.resume_lookup = resume_lookup
         self.span_dir = span_dir
+        #: RolloutController wired behind POST/GET /rollout (None =
+        #: the admin surface answers a typed 503).
+        self.rollout = rollout
         self.registry = registry
         self.host = host
         self.port = port
@@ -1185,7 +1249,7 @@ class RouterServer:
         return store.autopsy(trace_id)
 
     def stats(self) -> Dict:
-        return {
+        out = {
             **self.registry.metrics.snapshot(),
             "policy": "join-shortest-queue",
             "max_attempts": self.max_attempts,
@@ -1194,6 +1258,9 @@ class RouterServer:
             "replicas": {s.endpoint.rid: s.as_dict()
                          for s in self.registry.statuses()},
         }
+        if self.rollout is not None:
+            out["rollout"] = self.rollout.status()
+        return out
 
     def start(self) -> "RouterServer":
         if self._httpd is not None:

@@ -103,6 +103,17 @@ class ReplicaSpec:
     drain_timeout: float = 10.0
     warm: Sequence[int] = ()
     faults: Sequence[str] = ()
+    #: Config-generation label (docs/serving.md "Fleet rollouts"):
+    #: stamped into the replica's EngineConfig and echoed through its
+    #: /stats so the rollout controller can prove which config a live
+    #: process was built at.  0 = the incumbent baseline.
+    config_gen: int = 0
+    #: Extra EngineConfig overrides rendered as repeatable
+    #: ``--set name=value`` flags (typed like replay's settings:
+    #: int/float/bool/none/str) — how a rollout candidate carries
+    #: engine knobs that have no dedicated CLI flag.
+    engine_knobs: Dict[str, object] = dataclasses.field(
+        default_factory=dict)
     extra_args: Sequence[str] = ()
 
     def command(self, port: int, host: str = "127.0.0.1") -> List[str]:
@@ -133,6 +144,13 @@ class ReplicaSpec:
             cmd += ["--warm", str(w)]
         for f in self.faults:
             cmd += ["--fault", f]
+        if self.config_gen:
+            cmd += ["--config-gen", str(self.config_gen)]
+        for name, value in self.engine_knobs.items():
+            rendered = ("none" if value is None
+                        else str(value).lower() if isinstance(value, bool)
+                        else str(value))
+            cmd += ["--set", f"{name}={rendered}"]
         cmd += list(self.extra_args)
         return cmd
 
@@ -148,6 +166,7 @@ class ReplicaHandle:
     spawned_at: float
     restarts: int = 0            # respawns of this SLOT so far
     term_sent_at: Optional[float] = None
+    kill_sent: bool = False      # drain escalated to SIGKILL (once)
     unroutable_since: Optional[float] = None
 
     @property
@@ -221,6 +240,11 @@ class ReplicaSupervisor:
         self._handles: Dict[int, ReplicaHandle] = {}   # slot -> handle
         self._respawn_at: Dict[int, float] = {}        # slot -> monotonic
         self._gen: Dict[int, int] = {}
+        # Per-slot spec overrides (rollout controller): a slot with an
+        # override respawns at THAT spec instead of self._spec — the
+        # mechanism by which a rolling reconfiguration rebuilds one
+        # replica at a time while the rest keep the incumbent config.
+        self._slot_specs: Dict[int, ReplicaSpec] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -259,6 +283,17 @@ class ReplicaSupervisor:
             while h.proc.poll() is None and time.monotonic() < deadline:
                 time.sleep(0.05)
             if h.proc.poll() is None:
+                if drain:
+                    h.kill_sent = True
+                    self.registry.metrics.drain_timeouts.inc()
+                    self._instant("replica_drain_timeout",
+                                  {"rid": h.rid, "pid": h.pid,
+                                   "grace_s": self._shutdown_grace})
+                    logger.warning(
+                        "router: replica %s (pid %d) did not drain "
+                        "within shutdown_grace=%.1fs at stop; "
+                        "escalating to SIGKILL", h.rid, h.pid,
+                        self._shutdown_grace)
                 self._signal(h, signal.SIGKILL)
                 h.proc.wait()
 
@@ -285,6 +320,62 @@ class ReplicaSupervisor:
         with self._lock:
             return self._handles.get(slot)
 
+    # -- per-slot spec overrides (rollout controller) ----------------------
+
+    @property
+    def spec(self):
+        """The fleet-wide base spec (ReplicaSpec or command callable)."""
+        return self._spec
+
+    def set_base_spec(self, spec: ReplicaSpec) -> None:
+        """Promote ``spec`` to the fleet-wide base and drop every slot
+        override — the rollout controller's final act after a full
+        promotion (from here on, ANY respawn lands on the new config)."""
+        with self._lock:
+            self._spec = spec
+            self._slot_specs.clear()
+
+    def slot_spec(self, slot: int):
+        """The spec ``slot`` will (re)spawn at: its override when the
+        rollout controller set one, else the fleet-wide base spec."""
+        with self._lock:
+            return self._slot_specs.get(slot, self._spec)
+
+    def set_slot_spec(self, slot: int, spec: ReplicaSpec) -> None:
+        """Override ``slot``'s spec — takes effect on its NEXT spawn
+        (the rollout controller drains the slot to trigger one)."""
+        if callable(self._spec):
+            raise TypeError(
+                "slot spec overrides require a ReplicaSpec base, not a "
+                "callable command factory")
+        with self._lock:
+            self._slot_specs[slot] = spec
+
+    def clear_slot_spec(self, slot: int) -> None:
+        with self._lock:
+            self._slot_specs.pop(slot, None)
+
+    def drain_slot(self, slot: int,
+                   reason: str = "rollout") -> Optional[ReplicaHandle]:
+        """Start the graceful drain of one slot's live process (SIGTERM
+        → the replica's drain handler; the monitor escalates to SIGKILL
+        after ``shutdown_grace``).  The exit watcher then respawns the
+        slot at :meth:`slot_spec` — this is the rollout controller's
+        one-replica-at-a-time rebuild primitive.  Returns the handle
+        being drained (None for an empty slot)."""
+        with self._lock:
+            h = self._handles.get(slot)
+        if h is None or h.proc.poll() is not None:
+            return h
+        if h.term_sent_at is None:
+            h.term_sent_at = time.monotonic()
+            self._instant("replica_drain",
+                          {"rid": h.rid, "pid": h.pid, "reason": reason})
+            logger.info("router: draining replica %s (pid %d) for %s",
+                        h.rid, h.pid, reason)
+            self._signal(h, signal.SIGTERM)
+        return h
+
     # -- spawn / reap ------------------------------------------------------
 
     def _command(self, slot: int, port: int,
@@ -296,7 +387,7 @@ class ReplicaSupervisor:
             # (Journaling/span streams are replica_main plumbing —
             # custom programs arm their own.)
             return list(self._spec(slot, port))
-        cmd = self._spec.command(port, self._host)
+        cmd = self.slot_spec(slot).command(port, self._host)
         if journal_path:
             cmd += ["--journal", journal_path]
         if span_path:
@@ -364,8 +455,8 @@ class ReplicaSupervisor:
         # it, so disjointness is by construction).  An operator who
         # already pinned the env wins — the supervisor only fills
         # blanks.
-        tp = getattr(self._spec, "tp", 1) if not callable(self._spec) \
-            else 1
+        spec = self.slot_spec(slot)
+        tp = getattr(spec, "tp", 1) if not callable(spec) else 1
         if tp > 1:
             flag = "--xla_force_host_platform_device_count"
             if flag not in env.get("XLA_FLAGS", ""):
@@ -449,7 +540,21 @@ class ReplicaSupervisor:
                 if h.term_sent_at is None:
                     continue
             if h.term_sent_at is not None:
-                if now - h.term_sent_at >= self._shutdown_grace:
+                if (now - h.term_sent_at >= self._shutdown_grace
+                        and not h.kill_sent):
+                    # Drain blew its budget: count it, mark the
+                    # timeline, and escalate ONCE — in-flight requests
+                    # now fail over via the journal instead of
+                    # finishing locally.
+                    h.kill_sent = True
+                    self.registry.metrics.drain_timeouts.inc()
+                    self._instant("replica_drain_timeout",
+                                  {"rid": h.rid, "pid": h.pid,
+                                   "grace_s": self._shutdown_grace})
+                    logger.warning(
+                        "router: replica %s (pid %d) drain exceeded "
+                        "shutdown_grace=%.1fs; escalating to SIGKILL",
+                        h.rid, h.pid, self._shutdown_grace)
                     self._signal(h, signal.SIGKILL)
                 continue
             if h.unroutable_since is None:
@@ -497,10 +602,12 @@ class ReplicaSupervisor:
             self._instant("replica_exit", {"rid": h.rid, "pid": h.pid,
                                            "exit_code": rc})
             logger.warning(
-                "router: replica %s (pid %d) exited with code %s%s",
+                "router: replica %s (pid %d) exited with code %s%s%s",
                 h.rid, h.pid, rc,
                 " (engine terminally failed)"
-                if rc == EXIT_CODE_REPLICA_FAILED else "")
+                if rc == EXIT_CODE_REPLICA_FAILED else "",
+                " (drain timed out; was SIGKILLed)"
+                if h.kill_sent else "")
         if now >= when and not self._stop.is_set():
             self._spawn(slot)
 

@@ -236,6 +236,16 @@ class OnlineTuner:
             self._ticks = 0
             self._advance(engine, stats)
 
+    def reset_window(self) -> None:
+        """Drop the current scoring window's baseline — called by the
+        engine's supervised-restart path (``_recover``), so the first
+        post-restart window starts from post-restart counters instead
+        of scoring the crash (dead time, resume re-prefills, inflated
+        TTFT) against whatever knob setting happened to be live."""
+        with self._lock:
+            self._window = None
+            self._ticks = 0
+
     def _advance(self, engine, stats: WindowStats) -> None:
         metrics = engine.metrics
         if self.phase == "warmup":

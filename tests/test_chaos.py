@@ -1479,3 +1479,46 @@ class TestServerFaultTolerance:
                               {"tokens": prompt, "max_new_tokens": 6})
             assert code == 200
             assert out["tokens"] == _ref_greedy(params, cfg, prompt, 6)
+
+
+@pytest.mark.slow
+class TestTunerResetOnRecover:
+    """Regression (docs/serving.md "Self-tuning"): a supervised
+    restart must DROP the online tuner's scoring-window baseline.
+    The baseline predates the crash, so scoring the first post-restart
+    window against it would charge the dead time + resume re-prefills
+    to whatever knob setting happened to be live — garbage that can
+    trip a spurious SLO rollback.  Slow (an autotune engine's full
+    warm sweep); tier-1 siblings: test_tuning.py's
+    test_reset_window_drops_baseline covers the reset itself, and
+    TestSupervisedRestart here covers the _recover path every run."""
+
+    def test_recover_resets_tuner_window(self, model):
+        params, cfg = model
+        inj = serving.FaultInjector()
+        engine = _engine(model, faults=inj, autotune=True)
+        _warm(engine)                      # installs the tuner
+        tuner = engine._tuner
+        assert tuner is not None
+        # a couple of worked ticks so a window baseline is OPEN
+        fut = engine.submit([9, 10], max_new_tokens=4)
+        _run_until_done(engine, [fut])
+        assert tuner._window is not None
+        resets = []
+        orig = tuner.reset_window
+        tuner.reset_window = lambda: (resets.append(1), orig())[-1]
+        inj.add(serving.FaultSpec(
+            site="decode_tick", kind="raise",
+            skip=inj.visits("decode_tick") + 1))
+        futs = [engine.submit([3, 4, 5], max_new_tokens=8)]
+        _run_until_done(engine, futs)
+        assert engine.stats()["engine_restarts"] == 1
+        assert resets, "_recover never reset the tuner window"
+        # recovery still serves the oracle, and the resumed request's
+        # output is byte-identical through the restart
+        assert futs[0].result(timeout=0) == _ref_greedy(
+            params, cfg, [3, 4, 5], 8)
+        fut = engine.submit([6, 7], max_new_tokens=6)
+        _run_until_done(engine, [fut])
+        assert fut.result(timeout=0) == _ref_greedy(params, cfg,
+                                                    [6, 7], 6)

@@ -1,0 +1,1001 @@
+"""Rollout controller (horovod_tpu/serving/router/rollout.py).
+
+Two layers of proof, mirroring tests/test_router.py:
+
+* **Unit** (a fake supervisor + registry pair that completes drains
+  and respawns synchronously, and canned ``/stats`` snapshots wired
+  straight into the controller's fetch hook): the full state machine
+  — happy-path promotion, candidate splitting (spec fields vs engine
+  knobs), the refusal rules, a deterministic fault at every one of
+  the four ``rollout_*`` sites, canary SLO/score/crash/abort trips,
+  drain-overrun trips, the journal format, and the recovery decision
+  rule (journaled ``rolling`` → resume forward, else roll back).
+  Every trip must leave the fake fleet convergent: either every slot
+  at the candidate config or every slot back at the incumbent, never
+  mixed, with the override table empty.
+* **Chaos** (real replica subprocesses behind a real supervisor +
+  router): the acceptance invariant — a full rolling promotion under
+  concurrent load drops zero requests and converges every replica's
+  live ``/stats`` config generation; SIGKILLing the canary mid-score
+  trips an automatic rollback that converges back to the incumbent
+  with every request still resolving oracle-identical; and a
+  supervisor that died mid-rollout (its journal ends without an
+  ``end`` event) recovers deterministically from the journal alone.
+"""
+
+import json
+import os
+import signal
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import serving
+from horovod_tpu.models import transformer as T
+from horovod_tpu.serving.router import (
+    ReplicaEndpoint,
+    ReplicaRegistry,
+    ReplicaSpec,
+    ReplicaSupervisor,
+    RolloutController,
+    RolloutError,
+    RouterMetrics,
+    RouterServer,
+)
+from horovod_tpu.serving.router import rollout as rollout_mod
+
+pytestmark = pytest.mark.rollout
+
+
+# ---------------------------------------------------------------------------
+# fakes: a supervisor + registry pair with synchronous drains/respawns
+# ---------------------------------------------------------------------------
+
+
+class _FakeHandle:
+    def __init__(self, slot, gen):
+        self.slot = slot
+        self.gen = gen
+
+    @property
+    def rid(self):
+        return f"r{self.slot}g{self.gen}"
+
+
+class _FakeStatus:
+    """Just enough ReplicaStatus surface for the controller: an
+    endpoint with a rid/base_url and the polled config generation."""
+
+    def __init__(self, rid, config_gen=0):
+        self.endpoint = ReplicaEndpoint(rid, "127.0.0.1", 1)
+        self.config_gen = config_gen
+
+
+class _FakeRegistry:
+    """The registry surface the controller touches, no HTTP."""
+
+    def __init__(self):
+        self.metrics = RouterMetrics()
+        self.poll_timeout = 1.0
+        self._thread = object()   # "poll thread running"
+        self.routable = {}        # rid -> config_gen
+        self.canary_rid = None
+        self.canary_weight = 0.0
+        self.canary_history = []
+
+    def is_routable(self, rid):
+        return rid in self.routable
+
+    def in_rotation(self):
+        return [_FakeStatus(rid, g) for rid, g in self.routable.items()]
+
+    statuses = in_rotation
+
+    def poll_now(self):
+        pass
+
+    def set_canary(self, rid, weight):
+        self.canary_rid = rid
+        self.canary_weight = weight
+        self.canary_history.append(rid)
+
+    def clear_canary(self):
+        self.canary_rid = None
+        self.canary_weight = 0.0
+
+
+class _FakeSupervisor:
+    """Drain-and-respawn completes synchronously: ``drain_slot`` swaps
+    in a NEW handle one generation up (the real supervisor's exit
+    watcher does this asynchronously) and marks the new rid routable
+    at its slot spec's config generation.  ``drain_mode`` scripts the
+    failure shapes the trips need."""
+
+    def __init__(self, spec, n_replicas, registry, *,
+                 drain_mode="ok", shutdown_grace=0.05):
+        self._spec = spec
+        self.n_replicas = n_replicas
+        self.registry = registry
+        self._shutdown_grace = shutdown_grace
+        self._slot_specs = {}
+        self._journal_dir = None
+        self.handles = {}
+        self.drained = []          # (slot, reason) in drain order
+        self.drain_mode = drain_mode
+        for slot in range(n_replicas):
+            h = _FakeHandle(slot, 0)
+            self.handles[slot] = h
+            registry.routable[h.rid] = spec.config_gen
+
+    @property
+    def spec(self):
+        return self._spec
+
+    def set_base_spec(self, spec):
+        self._spec = spec
+        self._slot_specs.clear()
+
+    def slot_spec(self, slot):
+        return self._slot_specs.get(slot, self._spec)
+
+    def set_slot_spec(self, slot, spec):
+        self._slot_specs[slot] = spec
+
+    def clear_slot_spec(self, slot):
+        self._slot_specs.pop(slot, None)
+
+    def handle(self, slot):
+        return self.handles.get(slot)
+
+    def respawn(self, slot, routable=True):
+        old = self.handles[slot]
+        self.registry.routable.pop(old.rid, None)
+        h = _FakeHandle(slot, old.gen + 1)
+        self.handles[slot] = h
+        if routable:
+            self.registry.routable[h.rid] = \
+                self.slot_spec(slot).config_gen
+        return h
+
+    def drain_slot(self, slot, reason="rollout"):
+        self.drained.append((slot, reason))
+        if self.drain_mode == "stuck":
+            return self.handles[slot]     # never exits, never respawns
+        if self.drain_mode == "unroutable":
+            return self.respawn(slot, routable=False)
+        return self.respawn(slot)
+
+
+def _snap(tokens=0, ticks=0, preempt=0, ttft=None):
+    """One cumulative /stats payload in the replica contract shape."""
+    hists = {}
+    for cls, buckets in (ttft or {}).items():
+        total = sum(buckets.values())
+        hists[cls] = {"count": total, "sum": 0.0, "buckets": buckets}
+    return {"tokens_generated": tokens, "decode_ticks": ticks,
+            "preemptions": preempt, "ttft_seconds_by_class": hists}
+
+
+def _wire_stats(ctl, feeds):
+    """Replace the controller's HTTP fetch with canned snapshot
+    sequences: ``feeds[rid]`` is a list consumed one per fetch (the
+    last entry repeats, so counters keep their final plateau)."""
+    cursors = {}
+
+    def fetch(st):
+        rid = st.endpoint.rid
+        seq = feeds.get(rid)
+        if not seq:
+            return None
+        i = cursors.get(rid, 0)
+        cursors[rid] = i + 1
+        return seq[min(i, len(seq) - 1)]
+
+    ctl._fetch_stats = fetch
+
+
+def _controller(sup, **kw):
+    kw.setdefault("window_s", 0.01)
+    kw.setdefault("canary_windows", 1)
+    kw.setdefault("drain_margin", 0.05)
+    kw.setdefault("ready_timeout", 2.0)
+    ctl = RolloutController(sup, **kw)
+    # Default canned stats: a healthy, in-SLO window for everyone.
+    good = [_snap(tokens=0, ticks=0),
+            _snap(tokens=50, ticks=10,
+                  ttft={"interactive": {"0.25": 5, "+Inf": 0}})]
+    feeds = {}
+    for slot in range(sup.n_replicas):
+        for gen in range(6):
+            feeds[f"r{slot}g{gen}"] = good
+    _wire_stats(ctl, feeds)
+    return ctl
+
+
+def _assert_converged(sup, reg, spec):
+    """The terminal invariant: every slot routable at ``spec``'s
+    config generation, no slot overrides left behind."""
+    assert sup._slot_specs == {}
+    gens = {slot: reg.routable.get(sup.handles[slot].rid)
+            for slot in range(sup.n_replicas)}
+    assert gens == {s: spec.config_gen for s in range(sup.n_replicas)}, \
+        f"fleet not converged: {gens}"
+
+
+# ---------------------------------------------------------------------------
+# unit: state machine
+# ---------------------------------------------------------------------------
+
+
+class TestRolloutMachine:
+    def test_happy_path_promotes_fleet(self, tmp_path):
+        reg = _FakeRegistry()
+        sup = _FakeSupervisor(ReplicaSpec(seed=0), 3, reg)
+        ctl = _controller(
+            sup, journal_path=str(tmp_path / "rollout.jsonl"))
+        st = ctl.start({"max_prefills_per_tick": 4, "page_size": 16})
+        assert st["state"] in ("draining", "rebuilding", "canary",
+                               "rolling", "done")
+        assert ctl.wait(10.0)
+        assert ctl.state == "done"
+        assert ctl.trip_reason is None
+        # promotion: candidate became the fleet-wide base spec
+        assert sup.spec.config_gen == 1
+        assert sup.spec.max_prefills_per_tick == 4       # spec field
+        assert sup.spec.engine_knobs == {"page_size": 16}  # engine knob
+        _assert_converged(sup, reg, sup.spec)
+        # one drain per slot, in slot order, tagged with the target gen
+        assert [s for s, _ in sup.drained] == [0, 1, 2]
+        assert all("gen 1" in r for _, r in sup.drained)
+        # the first rebuilt replica was the canary, then cleared
+        assert reg.canary_history == ["r0g1"]
+        assert reg.canary_rid is None
+        snap = reg.metrics.snapshot()
+        assert snap["rollouts_started"] == 1
+        assert snap["rollout_promotions"] == 1
+        assert snap["rollout_rollbacks"] == 0
+        assert snap["rollout_steps"] == 3
+        assert snap["rollout_active"] == 0
+        status = ctl.status()
+        assert status["config_generation"] == 1
+        assert status["canary_score"] is not None
+        for key in ("drain_slot0", "rebuild_slot0", "canary", "total"):
+            assert key in status["step_durations_s"]
+        # journal: start .. states .. end, with a score event
+        events = [json.loads(l) for l in
+                  (tmp_path / "rollout.jsonl").read_text().splitlines()]
+        assert events[0]["e"] == "start"
+        assert events[0]["config_gen"] == 1
+        assert events[-1]["e"] == "end"
+        assert events[-1]["state"] == "done"
+        assert any(e["e"] == "score" for e in events)
+        states = [e["s"] for e in events if e["e"] == "state"]
+        assert states[0] == "draining" and states[-1] == "done"
+        assert "rolling" in states
+
+    def test_candidate_split_and_generation_bump(self):
+        reg = _FakeRegistry()
+        base = ReplicaSpec(seed=0, config_gen=3,
+                           engine_knobs={"overlap": True})
+        sup = _FakeSupervisor(base, 2, reg)
+        ctl = _controller(sup)
+        ctl.start({"slots": 8, "speculation_k": 2})
+        assert ctl.wait(10.0)
+        cand = ctl._candidate_spec
+        assert cand.slots == 8                     # ReplicaSpec field
+        assert cand.config_gen == 4                # bumped from base
+        # new knob merged over the incumbent's existing knobs
+        assert cand.engine_knobs == {"overlap": True, "speculation_k": 2}
+
+    def test_refusals(self):
+        reg = _FakeRegistry()
+        sup = _FakeSupervisor(ReplicaSpec(seed=0), 2, reg)
+        ctl = _controller(sup)
+        with pytest.raises(RolloutError, match="non-empty"):
+            ctl.start({})
+        with pytest.raises(RolloutError, match="non-empty"):
+            ctl.start("slots=8")
+        # 1-replica fleet: the drain step would take 100% of capacity
+        sup1 = _FakeSupervisor(ReplicaSpec(seed=0), 1, _FakeRegistry())
+        with pytest.raises(RolloutError, match="allow_capacity_dip"):
+            _controller(sup1).start({"slots": 8})
+        # callable command factories carry no config to re-render
+        supc = _FakeSupervisor(ReplicaSpec(seed=0), 2, _FakeRegistry())
+        supc._spec = lambda slot, port: ["true"]
+        with pytest.raises(RolloutError, match="callable"):
+            _controller(supc).start({"slots": 8})
+
+    def test_one_replica_with_capacity_dip_promotes(self):
+        reg = _FakeRegistry()
+        sup = _FakeSupervisor(ReplicaSpec(seed=0), 1, reg)
+        ctl = _controller(sup, allow_capacity_dip=True)
+        ctl.start({"slots": 8})
+        assert ctl.wait(10.0)
+        assert ctl.state == "done"
+        assert sup.spec.config_gen == 1
+        _assert_converged(sup, reg, sup.spec)
+
+    def test_double_start_refused_while_active(self):
+        reg = _FakeRegistry()
+        sup = _FakeSupervisor(ReplicaSpec(seed=0), 2, reg)
+        ctl = _controller(sup, canary_windows=50, window_s=0.1)
+        ctl.start({"slots": 8})
+        try:
+            with pytest.raises(RolloutError, match="already"):
+                ctl.start({"slots": 16})
+        finally:
+            ctl.abort()
+            assert ctl.wait(10.0)
+
+    @pytest.mark.parametrize("site", ["rollout_drain", "rollout_rebuild",
+                                      "rollout_canary", "rollout_promote"])
+    def test_fault_at_every_site_converges_to_incumbent(self, site):
+        """THE chaos invariant at unit scale: a deterministic injected
+        fault at each of the four controller sites ends in a terminal
+        rollback state with the whole fleet back at the incumbent
+        config generation — never mixed, no overrides left."""
+        reg = _FakeRegistry()
+        incumbent = ReplicaSpec(seed=0)
+        sup = _FakeSupervisor(incumbent, 3, reg)
+        inj = serving.FaultInjector([
+            serving.FaultSpec(site=site, kind="raise")])
+        ctl = _controller(sup, faults=inj)
+        ctl.start({"slots": 8})
+        assert ctl.wait(10.0)
+        assert ctl.state in ("rolled_back", "aborted")
+        assert "InjectedFaultError" in ctl.trip_reason
+        assert sup.spec is incumbent            # never promoted
+        _assert_converged(sup, reg, incumbent)
+        snap = reg.metrics.snapshot()
+        assert snap["rollout_rollbacks"] == 1
+        assert snap["rollout_promotions"] == 0
+        assert snap["rollout_active"] == 0
+        assert reg.canary_rid is None
+        if site == "rollout_drain":
+            # tripped before ANY slot was touched: nothing to recycle
+            assert ctl.state == "aborted"
+            assert sup.drained == []
+        else:
+            # slot 0 ran the candidate config and had to be recycled
+            assert ctl.state == "rolled_back"
+            assert sup.handles[0].gen == 2      # out and back
+
+    def test_canary_slo_breach_rolls_back(self):
+        reg = _FakeRegistry()
+        incumbent = ReplicaSpec(seed=0)
+        sup = _FakeSupervisor(incumbent, 3, reg)
+        ctl = _controller(sup)
+        # Canary p99 lands in the 1.0s bucket: 2x the 0.5s interactive
+        # SLO = 100% excess, over the 50% guard band.
+        bad = [_snap(),
+               _snap(tokens=50, ticks=10,
+                     ttft={"interactive": {"0.25": 0, "1": 10,
+                                           "+Inf": 0}})]
+        good = [_snap(),
+                _snap(tokens=50, ticks=10,
+                      ttft={"interactive": {"0.25": 10, "+Inf": 0}})]
+        _wire_stats(ctl, {"r0g1": bad, "r1g0": good, "r2g0": good})
+        ctl.start({"slots": 8})
+        assert ctl.wait(10.0)
+        assert ctl.state == "rolled_back"
+        assert "canary_slo_breach" in ctl.trip_reason
+        assert "interactive" in ctl.trip_reason
+        assert sup.spec is incumbent
+        _assert_converged(sup, reg, incumbent)
+        assert reg.metrics.snapshot()["rollout_rollbacks"] == 1
+
+    def test_canary_score_below_incumbent_rolls_back(self):
+        reg = _FakeRegistry()
+        incumbent = ReplicaSpec(seed=0)
+        sup = _FakeSupervisor(incumbent, 3, reg)
+        ctl = _controller(sup, min_score_delta=1.0)
+        # In-SLO but much slower than the incumbents: 1 token/tick vs 8.
+        slow = [_snap(), _snap(tokens=10, ticks=10)]
+        fast = [_snap(), _snap(tokens=80, ticks=10)]
+        _wire_stats(ctl, {"r0g1": slow, "r1g0": fast, "r2g0": fast})
+        ctl.start({"slots": 8})
+        assert ctl.wait(10.0)
+        assert ctl.state == "rolled_back"
+        assert "below incumbent" in ctl.trip_reason
+        _assert_converged(sup, reg, incumbent)
+        st = ctl.status()
+        assert st["canary_score"] < st["incumbent_score"]
+
+    def test_canary_crash_rolls_back(self):
+        """The canary's handle generation moving during a scoring
+        window (the exit watcher respawned it = it crashed) trips."""
+        reg = _FakeRegistry()
+        incumbent = ReplicaSpec(seed=0)
+        sup = _FakeSupervisor(incumbent, 3, reg)
+        ctl = _controller(sup, window_s=0.2, canary_windows=5)
+        ctl.start({"slots": 8})
+        deadline = time.monotonic() + 5.0
+        while reg.canary_rid is None and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert reg.canary_rid == "r0g1"
+        sup.respawn(0)                          # crash + respawn
+        assert ctl.wait(10.0)
+        assert ctl.state == "rolled_back"
+        assert "canary" in ctl.trip_reason
+        assert sup.spec is incumbent
+        _assert_converged(sup, reg, incumbent)
+
+    def test_operator_abort_rolls_back(self):
+        reg = _FakeRegistry()
+        incumbent = ReplicaSpec(seed=0)
+        sup = _FakeSupervisor(incumbent, 3, reg)
+        ctl = _controller(sup, window_s=0.2, canary_windows=50)
+        ctl.start({"slots": 8})
+        deadline = time.monotonic() + 5.0
+        while reg.canary_rid is None and time.monotonic() < deadline:
+            time.sleep(0.005)
+        ctl.abort()
+        assert ctl.wait(10.0)
+        assert ctl.state == "rolled_back"
+        assert ctl.trip_reason == "operator_abort"
+        _assert_converged(sup, reg, incumbent)
+
+    def test_drain_overrun_trips_bounded(self):
+        """A slot that never exits its drain must not wedge the
+        rollout: the budget (drain_timeout + shutdown_grace + margin)
+        trips it into rollback."""
+        reg = _FakeRegistry()
+        incumbent = ReplicaSpec(seed=0, drain_timeout=0.05)
+        sup = _FakeSupervisor(incumbent, 2, reg, drain_mode="stuck",
+                              shutdown_grace=0.05)
+        ctl = _controller(sup, drain_margin=0.05)
+        t0 = time.monotonic()
+        ctl.start({"slots": 8})
+        assert ctl.wait(10.0)
+        assert time.monotonic() - t0 < 5.0
+        assert ctl.state == "rolled_back"
+        assert "drain_timeout" in ctl.trip_reason
+        assert sup.spec is incumbent
+        # the recycle overran too (drains stay stuck) — overrides are
+        # still cleared so the supervisor converges any future respawn
+        assert sup._slot_specs == {}
+
+    def test_rebuild_timeout_trips(self):
+        """A respawn that never becomes routable trips within
+        ready_timeout instead of waiting forever."""
+        reg = _FakeRegistry()
+        incumbent = ReplicaSpec(seed=0)
+        sup = _FakeSupervisor(incumbent, 2, reg,
+                              drain_mode="unroutable")
+        ctl = _controller(sup, ready_timeout=0.2)
+        ctl.start({"slots": 8})
+        assert ctl.wait(10.0)
+        assert ctl.state == "rolled_back"
+        assert "rebuild_timeout" in ctl.trip_reason
+        assert sup.spec is incumbent
+        assert sup._slot_specs == {}
+
+
+# ---------------------------------------------------------------------------
+# unit: journal + recovery decision rule
+# ---------------------------------------------------------------------------
+
+
+class TestRolloutRecovery:
+    def _journal(self, path, events):
+        with open(path, "w") as f:
+            for e in events:
+                f.write(json.dumps({"t": 0.0, **e}) + "\n")
+
+    def _fleet(self, slot_gens):
+        """A fake fleet whose live config generations are scripted —
+        the state a restarted supervisor would observe by polling."""
+        reg = _FakeRegistry()
+        incumbent = ReplicaSpec(seed=0)
+        sup = _FakeSupervisor(incumbent, len(slot_gens), reg)
+        for slot, gen in enumerate(slot_gens):
+            if gen:
+                h = sup.handles[slot]
+                reg.routable.pop(h.rid, None)
+                h2 = _FakeHandle(slot, 1)
+                sup.handles[slot] = h2
+                reg.routable[h2.rid] = gen
+        return reg, incumbent, sup
+
+    def test_no_pending_rollout_returns_none(self, tmp_path):
+        path = str(tmp_path / "rollout.jsonl")
+        reg, _, sup = self._fleet([0, 0])
+        ctl = _controller(sup, journal_path=path)
+        assert ctl.recover() is None            # no journal at all
+        self._journal(path, [
+            {"e": "start", "candidate": {"slots": 8}, "config_gen": 1,
+             "n_replicas": 2},
+            {"e": "state", "s": "draining"},
+            {"e": "end", "state": "rolled_back", "trip": "x"},
+        ])
+        assert ctl.recover() is None            # finished cleanly
+
+    def test_recover_rolls_back_before_promotion_point(self, tmp_path):
+        """SIGKILLed mid-canary (no ``rolling`` state journaled): the
+        candidate was never deemed good — recovery recycles the one
+        candidate-config slot back to the incumbent."""
+        path = str(tmp_path / "rollout.jsonl")
+        reg, incumbent, sup = self._fleet([1, 0, 0])
+        self._journal(path, [
+            {"e": "start", "candidate": {"slots": 8}, "config_gen": 1,
+             "n_replicas": 3},
+            {"e": "state", "s": "draining"},
+            {"e": "state", "s": "rebuilding"},
+            {"e": "state", "s": "canary"},
+        ])
+        ctl = _controller(sup, journal_path=path)
+        st = ctl.recover()
+        assert st is not None and st["state"] == "rolling_back"
+        assert ctl.wait(10.0)
+        assert ctl.state == "rolled_back"
+        assert sup.spec is incumbent
+        _assert_converged(sup, reg, incumbent)
+        # only the mismatched slot was recycled
+        assert [s for s, _ in sup.drained] == [0]
+        ev = [json.loads(l) for l in open(path)]
+        assert any(e.get("e") == "recover" and e["forward"] is False
+                   for e in ev)
+        assert ev[-1]["e"] == "end"
+
+    def test_recover_resumes_forward_past_promotion_point(
+            self, tmp_path):
+        """SIGKILLed while ``rolling`` (canary already scored good):
+        recovery finishes the promotion — only the slots still at the
+        incumbent generation are recycled, and the candidate becomes
+        the base spec."""
+        path = str(tmp_path / "rollout.jsonl")
+        reg, incumbent, sup = self._fleet([1, 1, 0])
+        self._journal(path, [
+            {"e": "start", "candidate": {"slots": 8}, "config_gen": 1,
+             "n_replicas": 3},
+            {"e": "state", "s": "draining"},
+            {"e": "state", "s": "rebuilding"},
+            {"e": "state", "s": "canary"},
+            {"e": "state", "s": "rolling"},
+        ])
+        ctl = _controller(sup, journal_path=path)
+        st = ctl.recover()
+        assert st is not None and st["state"] == "rolling"
+        assert ctl.wait(10.0)
+        assert ctl.state == "done"
+        assert sup.spec.config_gen == 1
+        assert sup.spec.slots == 8
+        _assert_converged(sup, reg, sup.spec)
+        assert [s for s, _ in sup.drained] == [2]
+
+    def test_recover_tolerates_torn_tail(self, tmp_path):
+        """A SIGKILL can tear the journal's final line mid-write; the
+        reader must skip it, not crash or mis-decide."""
+        path = str(tmp_path / "rollout.jsonl")
+        reg, incumbent, sup = self._fleet([1, 0])
+        self._journal(path, [
+            {"e": "start", "candidate": {"slots": 8}, "config_gen": 1,
+             "n_replicas": 2},
+            {"e": "state", "s": "draining"},
+        ])
+        with open(path, "a") as f:
+            f.write('{"t": 0.0, "e": "sta')   # torn write
+        ctl = _controller(sup, journal_path=path)
+        assert ctl.recover() is not None
+        assert ctl.wait(10.0)
+        assert ctl.state == "rolled_back"
+        _assert_converged(sup, reg, incumbent)
+
+
+# ---------------------------------------------------------------------------
+# unit: scoring plumbing
+# ---------------------------------------------------------------------------
+
+
+class TestScoringWindows:
+    def test_hist_delta_p99_diffs_cumulative_buckets(self):
+        base = {"buckets": {"0.1": 100, "0.5": 0, "+Inf": 0}}
+        now = {"buckets": {"0.1": 100, "0.5": 10, "+Inf": 0}}
+        # all 10 WINDOWED observations sit in the 0.5 bucket — the 100
+        # older ones in 0.1 must not drag the p99 down
+        assert rollout_mod._hist_delta_p99(now, base) == 0.5
+        # un-windowed, the 109th of 110 observations is still in the
+        # 0.5 bucket (upper-edge convention, same as _Window._p99)
+        assert rollout_mod._hist_delta_p99(now, None) == 0.5
+        only_low = {"buckets": {"0.1": 100, "0.5": 1, "+Inf": 0}}
+        assert rollout_mod._hist_delta_p99(only_low, None) == 0.1
+        assert rollout_mod._hist_delta_p99(base, base) is None  # empty
+        assert rollout_mod._hist_delta_p99({}, None) is None
+
+    def test_stats_window_diffs_counters(self):
+        w = rollout_mod._StatsWindow(_snap(tokens=100, ticks=20,
+                                           preempt=3))
+        out = w.close(_snap(tokens=160, ticks=30, preempt=4,
+                            ttft={"interactive": {"0.25": 5,
+                                                  "+Inf": 0}}))
+        assert (out.tokens, out.ticks, out.preemptions) == (60, 10, 1)
+        assert out.ttft_p99 == {"interactive": 0.25}
+
+    def test_merge_windows_sums_counters_takes_worst_p99(self):
+        from horovod_tpu.tuning import WindowStats
+        merged = rollout_mod._merge_windows([
+            WindowStats(ticks=10, tokens=50, preemptions=1,
+                        ttft_p99={"interactive": 0.1}),
+            WindowStats(ticks=20, tokens=80, preemptions=0,
+                        ttft_p99={"interactive": 0.4, "batch": 1.0}),
+        ])
+        assert (merged.ticks, merged.tokens, merged.preemptions) \
+            == (30, 130, 1)
+        assert merged.ttft_p99 == {"interactive": 0.4, "batch": 1.0}
+
+
+# ---------------------------------------------------------------------------
+# unit: the POST/GET /rollout admin surface
+# ---------------------------------------------------------------------------
+
+
+def _http(base, path, payload=None, timeout=10):
+    if payload is None:
+        req = urllib.request.Request(base + path)
+    else:
+        req = urllib.request.Request(
+            base + path, data=json.dumps(payload).encode()
+            if not isinstance(payload, bytes) else payload,
+            headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestRolloutAdminEndpoint:
+    def test_no_controller_is_typed_503(self):
+        rt = RouterServer(ReplicaRegistry(), port=0,
+                          own_registry_thread=False).start()
+        try:
+            base = "http://%s:%d" % rt.address
+            code, body = _http(base, "/rollout")
+            assert code == 503
+            assert body["type"] == "no_rollout_controller"
+            code, body = _http(base, "/rollout", {"candidate": {"x": 1}})
+            assert code == 503
+            assert body["type"] == "no_rollout_controller"
+        finally:
+            rt.stop()
+
+    def test_admin_lifecycle_and_validation(self):
+        reg = _FakeRegistry()
+        sup = _FakeSupervisor(ReplicaSpec(seed=0), 2, reg)
+        ctl = _controller(sup, window_s=0.2, canary_windows=100)
+        rt = RouterServer(ReplicaRegistry(), port=0, rollout=ctl,
+                          own_registry_thread=False).start()
+        try:
+            base = "http://%s:%d" % rt.address
+            code, body = _http(base, "/rollout", b"not json")
+            assert (code, body["type"]) == (400, "bad_request")
+            code, body = _http(base, "/rollout", {"nope": 1})
+            assert (code, body["type"]) == (400, "bad_request")
+            code, body = _http(base, "/rollout", {"candidate": {}})
+            assert (code, body["type"]) == (400, "bad_request")
+            # a shape the CONTROLLER refuses (1-replica fleet) is a
+            # typed bad_candidate, distinct from a malformed body
+            sup1 = _FakeSupervisor(ReplicaSpec(seed=0), 1,
+                                   _FakeRegistry())
+            rt.rollout = _controller(sup1)
+            code, body = _http(base, "/rollout",
+                               {"candidate": {"slots": 8}})
+            assert (code, body["type"]) == (400, "bad_candidate")
+            rt.rollout = ctl
+            # accepted: 202 + live status; visible through GET and the
+            # router's own /stats
+            code, body = _http(base, "/rollout",
+                               {"candidate": {"slots": 8}})
+            assert code == 202
+            assert body["active"] is True
+            assert body["config_generation"] == 1
+            code, body = _http(base, "/rollout")
+            assert code == 200 and body["active"] is True
+            code, body = _http(base, "/stats")
+            assert body["rollout"]["active"] is True
+            # a second start while active is a 409, not a new rollout
+            code, body = _http(base, "/rollout",
+                               {"candidate": {"slots": 16}})
+            assert (code, body["type"]) == (409, "rollout_active")
+            # operator abort over HTTP unwinds it
+            code, body = _http(base, "/rollout", {"abort": True})
+            assert code == 200
+            assert ctl.wait(10.0)
+            assert ctl.state == "rolled_back"
+            assert ctl.trip_reason == "operator_abort"
+        finally:
+            ctl.abort()
+            ctl.wait(10.0)
+            rt.stop()
+
+
+# ---------------------------------------------------------------------------
+# chaos: real replica processes, real kills
+# ---------------------------------------------------------------------------
+
+
+def _cfg():
+    return T.TransformerConfig(
+        vocab_size=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+        max_seq=48, dtype=jnp.float32, attention_impl="reference",
+        n_kv_heads=2)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = _cfg()
+    return T.init_params(jax.random.PRNGKey(0), cfg), cfg
+
+
+def _ref_greedy(params, cfg, prompt, steps):
+    return np.asarray(T.greedy_decode(
+        params, jnp.asarray([prompt], jnp.int32), steps, cfg))[0].tolist()
+
+
+def _post(base, payload, timeout=60):
+    req = urllib.request.Request(
+        base + "/generate", data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _replica_stats(reg):
+    """rid -> parsed /stats for every replica currently registered."""
+    out = {}
+    for st in reg.statuses():
+        try:
+            with urllib.request.urlopen(
+                    st.endpoint.base_url + "/stats", timeout=2.0) as r:
+                out[st.endpoint.rid] = json.loads(r.read())
+        except Exception:
+            pass
+    return out
+
+
+def _load_loop(base, prompts, steps, stop, results, timeout=90):
+    """Open-loop trickle: keep POSTing until told to stop, recording
+    every (code, tokens) — the zero-drops ledger."""
+    i = 0
+    while not stop.is_set():
+        p = prompts[i % len(prompts)]
+        try:
+            code, resp = _post(base, {"tokens": p,
+                                      "max_new_tokens": steps},
+                               timeout=timeout)
+            results.append((p, code, resp))
+        except Exception as e:
+            results.append((p, None, repr(e)))
+        i += 1
+        time.sleep(0.05)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestRolloutChaos:
+    """Real subprocess fleets.  Slow (multi-replica spawns + XLA
+    compiles per generation); tier-1 siblings: the TestRolloutMachine
+    fault matrix and TestRolloutRecovery prove the same decision logic
+    at unit scale every run."""
+
+    N = 3
+
+    def _fleet(self, n=None, spec=None, **sup_kw):
+        spec = spec or ReplicaSpec(seed=0, slots=4, warm=(8,),
+                                   tick_timeout=30.0, drain_timeout=3.0,
+                                   request_timeout=90.0)
+        reg = ReplicaRegistry(poll_interval=0.15, poll_timeout=1.0,
+                              heartbeat_stale=5.0)
+        sup_kw.setdefault("unhealthy_grace", 1.5)
+        sup_kw.setdefault("shutdown_grace", 2.0)
+        sup_kw.setdefault("backoff_initial", 0.1)
+        sup_kw.setdefault("journal_dir",
+                          tempfile.mkdtemp(prefix="rollout_journal_"))
+        sup = ReplicaSupervisor(spec, n or self.N, registry=reg,
+                                **sup_kw)
+        rt = RouterServer(reg, port=0, max_attempts=4,
+                          retry_backoff=0.05, proxy_timeout=120.0,
+                          resume_lookup=sup.resume_lookup)
+        return reg, sup, rt
+
+    def test_full_promotion_under_load_converges_and_drops_nothing(
+            self, model):
+        """ACCEPTANCE: a replay-tunable candidate rolls through a
+        3-replica fleet under open-loop load — every request resolves
+        200 with oracle-identical greedy output (including the ones
+        that failed over off draining replicas), zero 5xx, and every
+        live replica's /stats reports the candidate generation."""
+        params, cfg = model
+        steps = 12
+        rng = np.random.default_rng(7)
+        prompts = [[int(t) for t in rng.integers(1, 60, 2 + i % 3)]
+                   for i in range(4)]
+        # Oracle BEFORE the fleet exists: the XLA compile runs in a
+        # pristine process (and off the single CPU the replicas are
+        # about to saturate).
+        oracle = {tuple(p): _ref_greedy(params, cfg, p, steps)
+                  for p in prompts}
+        reg, sup, rt = self._fleet()
+        ctl = RolloutController(sup, canary_weight=0.3,
+                                canary_windows=1, window_s=1.0,
+                                ready_timeout=240.0)
+        rt.rollout = ctl
+        sup.start()
+        rt.start()
+        stop, results = threading.Event(), []
+        loader = None
+        try:
+            assert sup.wait_ready(timeout=240), "fleet never ready"
+            base = "http://%s:%d" % rt.address
+            loader = threading.Thread(
+                target=_load_loop,
+                args=(base, prompts, steps, stop, results))
+            loader.start()
+            time.sleep(0.5)
+            code, body = _http(base, "/rollout", {
+                "candidate": {"max_prefills_per_tick": 4}})
+            assert code == 202, body
+            assert ctl.wait(480.0), f"rollout wedged in {ctl.state}"
+            assert ctl.state == "done", ctl.trip_reason
+            time.sleep(1.0)
+        finally:
+            stop.set()
+            if loader is not None:
+                loader.join(120.0)
+            try:
+                # convergence: every live replica at generation 1
+                gens = {rid: s.get("config_generation")
+                        for rid, s in _replica_stats(reg).items()}
+                assert gens and set(gens.values()) == {1}, gens
+                # the promoted spec is the base for future respawns
+                assert sup.spec.config_gen == 1
+                assert sup.spec.max_prefills_per_tick == 4
+                snap = reg.metrics.snapshot()
+                assert snap["rollout_promotions"] == 1
+                assert snap["rollout_rollbacks"] == 0
+            finally:
+                rt.stop()
+                sup.stop()
+            # zero drops, zero rollout-attributable 5xx, every output
+            # oracle-identical through drains and failovers
+            assert results, "load loop recorded nothing"
+            for p, code, resp in results:
+                assert code == 200, (p, code, resp)
+                assert resp["tokens"] == oracle[tuple(p)], p
+
+    def test_sigkill_canary_rolls_back_and_converges(self, model):
+        """SIGKILL the canary replica during its scoring window: the
+        controller trips (crash/eviction), rolls the rebuilt slot back
+        to the incumbent, and the fleet converges to generation 0 with
+        every request still resolving oracle-identically."""
+        params, cfg = model
+        rng = np.random.default_rng(11)
+        prompts = [[int(t) for t in rng.integers(1, 60, 2 + i % 3)]
+                   for i in range(4)]
+        # Oracle precomputed for the same reason as the promotion test.
+        oracle = {tuple(p): _ref_greedy(params, cfg, p, 12)
+                  for p in prompts}
+        reg, sup, rt = self._fleet()
+        ctl = RolloutController(sup, canary_weight=0.3,
+                                canary_windows=20, window_s=1.0,
+                                ready_timeout=240.0)
+        rt.rollout = ctl
+        sup.start()
+        rt.start()
+        stop, results = threading.Event(), []
+        loader = None
+        try:
+            assert sup.wait_ready(timeout=240), "fleet never ready"
+            base = "http://%s:%d" % rt.address
+            loader = threading.Thread(
+                target=_load_loop, args=(base, prompts, 12, stop,
+                                         results))
+            loader.start()
+            assert ctl.start({"max_prefills_per_tick": 4})["active"]
+            deadline = time.monotonic() + 300.0
+            while (ctl.state != "canary"
+                   and time.monotonic() < deadline):
+                assert ctl.active, \
+                    f"tripped early: {ctl.state} {ctl.trip_reason}"
+                time.sleep(0.05)
+            assert ctl.state == "canary", "canary phase never reached"
+            h = sup.handle(0)
+            assert h is not None and h.gen == 1
+            os.kill(h.pid, signal.SIGKILL)
+            assert ctl.wait(480.0), f"rollout wedged in {ctl.state}"
+            assert ctl.state == "rolled_back", ctl.state
+            assert "canary" in ctl.trip_reason
+            time.sleep(1.0)
+        finally:
+            stop.set()
+            if loader is not None:
+                loader.join(120.0)
+            try:
+                gens = {rid: s.get("config_generation")
+                        for rid, s in _replica_stats(reg).items()}
+                assert gens and set(gens.values()) == {0}, gens
+                assert sup.spec.config_gen == 0
+                snap = reg.metrics.snapshot()
+                assert snap["rollout_rollbacks"] == 1
+                assert snap["rollout_promotions"] == 0
+            finally:
+                rt.stop()
+                sup.stop()
+            assert results, "load loop recorded nothing"
+            for p, code, resp in results:
+                assert code == 200, (p, code, resp)
+                assert resp["tokens"] == oracle[tuple(p)], p
+
+    def test_supervisor_killed_mid_rollout_recovers_from_journal(
+            self, model):
+        """A supervisor SIGKILLed mid-rollout leaves (a) a journal
+        with no ``end`` event and (b) one replica live at the
+        candidate config.  A fresh controller's :meth:`recover` must
+        converge the real fleet from the journal alone — here the
+        kill landed before the promotion point, so it rolls back."""
+        reg, sup, rt = self._fleet(n=2)
+        jdir = sup._journal_dir
+        path = os.path.join(jdir, "rollout.journal.jsonl")
+        sup.start()
+        rt.start()
+        try:
+            assert sup.wait_ready(timeout=240), "fleet never ready"
+            # Reproduce the dead supervisor's on-disk + fleet state by
+            # hand: slot 0 rebuilt at gen 1, journal cut off mid-canary
+            # (exactly what its last fsync'd lines would be).
+            candidate_spec = __import__("dataclasses").replace(
+                sup.spec, max_prefills_per_tick=4, config_gen=1)
+            sup.set_slot_spec(0, candidate_spec)
+            old = sup.handle(0)
+            sup.drain_slot(0, reason="rollout gen 1")
+            deadline = time.monotonic() + 240.0
+            while time.monotonic() < deadline:
+                h = sup.handle(0)
+                if (h is not None and h.gen > old.gen
+                        and reg.is_routable(h.rid)):
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError("slot 0 never rebuilt")
+            with open(path, "w") as f:
+                for e in [
+                    {"e": "start",
+                     "candidate": {"max_prefills_per_tick": 4},
+                     "config_gen": 1, "n_replicas": 2},
+                    {"e": "state", "s": "draining"},
+                    {"e": "state", "s": "rebuilding"},
+                    {"e": "state", "s": "canary"},
+                ]:
+                    f.write(json.dumps({"t": 0.0, **e}) + "\n")
+            gens = {rid: s.get("config_generation")
+                    for rid, s in _replica_stats(reg).items()}
+            assert sorted(gens.values()) == [0, 1], gens  # mixed!
+            # ... supervisor process "restarts": a fresh controller
+            ctl = RolloutController(sup, ready_timeout=240.0,
+                                    journal_path=path)
+            st = ctl.recover()
+            assert st is not None and st["state"] == "rolling_back"
+            assert ctl.wait(480.0), f"recovery wedged in {ctl.state}"
+            assert ctl.state == "rolled_back"
+            time.sleep(0.5)
+            gens = {rid: s.get("config_generation")
+                    for rid, s in _replica_stats(reg).items()}
+            assert gens and set(gens.values()) == {0}, gens
+            assert sup.spec.config_gen == 0
+            events = [json.loads(l) for l in open(path)]
+            assert events[-1]["e"] == "end"
+            # a second recover() sees the end event and is a no-op
+            assert ctl.recover() is None
+        finally:
+            rt.stop()
+            sup.stop()

@@ -39,6 +39,7 @@ from horovod_tpu.serving.router import (
     ReplicaRegistry,
     ReplicaSpec,
     ReplicaSupervisor,
+    RolloutController,
     RouterServer,
 )
 from horovod_tpu.serving.router.replica_main import parse_fault
@@ -266,15 +267,85 @@ class TestRegistry:
         assert not reg.is_routable("a")
         assert reg.metrics.poll_errors.value == 2
 
-    def test_mark_failed_is_immediate_and_poll_readmits(self):
+    def test_mark_failed_is_immediate_readmit_needs_hysteresis(self):
         f = _FakeReplica("a")
-        reg = _registry(f)
+        reg = _registry(f)  # readmit_threshold default 2
         try:
             reg.mark_failed("a")
             assert not reg.is_routable("a")
             assert reg.pick() is None
-            reg.poll_now()  # replica actually fine: one poll re-admits
+            reg.poll_now()  # first good poll: still out (hysteresis)
+            assert not reg.is_routable("a")
+            reg.poll_now()  # second CONSECUTIVE good poll re-admits
             assert reg.is_routable("a")
+        finally:
+            f.stop()
+
+    def test_flapping_replica_stays_out_of_rotation(self):
+        """Satellite regression (ISSUE 18): a replica that answers only
+        every other poll must NOT oscillate in and out of rotation —
+        before re-admission hysteresis, each good poll re-admitted it
+        for a full poll interval and each bad one evicted it again."""
+        f = _FakeReplica("a")
+        reg = _registry(f, fail_threshold=1, readmit_threshold=2)
+        good = dict(f.stats)
+        try:
+            assert reg.is_routable("a")
+            for _ in range(4):     # flap: fail, ok, fail, ok, ...
+                f.stats.clear()    # garbage payload = failed poll
+                reg.poll_now()
+                assert not reg.is_routable("a")
+                f.stats.update(good)
+                reg.poll_now()     # ONE good poll must not re-admit
+                assert not reg.is_routable("a")
+            # Steady recovery: the second consecutive good poll readmits.
+            reg.poll_now()
+            assert reg.is_routable("a")
+        finally:
+            f.stop()
+
+    def test_canary_weighted_pick_is_deterministic(self):
+        fakes = [_FakeReplica("a"), _FakeReplica("b"), _FakeReplica("c")]
+        reg = _registry(*fakes)
+        try:
+            reg.set_canary("c", 0.25)
+            picks = [reg.pick().endpoint.rid for _ in range(40)]
+            # Credit accumulator: exactly weight * picks go canary-ward.
+            assert picks.count("c") == 10
+            # Incumbents split the rest; nobody is starved.
+            assert picks.count("a") > 0 and picks.count("b") > 0
+            reg.clear_canary()
+            picks = [reg.pick().endpoint.rid for _ in range(9)]
+            assert picks.count("c") == 3  # plain JSQ round-robin again
+        finally:
+            for f in fakes:
+                f.stop()
+
+    def test_canary_alone_in_rotation_still_picked(self):
+        """Availability beats the traffic split: a canary that is the
+        only routable replica serves everything rather than nothing."""
+        fakes = [_FakeReplica("a"), _FakeReplica("b")]
+        reg = _registry(*fakes)
+        try:
+            reg.set_canary("b", 0.1)
+            fakes[0].stats["engine_state"] = "failed"
+            reg.poll_now()
+            picks = [reg.pick().endpoint.rid for _ in range(5)]
+            assert picks == ["b"] * 5
+        finally:
+            for f in fakes:
+                f.stop()
+
+    def test_config_generation_tracked_from_stats(self):
+        f = _FakeReplica("a")
+        reg = _registry(f)
+        try:
+            assert reg.statuses()[0].config_gen == 0  # absent -> 0
+            f.stats["config_generation"] = 3
+            reg.poll_now()
+            st = reg.statuses()[0]
+            assert st.config_gen == 3
+            assert st.as_dict()["config_generation"] == 3
         finally:
             f.stop()
 
@@ -1359,3 +1430,106 @@ class TestFrontTierChaos:
             rt.stop()
             sup.stop(drain=False)
             TR.stop_spans()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+class TestRolloutDrainChaos:
+    """SATELLITE drill (tests/test_rollout.py owns the rollout suite;
+    this one lives here because it exercises the FRONT-TIER failover
+    path): SIGKILL a replica at the exact moment a rollout is
+    draining it.  The drain's SIGTERM already told it to finish its
+    in-flight work; the SIGKILL means it cannot — so those requests
+    must fail over and RESUME byte-identical on the survivor, while
+    the rollout itself (tripped by an injected canary fault) rolls
+    back cleanly to an all-incumbent fleet.  Slow (real replica
+    subprocesses); tier-1 siblings: TestResumeFailover here and
+    test_rollout.py's TestRolloutMachine fault matrix."""
+
+    def test_sigkill_mid_rollout_drain_resumes_and_rolls_back(
+            self, model):
+        params, cfg = model
+        steps = 24
+        rng = np.random.default_rng(17)
+        prompts = [[int(t) for t in rng.integers(1, 60, 2 + i % 3)]
+                   for i in range(6)]
+        # Oracle BEFORE the fleet exists: the XLA compile runs in a
+        # pristine process, off the CPU the replicas are about to
+        # saturate.
+        oracle = {tuple(p): _ref_greedy(params, cfg, p, steps)
+                  for p in prompts}
+        spec = ReplicaSpec(seed=0, slots=4, warm=(8, 30),
+                           tick_timeout=30.0, drain_timeout=5.0,
+                           request_timeout=90.0)
+        reg = ReplicaRegistry(poll_interval=0.15, poll_timeout=1.0,
+                              heartbeat_stale=5.0)
+        journal_dir = tempfile.mkdtemp(prefix="rollout_drain_chaos_")
+        sup = ReplicaSupervisor(spec, 2, registry=reg,
+                                unhealthy_grace=1.5, shutdown_grace=2.0,
+                                backoff_initial=0.1,
+                                journal_dir=journal_dir)
+        rt = RouterServer(reg, port=0, max_attempts=4,
+                          retry_backoff=0.05, proxy_timeout=120.0,
+                          resume_lookup=sup.resume_lookup)
+        # The canary fault guarantees the rollout TRIPS after the
+        # rebuild, so the drill proves rollback convergence too.
+        ctl = RolloutController(
+            sup, canary_windows=1, window_s=0.5, ready_timeout=240.0,
+            faults=serving.FaultInjector([serving.FaultSpec(
+                site="rollout_canary", kind="raise")]))
+        rt.rollout = ctl
+        sup.start()
+        rt.start()
+        try:
+            assert sup.wait_ready(timeout=240), "replicas never ready"
+            host, port = rt.address
+            base = f"http://{host}:{port}"
+
+            def rollout_then_kill_draining():
+                """Start the rollout (slot 0 drains first), then
+                SIGKILL that exact process the moment the SIGTERM
+                lands — its in-flight share cannot finish locally."""
+                h0 = sup.handle(0)
+                assert h0 is not None
+                ctl.start({"max_prefills_per_tick": 4})
+                deadline = time.monotonic() + 60.0
+                while (h0.term_sent_at is None
+                       and time.monotonic() < deadline):
+                    time.sleep(0.005)
+                assert h0.term_sent_at is not None, "drain never began"
+                os.kill(h0.pid, signal.SIGKILL)
+
+            results = _burst(base, prompts, steps, timeout=120,
+                             kill_after=rollout_then_kill_draining)
+
+            assert len(results) == len(prompts)
+            drops = [i for i, (c, _) in results.items() if c is None]
+            assert not drops, f"transport-dropped requests: {results}"
+            for i, (code, resp) in results.items():
+                assert code == 200, f"req {i}: {code} {resp}"
+                assert resp["tokens"] == oracle[tuple(prompts[i])], \
+                    f"req {i}"
+
+            # the rollout tripped and converged back to the incumbent
+            assert ctl.wait(480.0), f"rollout wedged in {ctl.state}"
+            assert ctl.state == "rolled_back", ctl.state
+            assert "InjectedFaultError" in ctl.trip_reason
+            snap = reg.metrics.snapshot()
+            assert snap["rollout_rollbacks"] == 1
+            assert snap["rollout_promotions"] == 0
+            time.sleep(0.5)
+            gens = set()
+            for st in reg.statuses():
+                try:
+                    with urllib.request.urlopen(
+                            st.endpoint.base_url + "/stats",
+                            timeout=2.0) as r:
+                        gens.add(json.loads(r.read())
+                                 .get("config_generation"))
+                except Exception:
+                    pass
+            assert gens == {0}, f"fleet not all-incumbent: {gens}"
+            assert sup.spec.config_gen == 0
+        finally:
+            rt.stop()
+            sup.stop()

@@ -451,3 +451,20 @@ class TestOfflineTuning:
         assert verdict["ok"], verdict
         assert verdict["token_identical"] == verdict["compared"]
         assert verdict["decode_recompiles"] == 0
+
+
+class TestResetWindow:
+    def test_reset_window_drops_baseline(self):
+        """The supervised-restart hook (engine._recover calls this):
+        dropping the window baseline means the first post-restart
+        window scores post-restart counters only — never the crash's
+        dead time.  Tier-1 sibling of test_chaos.py's slow
+        TestTunerResetOnRecover, which proves the _recover wiring on
+        a real fault-injected engine."""
+        tuner = OnlineTuner(KnobSpace([
+            Knob(name="k", default=2, kind="bo", bounds=(1, 4))]))
+        tuner._window = object()     # an open baseline
+        tuner._ticks = 17
+        tuner.reset_window()
+        assert tuner._window is None
+        assert tuner._ticks == 0
