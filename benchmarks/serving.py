@@ -283,7 +283,70 @@ def _ab_paged(args, cfg, params):
         except serving.CacheOutOfPagesError:
             preempted += 1
 
+    # -- per-tick attention time SPLIT: gather / dequant / attend vs the
+    #    fused kernel, each leg its own jitted function on one layer's
+    #    full int8 pool (int8 so the dequant leg is live), scaled to a
+    #    per-tick figure by n_layers.  This is the attribution column
+    #    for benchmarks/paged_decode_ab.py's end-to-end A/B: when the
+    #    fused ratio moves, this says WHICH leg the kernel absorbed.
+    from horovod_tpu.models import transformer as T
+    from horovod_tpu.ops import paged_attention as PA
+
+    hkv = cfg.n_kv_heads or cfg.n_heads
+    dh = cfg.d_model // cfg.n_heads
+    mp = -(-max_len // ps)
+    npage = 1 + S * mp  # page 0 = NULL
+    kq, ks = T.kv_quantize(jax.random.normal(
+        jax.random.PRNGKey(11), (npage, hkv, ps, dh), jnp.float32))
+    vq, vs = T.kv_quantize(jax.random.normal(
+        jax.random.PRNGKey(12), (npage, hkv, ps, dh), jnp.float32))
+    table = jnp.asarray(
+        1 + np.arange(S * mp, dtype=np.int32).reshape(S, mp))
+    pos = jnp.full((S,), max_len - 1, jnp.int32)
+    mask = (jax.lax.broadcasted_iota(jnp.int32, (mp * ps,), 0)[None, :]
+            <= pos[:, None])
+    qh = jax.random.normal(jax.random.PRNGKey(13),
+                           (S, cfg.n_heads, 1, dh), cfg.dtype)
+
+    gather = jax.jit(lambda kp, sk, vp, sv, t: (
+        T._gather_pages(kp, t), T._gather_scales(sk, t),
+        T._gather_pages(vp, t), T._gather_scales(sv, t)))
+    dequant = jax.jit(lambda kg, sk, vg, sv: (
+        T.kv_dequantize(kg, sk, cfg.dtype),
+        T.kv_dequantize(vg, sv, cfg.dtype)))
+    attend = jax.jit(lambda q, kd, vd: T._cache_attend(
+        q, kd, vd, mask[:, None, None, :]))
+    fused = jax.jit(lambda q, kp, vp, sk, sv, t, lim: PA.paged_attend(
+        q.reshape(S, hkv, cfg.n_heads // hkv, dh), kp, vp, sk, sv,
+        t, lim, compute_dtype=cfg.dtype)[0])
+
+    def _best(fn, *a):
+        jax.block_until_ready(fn(*a))  # compile + warm
+        best = float("inf")
+        for _ in range(max(args.iters, 4)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*a))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_gather = _best(gather, kq, ks, vq, vs, table)
+    kg, skg, vg, svg = gather(kq, ks, vq, vs, table)
+    t_dequant = _best(dequant, kg, skg, vg, svg)
+    kd, vd = dequant(kg, skg, vg, svg)
+    t_attend = _best(attend, qh, kd, vd)
+    t_fused = _best(fused, qh, kq, vq, ks, vs, table, pos + 1)
+    to_tick_ms = cfg.n_layers * 1e3
+    attn_split = {
+        "gather_ms": round(t_gather * to_tick_ms, 4),
+        "dequant_ms": round(t_dequant * to_tick_ms, 4),
+        "attend_ms": round(t_attend * to_tick_ms, 4),
+        "unfused_total_ms": round(
+            (t_gather + t_dequant + t_attend) * to_tick_ms, 4),
+        "fused_ms": round(t_fused * to_tick_ms, 4),
+    }
+
     return {
+        "attn_split_per_tick": attn_split,
         "decode_tok_s_paged": round(S / q["paged"], 2),
         "decode_tok_s_unpaged": round(S / q["unpaged"], 2),
         "paged_decode_ratio": round(q["unpaged"] / q["paged"], 3),

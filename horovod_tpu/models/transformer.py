@@ -537,6 +537,27 @@ def paged_pool_specs(quantized: bool = False) -> Dict:
     return specs
 
 
+def paged_kernel_specs(quantized: bool = False):
+    """Operand/result PartitionSpecs for the fused paged-attention
+    kernel under a tp mesh — the ONE ordering contract
+    :func:`_paged_kernel_attend`'s ``shard_map`` and
+    :meth:`~horovod_tpu.serving.sharding.ServingSharding.
+    paged_kernel_shardings` both read.  The kernel's grid is
+    per-(slot, kv-head) with no cross-head communication, so grouped
+    queries, the per-layer pool, and int8 scales all split at the
+    kv-head dim over ``tp`` while the page table and per-slot limits
+    stay replicated host data; outputs come back head-sharded, matching
+    the out-projection that consumes them.  Returns ``(in_specs,
+    out_specs)`` ordered as ``(q, k_pool, v_pool[, k_scale, v_scale],
+    table, limit)`` / ``(o, lse)``."""
+    head = P(None, "tp", None, None)
+    scale = P(None, "tp", None)
+    in_specs = (head, head, head)
+    if quantized:
+        in_specs = in_specs + (scale, scale)
+    return in_specs + (P(), P()), (head, P(None, "tp", None))
+
+
 def prefix_kv_specs():
     """Sharding for a gathered shared-prefix block
     (:func:`~horovod_tpu.serving.cache.gather_prefix_pages` output,
@@ -757,7 +778,15 @@ def kv_quantize(x):
 
 
 def kv_dequantize(q, scale, dtype):
-    """Inverse of :func:`kv_quantize`: ``q * scale`` cast to ``dtype``."""
+    """Inverse of :func:`kv_quantize`: ``q * scale`` cast to ``dtype``.
+
+    PINNED compute dtype: the multiply happens in f32 — even when
+    ``dtype`` is bf16 — and only the final cast narrows.  The fused
+    paged-attention kernel replicates this exact f32-multiply-then-cast
+    in its load (:data:`horovod_tpu.ops.paged_attention.DEQUANT_COMPUTE`
+    is the single shared constant), so the unfused fallback and the
+    fused path round int8 pages identically; change one and you must
+    change both (``tests/test_paged.py`` pins the contract)."""
     return (q.astype(jnp.float32)
             * scale[..., None].astype(jnp.float32)).astype(dtype)
 
@@ -784,8 +813,44 @@ def _gather_scales(scale_l, table):
     return jnp.moveaxis(g, 1, 2).reshape(S, Hkv, max_pages * ps)
 
 
+def _paged_kernel_attend(qg, k_pool, v_pool, k_scale, v_scale, table,
+                         limit, cfg: TransformerConfig, mesh=None):
+    """Call the fused paged-attention kernel for one layer, under
+    ``shard_map`` when a tp mesh is given.
+
+    The kernel's grid is per-(slot, kv-head) with NO cross-head
+    communication, so the tp=N head-sharded pool (``paged_pool_specs``)
+    maps onto it shard-locally: each device runs the kernel over its
+    own ``H_kv / tp`` heads against its own pool shard, with the table
+    and per-slot limits replicated (host tick data).  Outputs come back
+    head-sharded, matching the projection that consumes them.  Without
+    a mesh the kernel is called directly (single-device serving)."""
+    from horovod_tpu.ops import paged_attention as _pa
+
+    quantized = k_scale is not None
+    if mesh is None:
+        return _pa.paged_attend(qg, k_pool, v_pool, k_scale, v_scale,
+                                table, limit, compute_dtype=cfg.dtype)
+
+    from horovod_tpu import spmd
+
+    in_specs, out_specs = paged_kernel_specs(quantized)
+    if quantized:
+        fn = spmd.shard(
+            lambda q_, k_, v_, ks_, vs_, t_, l_: _pa.paged_attend(
+                q_, k_, v_, ks_, vs_, t_, l_, compute_dtype=cfg.dtype),
+            in_specs=in_specs, out_specs=out_specs, mesh=mesh)
+        return fn(qg, k_pool, v_pool, k_scale, v_scale, table, limit)
+    fn = spmd.shard(
+        lambda q_, k_, v_, t_, l_: _pa.paged_attend(
+            q_, k_, v_, None, None, t_, l_, compute_dtype=cfg.dtype),
+        in_specs=in_specs, out_specs=out_specs, mesh=mesh)
+    return fn(qg, k_pool, v_pool, table, limit)
+
+
 def _attention_decode_paged(x, p, cfg: TransformerConfig, k_pool, v_pool,
-                            k_scale, v_scale, table, pos, active):
+                            k_scale, v_scale, table, pos, active,
+                            kernel=False, mesh=None):
     """Per-slot one-token attention against a PAGED cache: row ``s``
     writes its K/V at logical position ``pos[s]`` — resolved through
     the page table to ``(page table[s, pos//page], offset pos%page)`` —
@@ -805,7 +870,15 @@ def _attention_decode_paged(x, p, cfg: TransformerConfig, k_pool, v_pool,
     ``k_scale``/``v_scale`` are the per-(head, position) f32 scales of
     int8 pools (None for bf16/f32 storage): the payload is dequantized
     AFTER the gather, so only the logical view — not the whole pool —
-    is ever materialized at compute dtype."""
+    is ever materialized at compute dtype.
+
+    ``kernel=True`` replaces the gather -> dequant -> attend tail with
+    the fused Pallas flash-decoding kernel (:mod:`horovod_tpu.ops.
+    paged_attention`): the pages stream through VMEM with int8 dequant
+    in the load and NOTHING materialized at logical shape.  The scatter
+    (write-before-attend) is identical under both paths, so the fused
+    tick attends exactly the same pool state; ``mesh`` routes the
+    kernel through ``shard_map`` for tp head-sharded pools."""
     S = x.shape[0]
     max_pages = table.shape[1]
     ps = k_pool.shape[2]
@@ -823,24 +896,40 @@ def _attention_decode_paged(x, p, cfg: TransformerConfig, k_pool, v_pool,
         v_pool = v_pool.at[phys, :, off, :].set(qv)
         k_scale = k_scale.at[phys, :, off].set(sk)
         v_scale = v_scale.at[phys, :, off].set(sv)
-        kg = kv_dequantize(_gather_pages(k_pool, table),
-                           _gather_scales(k_scale, table), cfg.dtype)
-        vg = kv_dequantize(_gather_pages(v_pool, table),
-                           _gather_scales(v_scale, table), cfg.dtype)
     else:
         k_pool = k_pool.at[phys, :, off, :].set(k_t1.astype(k_pool.dtype))
         v_pool = v_pool.at[phys, :, off, :].set(v_t1.astype(v_pool.dtype))
-        kg = _gather_pages(k_pool, table)
-        vg = _gather_pages(v_pool, table)
-    T = max_pages * ps
-    mask = lax.broadcasted_iota(jnp.int32, (T,), 0)[None, :] <= pos[:, None]
-    o = _cache_attend(qh, kg, vg, mask[:, None, None, :])
+    B, H, _, Dh = qh.shape
+    if kernel:
+        # Fused path: attend positions <= pos ⇔ logical < pos + 1,
+        # zeroed for inactive rows so their (NULL-page-routed) writes
+        # are never attended.
+        limit = jnp.where(active, pos + 1, 0)
+        Hkv = k_pool.shape[1]
+        qg = qh.reshape(B, Hkv, H // Hkv, Dh)
+        o, _ = _paged_kernel_attend(qg, k_pool, v_pool, k_scale, v_scale,
+                                    table, limit, cfg, mesh)
+        o = o.reshape(B, H, 1, Dh)
+    else:
+        if quantized:
+            kg = kv_dequantize(_gather_pages(k_pool, table),
+                               _gather_scales(k_scale, table), cfg.dtype)
+            vg = kv_dequantize(_gather_pages(v_pool, table),
+                               _gather_scales(v_scale, table), cfg.dtype)
+        else:
+            kg = _gather_pages(k_pool, table)
+            vg = _gather_pages(v_pool, table)
+        T = max_pages * ps
+        mask = (lax.broadcasted_iota(jnp.int32, (T,), 0)[None, :]
+                <= pos[:, None])
+        o = _cache_attend(qh, kg, vg, mask[:, None, None, :])
     return (_out_proj(o.astype(cfg.dtype), p, cfg),
             k_pool, v_pool, k_scale, v_scale)
 
 
 def decode_step_paged(params: Dict, tokens_t, pool: Dict, table,
-                      cfg: TransformerConfig, active):
+                      cfg: TransformerConfig, active, *, kernel=False,
+                      mesh=None):
     """One continuous-batching decode tick over a PAGED KV cache.
 
     ``pool``: the page pool (:func:`horovod_tpu.serving.cache.
@@ -857,7 +946,14 @@ def decode_step_paged(params: Dict, tokens_t, pool: Dict, table,
     for any table that lays the slot's positions out in order).
 
     Returns ``(logits (S, V) float32, updated pool)`` — the table is
-    host-owned and passed back unchanged."""
+    host-owned and passed back unchanged.
+
+    ``kernel=True`` routes every layer's attention through the fused
+    Pallas flash-decoding kernel (gather/dequant/attend in one VMEM
+    pass — :mod:`horovod_tpu.ops.paged_attention`); logits stay greedy-
+    token-identical to the unfused path.  ``kernel``/``mesh`` are
+    trace-time Python values, so flipping them selects a DIFFERENT
+    executable rather than recompiling an existing one."""
     pos = pool["pos"]
     T_cap = table.shape[1] * pool["k"].shape[3]
     if not isinstance(pos, jax.core.Tracer) and not isinstance(
@@ -879,7 +975,7 @@ def decode_step_paged(params: Dict, tokens_t, pool: Dict, table,
             (p, k_c, v_c), ks_c, vs_c = inp, None, None
         h, k_new, v_new, ks_new, vs_new = _attention_decode_paged(
             _rmsnorm(x, p["ln1"]), p, cfg, k_c, v_c, ks_c, vs_c,
-            table, pos, active)
+            table, pos, active, kernel=kernel, mesh=mesh)
         out = (k_new, v_new) + ((ks_new, vs_new) if quantized else ())
         return _mlp_block(x + h, p, cfg, moe_impl="dense"), out
 
@@ -907,7 +1003,8 @@ def decode_step_paged(params: Dict, tokens_t, pool: Dict, table,
 
 
 def draft_propose_paged(params: Dict, tokens_t, pool: Dict, table,
-                        cfg: TransformerConfig, active, k: int):
+                        cfg: TransformerConfig, active, k: int, *,
+                        kernel=False, mesh=None):
     """``k`` greedy draft tokens per slot from a (shallow) draft model:
     ``k + 1`` sequential :func:`decode_step_paged` steps in one trace —
     step ``i`` feeds the previous step's argmax, so the scan writes the
@@ -921,7 +1018,8 @@ def draft_propose_paged(params: Dict, tokens_t, pool: Dict, table,
 
     def step(carry, _):
         tok, pl = carry
-        logits, pl = decode_step_paged(params, tok, pl, table, cfg, active)
+        logits, pl = decode_step_paged(params, tok, pl, table, cfg, active,
+                                       kernel=kernel, mesh=mesh)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return (nxt, pl), nxt
 
@@ -966,7 +1064,7 @@ def ngram_propose(hist, pos, k: int):
 
 def decode_verify_paged(params: Dict, window, pool: Dict, table,
                         cfg: TransformerConfig, active, spec_on=None,
-                        sample=None):
+                        sample=None, *, kernel=False, mesh=None):
     """One batched W-position VERIFY forward over a paged cache — the
     speculative tick's target-model half.
 
@@ -1009,7 +1107,15 @@ def decode_verify_paged(params: Dict, window, pool: Dict, table,
 
     Returns ``(target_tokens (S, W) int32, max_logits (S, W) f32,
     accepted (S,) int32, updated pool)`` with ``pos`` advanced by
-    ``acc + 1`` per active slot."""
+    ``acc + 1`` per active slot.
+
+    ``kernel=True`` splits each layer's attention into the fused Pallas
+    kernel over the COMMITTED pages (positions ``< pos[s]``, streamed
+    through VMEM with int8 dequant in the load) plus a dense causal
+    pass over the W-wide window, merged by logsumexp — the standard
+    flash-decoding cross-source combine.  The in-window K/V still takes
+    its storage-dtype round trip first, so verify logits keep their
+    bit-identity to the sequential one-token path."""
     pos = pool["pos"]
     S, W = window.shape
     max_pages = table.shape[1]
@@ -1033,6 +1139,10 @@ def decode_verify_paged(params: Dict, window, pool: Dict, table,
                <= lax.broadcasted_iota(jnp.int32, (W, W), 0))
     win_vis = jnp.broadcast_to(win_vis[None], (S, W, W))
     mask = jnp.concatenate([cache_vis, win_vis], axis=2)[:, None, None]
+    # Committed-page limit for the fused kernel (strictly < pos, shared
+    # by every window offset) — zeroed for inactive rows.
+    climit = jnp.where(active, pos, 0)
+    wmask = win_vis[:, None, None]              # (S, 1, 1, W, W)
 
     def layer(x, inp):
         if quantized:
@@ -1046,29 +1156,61 @@ def decode_verify_paged(params: Dict, window, pool: Dict, table,
             qv, sv = kv_quantize(vh)
             kh_a = kv_dequantize(qk, sk, cfg.dtype)
             vh_a = kv_dequantize(qv, sv, cfg.dtype)
-            kg = kv_dequantize(_gather_pages(k_c, table),
-                               _gather_scales(ks_c, table), cfg.dtype)
-            vg = kv_dequantize(_gather_pages(v_c, table),
-                               _gather_scales(vs_c, table), cfg.dtype)
             ys = (qk, sk, qv, sv)
         else:
             kh_a = kh.astype(storage)
             vh_a = vh.astype(storage)
-            kg = _gather_pages(k_c, table)
-            vg = _gather_pages(v_c, table)
             ys = (kh_a, vh_a)
-        k_full = jnp.concatenate([kg, kh_a], axis=2)  # (S,Hkv,T+W,Dh)
-        v_full = jnp.concatenate([vg, vh_a], axis=2)
-        # Grouped-query attention, W queries wide — _cache_attend's
-        # bandwidth discipline (stored dtype, f32 MXU accumulation).
         qg = qh.reshape(S, Hkv, G, W, Dh)
-        sc = jnp.einsum("bkgsd,bktd->bkgst", qg.astype(k_full.dtype),
-                        k_full, preferred_element_type=jnp.float32
-                        ) / np.sqrt(Dh)
-        sc = jnp.where(mask, sc, -1e30)
-        w = jax.nn.softmax(sc, axis=-1)
-        o = jnp.einsum("bkgst,bktd->bkgsd", w.astype(v_full.dtype),
-                       v_full, preferred_element_type=jnp.float32)
+        if kernel:
+            # Fused kernel over the committed pages: W*G query rows per
+            # (slot, kv-head) in one pass, pre-scatter pool (same state
+            # the unfused gather reads), int8 dequant in the load.
+            o_c, lse_c = _paged_kernel_attend(
+                qg.reshape(S, Hkv, G * W, Dh), k_c, v_c, ks_c, vs_c,
+                table, climit, cfg, mesh)
+            o_c = o_c.reshape(S, Hkv, G, W, Dh)
+            lse_c = lse_c.reshape(S, Hkv, G, W)
+            # Dense causal attention within the window (post round-trip
+            # K/V), kept unnormalized alongside its own logsumexp.
+            sw = jnp.einsum("bkgsd,bktd->bkgst", qg.astype(kh_a.dtype),
+                            kh_a, preferred_element_type=jnp.float32
+                            ) / np.sqrt(Dh)
+            sw = jnp.where(wmask, sw, -1e30)
+            mw = jnp.max(sw, axis=-1)           # (S, Hkv, G, W)
+            pw = jnp.exp(sw - mw[..., None])
+            lw = jnp.sum(pw, axis=-1)           # >= 1: diagonal visible
+            o_w = jnp.einsum("bkgst,bktd->bkgsd", pw.astype(vh_a.dtype),
+                             vh_a, preferred_element_type=jnp.float32
+                             ) / lw[..., None]
+            lse_w = mw + jnp.log(lw)
+            # Cross-source LSE combine; a_c underflows to exactly 0 for
+            # rows with no committed context (lse_c == NEG_INF).
+            m = jnp.maximum(lse_c, lse_w)
+            a_c = jnp.exp(lse_c - m)
+            a_w = jnp.exp(lse_w - m)
+            o = ((a_c[..., None] * o_c + a_w[..., None] * o_w)
+                 / (a_c + a_w)[..., None])
+        else:
+            if quantized:
+                kg = kv_dequantize(_gather_pages(k_c, table),
+                                   _gather_scales(ks_c, table), cfg.dtype)
+                vg = kv_dequantize(_gather_pages(v_c, table),
+                                   _gather_scales(vs_c, table), cfg.dtype)
+            else:
+                kg = _gather_pages(k_c, table)
+                vg = _gather_pages(v_c, table)
+            k_full = jnp.concatenate([kg, kh_a], axis=2)  # (S,Hkv,T+W,Dh)
+            v_full = jnp.concatenate([vg, vh_a], axis=2)
+            # Grouped-query attention, W queries wide — _cache_attend's
+            # bandwidth discipline (stored dtype, f32 MXU accumulation).
+            sc = jnp.einsum("bkgsd,bktd->bkgst", qg.astype(k_full.dtype),
+                            k_full, preferred_element_type=jnp.float32
+                            ) / np.sqrt(Dh)
+            sc = jnp.where(mask, sc, -1e30)
+            w = jax.nn.softmax(sc, axis=-1)
+            o = jnp.einsum("bkgst,bktd->bkgsd", w.astype(v_full.dtype),
+                           v_full, preferred_element_type=jnp.float32)
         out = _out_proj(o.reshape(S, H, W, Dh).astype(cfg.dtype), p, cfg)
         return _mlp_block(x + out, p, cfg, moe_impl="dense"), ys
 

@@ -192,54 +192,19 @@ def _flash_fwd_kernel(shift_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         lse_ref[0] = jnp.broadcast_to(lse[None, :], lse_ref.shape[1:])
 
 
-try:  # pallas is TPU/GPU-oriented; keep import failure non-fatal on CPU
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
-
-    _PALLAS = True
-except Exception:  # pragma: no cover
-    _PALLAS = False
-
-
-def _use_interpret() -> bool:
-    return jax.default_backend() != "tpu"
-
-
-def _out_sds(shape, dtype, like):
-    """ShapeDtypeStruct that inherits ``like``'s varying-over-mesh-axes
-    type, so the pallas_call type-checks inside ``shard_map`` (ring
-    attention runs the kernel per sequence shard)."""
-    try:
-        vma = jax.typeof(like).vma
-    except Exception:
-        vma = None
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, dtype)
-
-
-def _shift_operand(shift, like):
-    """(1,) int32 SMEM operand for the kernels (0 when unmasked)."""
-    arr = jnp.asarray(0 if shift is None else shift, jnp.int32).reshape(1)
-    try:
-        vma = set(jax.typeof(like).vma)
-        have = set(jax.typeof(arr).vma)
-    except Exception:
-        return arr
-    need = tuple(vma - have)
-    if need:  # match the tensor operands' varying-over-axis type
-        arr = jax.lax.pvary(arr, need)
-    return arr
-
-
-_SMEM_SPEC = None
-
-
-def _smem_spec():
-    global _SMEM_SPEC
-    if _SMEM_SPEC is None:
-        _SMEM_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
-    return _SMEM_SPEC
+# Shared Pallas plumbing (ops/_pallas_util.py): the guarded import,
+# interpreter fallback, vma-inheriting out shapes, and the SMEM scalar
+# spec are shared with the fused paged-attention decode kernel
+# (ops/paged_attention.py) so the conventions cannot fork.
+from horovod_tpu.ops._pallas_util import (  # noqa: E402
+    PALLAS_AVAILABLE as _PALLAS,
+    out_sds as _out_sds,
+    pl,
+    pltpu,
+    scalar_operand as _shift_operand,
+    smem_spec as _smem_spec,
+    use_interpret as _use_interpret,
+)
 
 
 def _flash_fwd(q, k, v, shift, sm_scale, block_q: int, block_k: int):

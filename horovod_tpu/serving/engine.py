@@ -378,6 +378,20 @@ class EngineConfig:
     page_size: int = 16
     n_pages: int = 0
     kv_dtype: Optional[str] = None
+    # Fused paged-attention decode kernel (docs/serving.md "Paged
+    # decode kernel"): route every paged decode/draft/verify tick's
+    # attention through the Pallas flash-decoding kernel
+    # (horovod_tpu/ops/paged_attention.py) — pages stream through VMEM
+    # with int8 dequant fused into the load, nothing materialized at
+    # logical shape.  None = auto (engage on a real TPU backend, stay
+    # on the unfused XLA path elsewhere — the CPU interpreter runs the
+    # kernel faithfully but slowly); True forces it anywhere Pallas
+    # imports (tests/benchmarks); False pins the unfused path.  Greedy
+    # output is token-identical either way (tests/test_paged.py), and
+    # the flag is a CONSTRUCTOR-level knob: it is baked into the tick
+    # executables at trace time, so flipping it means a rebuild —
+    # tuning/replay.py explores it offline like kv_dtype/page_size.
+    paged_kernel: Optional[bool] = None
     # Tensor parallelism (docs/serving.md "Tensor-parallel replicas"):
     # tp > 1 runs EVERY compiled tick body under GSPMD over a tp mesh
     # built from parallel/meshes.MeshSpec — params sharded per
@@ -702,6 +716,26 @@ class InferenceEngine:
         # stable — a fed-back committed output and a fresh host upload
         # hit the same compiled program — so the zero-decode-recompile
         # guard holds under tp unchanged.
+        # Fused paged-attention kernel engagement (paged_kernel knob):
+        # resolved HERE, once, to a Python bool — it is closed over by
+        # the tick bodies below at trace time, so engagement can never
+        # cause a steady-state recompile (flipping it is a rebuild, the
+        # same contract as kv_dtype/page_size).  None = auto: engage on
+        # a real TPU backend only — the CPU interpreter runs the kernel
+        # body faithfully but far slower than the unfused XLA path, so
+        # auto keeps CPU ticks (and the tier-1 suite) on the fallback
+        # while tests opt in explicitly with paged_kernel=True.
+        if engine_cfg.paged:
+            from horovod_tpu.ops._pallas_util import PALLAS_AVAILABLE
+            _want = (engine_cfg.paged_kernel
+                     if engine_cfg.paged_kernel is not None
+                     else jax.default_backend() == "tpu")
+            self._paged_kernel = bool(_want) and PALLAS_AVAILABLE
+        else:
+            self._paged_kernel = False
+        _pk = self._paged_kernel
+        _pk_mesh = self.mesh if (_pk and engine_cfg.tp > 1) else None
+
         shd = self._shard
         self._sh_R = _R = shd.replicated if shd else None
         self._sh_params = _psh = shd.param_shardings() if shd else None
@@ -740,12 +774,14 @@ class InferenceEngine:
                     # misplace the window's K/V for the whole tenancy.
                     dpool = {**dpool, "pos": pool["pos"]}
                     drafts, dpool = T.draft_propose_paged(
-                        dparams, tokens, dpool, dtable, dcfg, active, K)
+                        dparams, tokens, dpool, dtable, dcfg, active, K,
+                        kernel=_pk, mesh=_pk_mesh)
                     window = jnp.concatenate([tokens[:, None], drafts],
                                              axis=1)
                     t, mx, acc, pool = T.decode_verify_paged(
                         params, window, pool, table, self.cfg, active,
-                        spec_on, sample=(s_t, s_k, s_p, s_key))
+                        spec_on, sample=(s_t, s_k, s_p, s_key),
+                        kernel=_pk, mesh=_pk_mesh)
                     # Draft rollback on rejection = reset pos to the
                     # committed depth; the rejected tail's stale draft
                     # K/V is overwritten before it is ever attended
@@ -778,7 +814,8 @@ class InferenceEngine:
                                              axis=1)
                     t, mx, acc, pool = T.decode_verify_paged(
                         params, window, pool, table, self.cfg, active,
-                        spec_on, sample=(s_t, s_k, s_p, s_key))
+                        spec_on, sample=(s_t, s_k, s_p, s_key),
+                        kernel=_pk, mesh=_pk_mesh)
                     # Accepted drafts are now committed history too.
                     j = jnp.arange(1, K + 1, dtype=jnp.int32)[None, :]
                     wp = pos[:, None] + j
@@ -810,7 +847,8 @@ class InferenceEngine:
                 obs_tracing.record_compile("serving_decode")
                 pos = pool["pos"]
                 logits, pool = T.decode_step_paged(
-                    params, tokens, pool, table, self.cfg, active)
+                    params, tokens, pool, table, self.cfg, active,
+                    kernel=_pk, mesh=_pk_mesh)
                 nxt = self._pick(logits, pos, s_t, s_k, s_p, s_key)
                 mx = jnp.max(logits, axis=-1)
                 return jnp.where(active, nxt, 0), mx, pool
@@ -827,7 +865,8 @@ class InferenceEngine:
                 obs_tracing.record_compile("serving_decode")
                 pos = pool["pos"]
                 logits, pool = T.decode_step_paged(
-                    params, tokens, pool, table, self.cfg, active)
+                    params, tokens, pool, table, self.cfg, active,
+                    kernel=_pk, mesh=_pk_mesh)
                 # The sampled pick — per-slot temperature/top-k/top-p
                 # COLUMNS and PRNG key ROWS, all data: greedy rows
                 # (temperature 0) are the argmax of old, sampled rows
@@ -3688,5 +3727,10 @@ class InferenceEngine:
                 "kv_dtype": str(jnp.dtype(self.slots._storage_dtype).name),
                 "kv_pages_high_water": self.slots.pages_high_water,
                 "prefixes_registered": len(self._prefixes),
+                # Whether the decode/draft/verify ticks were built on
+                # the fused Pallas paged-attention kernel (resolved at
+                # construction from EngineConfig.paged_kernel; see
+                # docs/serving.md "Paged decode kernel").
+                "paged_kernel_engaged": self._paged_kernel,
             } if self.engine_cfg.paged else {}),
         }

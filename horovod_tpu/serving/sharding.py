@@ -178,6 +178,20 @@ class ServingSharding:
     def prefix_kv_sharding(self) -> NamedSharding:
         return NamedSharding(self.mesh, T.prefix_kv_specs())
 
+    def paged_kernel_shardings(self, quantized: bool = False):
+        """NamedShardings for the fused paged-attention kernel's
+        operands/results (:func:`~horovod_tpu.models.transformer.
+        paged_kernel_specs` order: ``(q, k_pool, v_pool[, k_scale,
+        v_scale], table, limit)`` / ``(o, lse)``).  The kernel runs
+        per-(slot, kv-head) with no cross-head traffic, so the
+        head-dim-sharded pool passes straight through: the tick's
+        ``shard_map`` uses the raw specs, and these placements exist so
+        callers (tests, benchmarks, ahead-of-time placement) can pin
+        kernel operands consistently with the pool they came from."""
+        in_specs, out_specs = T.paged_kernel_specs(quantized)
+        return ([NamedSharding(self.mesh, s) for s in in_specs],
+                [NamedSharding(self.mesh, s) for s in out_specs])
+
     # -- observability -----------------------------------------------------
 
     def describe(self) -> str:

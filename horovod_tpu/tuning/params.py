@@ -43,9 +43,12 @@ still reflect the old setting), and a human-readable apply path for
 ``GET /tuning`` and the docs table.
 
 Constructor-level knobs (``kv_dtype``, ``n_slots``, ``page_size``,
-``spec_k``) cannot be applied to a live engine at any price — they are
-the offline space :mod:`horovod_tpu.tuning.replay` explores by
-rebuilding an engine per sample.
+``spec_k``, ``paged_kernel``) cannot be applied to a live engine at any
+price — they are the offline space :mod:`horovod_tpu.tuning.replay`
+explores by rebuilding an engine per sample (``paged_kernel`` is baked
+into the tick executables at trace time, exactly like ``kv_dtype``:
+``--set paged_kernel=true`` on a replay run A/Bs the fused Pallas
+decode kernel against the unfused gather path).
 """
 
 from __future__ import annotations

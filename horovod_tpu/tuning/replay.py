@@ -17,7 +17,8 @@ Two consumers:
   over replay runs — one engine built (and warmed) per sample, scored
   by the same :class:`~horovod_tpu.tuning.tuner.Objective` the online
   tuner uses, so constructor-level knobs (``kv_dtype``, ``n_slots``,
-  ``page_size``, ``spec_k``) that no live engine could ever apply are
+  ``page_size``, ``spec_k``, ``paged_kernel`` — the fused Pallas
+  decode kernel switch) that no live engine could ever apply are
   tunable here;
 * the PERF-REGRESSION GATE (``benchmarks/replay_gate.py``): replay a
   committed miniature trace on CPU, compare the score JSON against a

@@ -64,6 +64,30 @@ def http_post_json(url, payload, timeout=60.0):
         return e.code, json.loads(e.read())
 
 
+def assert_compile_set(engine, *, decode=None, prefill=None, sample=None):
+    """The compile-count guard: assert an engine has built EXACTLY the
+    expected executables — no more, no fewer.  Shared by the paged /
+    sched / tp suites so every zero-recompile assertion reads the same
+    counters the /stats endpoint exposes (``decode_compilations`` etc.),
+    and so the fused paged-kernel path proves it adds NEW executables
+    (prefill + decode [+ verify]) rather than per-tick retraces: run
+    traffic, snapshot, run more traffic, call again with the same
+    expectations.  ``None`` skips a counter."""
+    stats = engine.stats()
+    got = {
+        "decode": stats["decode_compilations"],
+        "prefill": stats["prefill_compilations"],
+        "sample": stats["sample_compilations"],
+    }
+    want = {"decode": decode, "prefill": prefill, "sample": sample}
+    bad = {k: (got[k], want[k]) for k in got
+           if want[k] is not None and got[k] != want[k]}
+    assert not bad, (
+        "compile-set mismatch (counter: got != expected): "
+        + ", ".join(f"{k}: {g} != {w}" for k, (g, w) in bad.items()))
+    return got
+
+
 def parse_prometheus_text(text):
     """STRICT parser/validator for Prometheus text exposition (0.0.4);
     the golden check behind the /metrics tests (shared by test_obs.py

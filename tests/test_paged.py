@@ -677,6 +677,9 @@ class TestPagedObservability:
 
 
 class TestPagedDecodeKernel:
+    @pytest.mark.slow  # ~9 s eager rowwise A/B (PR 19 budget pass,
+    # DURATIONS.md); tier-1 siblings: test_growth_crosses_page_boundaries
+    # + test_inactive_rows_write_only_the_null_page below
     def test_matches_slot_decode_rowwise(self, model):
         """decode_step_paged row s == decode_step_slots row s for an
         OUT-OF-ORDER page table — the indirection is exact."""
@@ -741,6 +744,241 @@ class TestPagedDecodeKernel:
             T.decode_step_paged(params, jnp.zeros(2, jnp.int32), pool,
                                 jnp.asarray(table), cfg,
                                 jnp.asarray([True, False]))
+
+
+@pytest.mark.paged_kernel
+class TestFusedPagedKernel:
+    """The fused Pallas flash-decoding kernel (ops/paged_attention.py)
+    vs the unfused gather->dequant->attend path.
+
+    TOLERANCE CONTRACT (the satellite audit): int8 dequant is pinned to
+    f32 compute in BOTH paths (kv_dequantize and the kernel's fused
+    load share DEQUANT_COMPUTE), so f32 and int8 pools agree to f32
+    rounding (|dlogits| ~1e-6 at this scale; asserted at atol=1e-4).
+    bf16 pools round the attention weights at different points (the
+    online-softmax accumulator rescales before the final normalize),
+    so logits agree only to bf16 noise (atol=2e-2) — but GREEDY TOKENS
+    are identical in every case, which is the landing gate.
+    """
+
+    _KV = [None, "bf16", "int8"]
+
+    @pytest.mark.parametrize("kv", _KV)
+    def test_kernel_matches_reference_edge_tables(self, model, kv):
+        """Unit: Pallas kernel == pure-JAX reference over one layer's
+        pool for the edge-case table set — partial last page, a slot at
+        exactly table capacity, an inactive (fully masked) slot, and a
+        REPEATED page id (the refcount>1 / COW-shared shape: two slots'
+        tables referencing the same physical page)."""
+        from horovod_tpu.ops import paged_attention as PA
+
+        _, cfg = model
+        rng = np.random.RandomState(3)
+        S, Hkv, G, Dh, ps, MP = 4, 2, 2, 16, 8, 3
+        Pn = 8
+        qg = jnp.asarray(rng.randn(S, Hkv, G, Dh), jnp.float32)
+        kf = rng.randn(Pn, Hkv, ps, Dh).astype(np.float32)
+        vf = rng.randn(Pn, Hkv, ps, Dh).astype(np.float32)
+        table = np.asarray(rng.randint(1, Pn, (S, MP)), np.int32)
+        table[1] = table[0]           # shared pages, refcount > 1
+        limit = jnp.asarray([ps * MP,  # exactly at table capacity
+                             5,        # partial last page
+                             0,        # inactive: fully masked
+                             ps + 3], jnp.int32)
+        if kv == "int8":
+            kq, ks = T.kv_quantize(jnp.asarray(kf))
+            vq, vs = T.kv_quantize(jnp.asarray(vf))
+            args = (qg, kq, vq, ks, vs)
+        else:
+            dt = jnp.bfloat16 if kv == "bf16" else jnp.float32
+            args = (qg, jnp.asarray(kf, dt), jnp.asarray(vf, dt),
+                    None, None)
+        tab = jnp.asarray(table)
+        o_r, l_r = PA.paged_attend_reference(*args, tab, limit,
+                                             compute_dtype=cfg.dtype)
+        o_k, l_k = PA._pallas_paged_attend(*args, tab, limit, cfg.dtype)
+        tol = 2e-2 if kv == "bf16" else 1e-4
+        np.testing.assert_allclose(np.asarray(o_k), np.asarray(o_r),
+                                   atol=tol, rtol=tol)
+        live = np.asarray(limit) > 0
+        np.testing.assert_allclose(np.asarray(l_k)[live],
+                                   np.asarray(l_r)[live],
+                                   atol=tol, rtol=tol)
+        # fully-masked rows: zero output, NEG_INF logsumexp — the
+        # combine-neutral element
+        assert not np.asarray(o_k)[~live].any()
+        assert (np.asarray(l_k)[~live] <= PA.NEG_INF / 2).all()
+
+    def test_dequant_compute_dtype_pinned(self):
+        """The satellite audit: the kernel's fused dequant and
+        kv_dequantize must round IDENTICALLY — both promote int8
+        payload and scale through f32 (DEQUANT_COMPUTE) and cast once,
+        even when the target dtype is bf16."""
+        from horovod_tpu.ops import paged_attention as PA
+
+        assert PA.DEQUANT_COMPUTE == jnp.float32
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(5, 7, 16), jnp.float32)
+        q, s = T.kv_quantize(x)
+        for dt in (jnp.float32, jnp.bfloat16):
+            np.testing.assert_array_equal(
+                np.asarray(PA._dequant(q, s, dt).astype(jnp.float32)),
+                np.asarray(T.kv_dequantize(q, s, dt).astype(jnp.float32)))
+
+    @pytest.mark.slow  # ~18 s/variant eager-loop A/B (DURATIONS.md);
+    # tier-1 siblings: the kernel-vs-reference edge-table units above
+    # (all three pool dtypes) + test_engine_fused_oracle_and_compile_set
+    @pytest.mark.parametrize("kv", _KV)
+    def test_decode_step_fused_greedy_identical(self, model, kv):
+        """decode_step_paged(kernel=True) greedy-matches kernel=False
+        over ticks that cross a page boundary, with an inactive row and
+        an out-of-order table."""
+        params, cfg = model
+        rng = np.random.RandomState(1)
+        S, Pn, ps, MP = 4, 12, 8, 4
+        pool = serving.init_page_pool(cfg, S, Pn, ps, kv_dtype=kv)
+        table = jnp.asarray(rng.randint(1, Pn, (S, MP)), jnp.int32)
+        active = jnp.asarray([True, True, False, True])
+        tu = tk = jnp.asarray(rng.randint(0, 64, (S,)), jnp.int32)
+        pool_u, pool_k = dict(pool), dict(pool)
+        tol = 2e-2 if kv == "bf16" else 1e-4
+        for _ in range(10):  # crosses the ps=8 page boundary
+            lu, pool_u = T.decode_step_paged(params, tu, pool_u, table,
+                                             cfg, active)
+            lk, pool_k = T.decode_step_paged(params, tk, pool_k, table,
+                                             cfg, active, kernel=True)
+            np.testing.assert_allclose(np.asarray(lk)[np.asarray(active)],
+                                       np.asarray(lu)[np.asarray(active)],
+                                       atol=tol, rtol=tol)
+            au = jnp.argmax(lu, -1).astype(jnp.int32)
+            ak = jnp.argmax(lk, -1).astype(jnp.int32)
+            assert bool((au[active] == ak[active]).all())
+            tu, tk = au, ak
+        assert int(pool_k["pos"][2]) == 0  # inactive froze under kernel
+
+    @pytest.mark.slow  # ~18 s eager verify A/B (DURATIONS.md); tier-1
+    # sibling: test_engine_speculative_fused_oracle drives the same
+    # kernel+LSE-combine verify path through the compiled engine tick
+    def test_verify_fused_matches_unfused(self, model):
+        """decode_verify_paged(kernel=True): the committed-pages kernel
+        + in-window LSE combine produces the same target tokens AND the
+        same acceptance as the unfused concat path — including a fresh
+        slot at pos 0 (no committed context: the combine's a_c
+        underflows to exactly zero)."""
+        params, cfg = model
+        rng = np.random.RandomState(1)
+        S, Pn, ps, MP, W = 4, 12, 8, 4, 4
+        table = jnp.asarray(rng.randint(1, Pn, (S, MP)), jnp.int32)
+        active = jnp.asarray([True, True, False, True])
+        for kv in (None, "int8"):
+            pool = serving.init_page_pool(cfg, S, Pn, ps, kv_dtype=kv)
+            t = jnp.asarray(rng.randint(0, 64, (S,)), jnp.int32)
+            for _ in range(9):
+                l, pool = T.decode_step_paged(params, t, pool, table,
+                                              cfg, active)
+                t = jnp.argmax(l, -1).astype(jnp.int32)
+            pool = dict(pool)
+            pool["pos"] = pool["pos"].at[3].set(0)  # fresh slot
+            win = jnp.asarray(rng.randint(0, 64, (S, W)), jnp.int32)
+            tu, mu, accu, _ = T.decode_verify_paged(
+                params, win, dict(pool), table, cfg, active)
+            tk, mk, acck, _ = T.decode_verify_paged(
+                params, win, dict(pool), table, cfg, active, kernel=True)
+            a = np.asarray(active)
+            np.testing.assert_array_equal(np.asarray(tk)[a],
+                                          np.asarray(tu)[a])
+            np.testing.assert_array_equal(np.asarray(acck),
+                                          np.asarray(accu))
+            np.testing.assert_allclose(np.asarray(mk)[a],
+                                       np.asarray(mu)[a],
+                                       atol=1e-4, rtol=1e-4)
+
+    def test_engine_fused_oracle_and_compile_set(self, model):
+        """ACCEPTANCE: a paged_kernel=True engine is token-identical to
+        per-request greedy_decode, compiles decode EXACTLY once (the
+        fused path adds new executables, not per-tick retraces — the
+        compile-set guard re-asserted after a second traffic round),
+        and reports paged_kernel_engaged in /stats."""
+        from conftest import assert_compile_set
+
+        params, cfg = model
+        engine = _engine(params, cfg, paged_kernel=True)
+        engine.start()
+        try:
+            prompts = [[3, 5, 7], [11, 2], [9, 9, 1, 4]]
+            futs = [engine.submit(p, max_new_tokens=8) for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+            for p, o in zip(prompts, outs):
+                assert o == _ref_greedy(params, cfg, p, 8)
+            assert engine.stats()["paged_kernel_engaged"] is True
+            got = assert_compile_set(engine, decode=1)
+            # churn: a new admission in an already-warmed bucket must
+            # reuse every executable — same compile set, verbatim
+            futs = [engine.submit([1, 2, 3], max_new_tokens=6)]
+            assert futs[0].result(timeout=120) == _ref_greedy(
+                params, cfg, [1, 2, 3], 6)
+            assert_compile_set(engine, decode=1, prefill=got["prefill"],
+                               sample=got["sample"])
+        finally:
+            engine.stop()
+
+    def test_engine_defaults_off_on_cpu_and_disable_works(self, model):
+        """paged_kernel=None auto-resolves OFF on a CPU backend (the
+        interpreter would own the tick otherwise); False pins it off
+        explicitly — both report engaged=False."""
+        params, cfg = model
+        for flag in (None, False):
+            engine = _engine(params, cfg, paged_kernel=flag)
+            assert engine.stats()["paged_kernel_engaged"] is False
+
+    @pytest.mark.slow  # ~7 s whole-engine drive (DURATIONS.md); tier-1
+    # siblings: test_engine_fused_oracle_and_compile_set (fused engine
+    # path) + the COW-shared-rows case in the edge-table units + the
+    # TestResumePagedComposition refcount-balance tests
+    def test_cow_shared_prefix_fused(self, model):
+        """COW-shared prefix pages (refcount > 1) under the fused
+        kernel: two requests sharing a registered prefix stream the
+        SAME physical pages through the kernel and still match the
+        per-request oracle."""
+        params, cfg = model
+        engine = _engine(params, cfg, paged_kernel=True)
+        prefix = [7, 8, 9, 10, 11, 12, 13, 14]  # one full page
+        engine.register_prefix(prefix)
+        engine.start()
+        try:
+            suffixes = [[1, 2], [3, 4, 5]]
+            futs = [engine.submit(prefix + s, max_new_tokens=6)
+                    for s in suffixes]
+            outs = [f.result(timeout=120) for f in futs]
+            for s, o in zip(suffixes, outs):
+                assert o == _ref_greedy(params, cfg, prefix + s, 6)
+            assert engine.stats()["prefixes_registered"] == 1
+        finally:
+            engine.stop()
+
+    @pytest.mark.spec
+    @pytest.mark.slow  # ~7 s spec-engine drive (DURATIONS.md); tier-1
+    # siblings: test_engine_fused_oracle_and_compile_set (fused engine)
+    # + test_speculative's plain spec oracles; the slow verify A/B
+    # above covers the kernel+LSE-combine verify math directly
+    def test_engine_speculative_fused_oracle(self, model):
+        """Spec-decode VERIFY inherits the kernel: a speculative
+        paged_kernel=True engine stays token-identical to the plain
+        unfused oracle (greedy byte-identity is a property of the
+        verify kernel alone)."""
+        params, cfg = model
+        engine = _engine(params, cfg, paged_kernel=True,
+                         speculative=True, spec_k=3)
+        engine.start()
+        try:
+            prompts = [[3, 5, 7], [9, 9, 1, 4]]
+            futs = [engine.submit(p, max_new_tokens=8) for p in prompts]
+            outs = [f.result(timeout=120) for f in futs]
+            for p, o in zip(prompts, outs):
+                assert o == _ref_greedy(params, cfg, p, 8)
+            assert engine.stats()["paged_kernel_engaged"] is True
+        finally:
+            engine.stop()
 
 
 class TestPagedHTTP:

@@ -287,6 +287,32 @@ class TestTpPrefix:
 
 
 class TestTpCompose:
+    @pytest.mark.paged_kernel
+    def test_fused_paged_kernel_under_tp(self, model):
+        """The fused Pallas paged-attention kernel under a tp=2 mesh
+        (int8 pool — the full spec set: head-sharded pages AND
+        per-vector scales through ``paged_kernel_specs``): the kernel
+        runs shard-locally per kv-head inside the tick's shard_map, and
+        the tp=2 fused engine emits tokens identical to the tp=1
+        UNFUSED int8 oracle, with zero decode recompiles across
+        churn."""
+        params, cfg = model
+        reqs = [([3, 5, 7], 8, {}), ([11, 2], 6, {})]
+        oracle = _engine(params, cfg, 1, kv_dtype="int8",
+                         paged_kernel=False)
+        oracle.warmup([4])
+        want = _drive(oracle, reqs)
+
+        eng = _engine(params, cfg, 2, kv_dtype="int8",
+                      paged_kernel=True)
+        eng.warmup([4])
+        warm = eng.decode_compilations
+        got = _drive(eng, reqs)
+        assert got == want
+        assert eng.decode_compilations - warm == 0
+        assert eng.stats()["paged_kernel_engaged"] is True
+        assert oracle.stats()["paged_kernel_engaged"] is False
+
     @pytest.mark.slow
     def test_chunked_prefill_under_tp(self, model):
         # Slow (PR 17 budget pass): oracle + tp engine pair is ~8 s;
